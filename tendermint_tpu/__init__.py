@@ -1,0 +1,22 @@
+"""tendermint-tpu: a TPU-native BFT state-machine-replication framework.
+
+A from-scratch reimplementation of the capability surface of Tendermint Core
+v0.11 (reference: /root/reference), redesigned TPU-first:
+
+- Host plane: the replicated state machine (consensus, mempool, p2p, state,
+  RPC) runs on host in Python, mirroring the reference's layering
+  (see SURVEY.md section 1).
+- TPU data plane: the crypto hot paths -- batched Ed25519 signature
+  verification (reference: types/vote_set.go:175, types/validator_set.go:247)
+  and vectorized RIPEMD-160/SHA-256 Merkle hashing (types/part_set.go:95,
+  types/tx.go:33) -- run as JAX kernels batched across lanes and sharded
+  over a device mesh (`tendermint_tpu.ops`).
+
+The two planes meet in `tendermint_tpu.ops.gateway`, a batching gateway that
+preserves the CPU implementation's observable accept/reject semantics and
+byte-identical hashes.
+"""
+
+from tendermint_tpu.version import __version__
+
+__all__ = ["__version__"]

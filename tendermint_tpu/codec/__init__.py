@@ -1,0 +1,36 @@
+"""Deterministic serialization: the go-wire equivalent (SURVEY.md 2.2).
+
+Two codecs, both byte-deterministic:
+- `binary`: c-style binary per the reference's wire-protocol spec
+  (docs/specification/wire-protocol.rst): big-endian fixed ints,
+  length-of-length varints, length-prefixed bytes, structs as concatenated
+  fields, interfaces as type byte + payload.
+- `canonical`: the canonical-JSON sign-bytes format (alphabetical keys,
+  uppercase-hex bytes, compact separators; reference
+  types/canonical_json.go + docs block-structure.rst "Vote Sign Bytes").
+
+Everything that is signed or hashed in this framework goes through one of
+these, so the CPU and TPU planes agree byte-for-byte.
+"""
+
+from tendermint_tpu.codec.binary import (
+    Decoder,
+    Encoder,
+    decode_bytes,
+    encode_bytes,
+    encode_string,
+    encode_uvarint,
+    encode_varint,
+)
+from tendermint_tpu.codec.canonical import canonical_dumps
+
+__all__ = [
+    "Encoder",
+    "Decoder",
+    "encode_bytes",
+    "encode_string",
+    "encode_uvarint",
+    "encode_varint",
+    "decode_bytes",
+    "canonical_dumps",
+]

@@ -1,0 +1,23 @@
+"""Host crypto: Ed25519 keys/signatures, SHA-256/RIPEMD-160 hashing,
+addresses — the equivalent of the reference's go-crypto dependency
+(SURVEY.md section 2.2). The batched TPU verification path lives in
+`tendermint_tpu.ops`; this package is the CPU reference implementation and
+the signing side (signing is inherently sequential and stays on host).
+"""
+
+from tendermint_tpu.crypto.hashing import ripemd160, sha256
+from tendermint_tpu.crypto.keys import (
+    PrivKeyEd25519,
+    PubKeyEd25519,
+    SignatureEd25519,
+    gen_priv_key_ed25519,
+)
+
+__all__ = [
+    "ripemd160",
+    "sha256",
+    "PrivKeyEd25519",
+    "PubKeyEd25519",
+    "SignatureEd25519",
+    "gen_priv_key_ed25519",
+]

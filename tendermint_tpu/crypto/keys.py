@@ -1,0 +1,130 @@
+"""Typed keys/signatures with type-byte unions and RIPEMD-160 addresses —
+the go-crypto equivalent (reference usage: types/validator.go:75-86,
+types/priv_validator.go).
+
+Wire shape kept from go-crypto: a key/signature serializes as a 1-byte type
+tag followed by the raw bytes; an address is ripemd160(tag || raw_pubkey).
+Ed25519 is the validator key type (type byte 0x01); Secp256k1 (0x02) is
+reserved and unimplemented here, gated the way the reference gates unused
+key types.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.crypto.hashing import ripemd160
+
+TYPE_ED25519 = 0x01
+TYPE_SECP256K1 = 0x02
+
+
+@dataclass(frozen=True)
+class SignatureEd25519:
+    raw: bytes  # 64 bytes
+
+    TYPE = TYPE_ED25519
+
+    def __post_init__(self):
+        if len(self.raw) != 64:
+            raise ValueError("ed25519 signature must be 64 bytes")
+
+    def bytes_(self) -> bytes:
+        return bytes([self.TYPE]) + self.raw
+
+    def to_json(self):
+        return [self.TYPE, self.raw.hex().upper()]
+
+    @classmethod
+    def from_json(cls, obj) -> "SignatureEd25519":
+        if obj[0] != TYPE_ED25519:
+            raise ValueError(f"unknown signature type {obj[0]}")
+        return cls(bytes.fromhex(obj[1]))
+
+
+@dataclass(frozen=True)
+class PubKeyEd25519:
+    raw: bytes  # 32 bytes
+
+    TYPE = TYPE_ED25519
+
+    def __post_init__(self):
+        if len(self.raw) != 32:
+            raise ValueError("ed25519 pubkey must be 32 bytes")
+
+    def address(self) -> bytes:
+        """20-byte account address: ripemd160 over the tagged key bytes
+        (go-crypto PubKeyEd25519.Address equivalent)."""
+        return ripemd160(self.bytes_())
+
+    def bytes_(self) -> bytes:
+        return bytes([self.TYPE]) + self.raw
+
+    def verify_bytes(self, msg: bytes, sig: "SignatureEd25519") -> bool:
+        """Sequential CPU verify — the reference hot path
+        (types/vote_set.go:175). Batched verification goes through
+        ops.gateway instead."""
+        if not isinstance(sig, SignatureEd25519):
+            return False
+        return ed25519.verify(self.raw, msg, sig.raw)
+
+    def to_json(self):
+        return [self.TYPE, self.raw.hex().upper()]
+
+    @classmethod
+    def from_json(cls, obj) -> "PubKeyEd25519":
+        if obj[0] != TYPE_ED25519:
+            raise ValueError(f"unknown pubkey type {obj[0]}")
+        return cls(bytes.fromhex(obj[1]))
+
+    def __hash__(self):
+        return hash(self.raw)
+
+
+@dataclass(frozen=True)
+class PrivKeyEd25519:
+    raw: bytes  # 32-byte seed
+
+    TYPE = TYPE_ED25519
+
+    def __post_init__(self):
+        if len(self.raw) != 32:
+            raise ValueError("ed25519 privkey seed must be 32 bytes")
+
+    def pub_key(self) -> PubKeyEd25519:
+        return PubKeyEd25519(ed25519.public_key(self.raw))
+
+    def sign(self, msg: bytes) -> SignatureEd25519:
+        return SignatureEd25519(ed25519.sign(self.raw, msg))
+
+    def bytes_(self) -> bytes:
+        return bytes([self.TYPE]) + self.raw
+
+    def to_json(self):
+        return [self.TYPE, self.raw.hex().upper()]
+
+    @classmethod
+    def from_json(cls, obj) -> "PrivKeyEd25519":
+        if obj[0] != TYPE_ED25519:
+            raise ValueError(f"unknown privkey type {obj[0]}")
+        return cls(bytes.fromhex(obj[1]))
+
+
+def gen_priv_key_ed25519(seed: bytes | None = None) -> PrivKeyEd25519:
+    """Random key, or a key derived from secret material. The secret is
+    ALWAYS sha256-hashed regardless of its length (go-crypto
+    GenPrivKeyEd25519FromSecret semantics) so derivation can't silently
+    change behavior at the 32-byte boundary."""
+    if seed is None:
+        return PrivKeyEd25519(os.urandom(32))
+    import hashlib
+
+    return PrivKeyEd25519(hashlib.sha256(seed).digest())
+
+
+def pub_key_from_json(obj):
+    if obj[0] == TYPE_ED25519:
+        return PubKeyEd25519.from_json(obj)
+    raise ValueError(f"unknown pubkey type {obj[0]}")

@@ -1,0 +1,4 @@
+"""Runtime primitives: the equivalents of the reference's tmlibs foundation
+(SURVEY.md section 2.2): BaseService lifecycle, BitArray, concurrent list,
+event switch, KV DB, autofile/WAL group, flow-rate monitor.
+"""

@@ -1,0 +1,105 @@
+"""Rotating append-only file group with reverse marker search — equivalent of
+tmlibs/autofile (Group + Search), the storage layer of the consensus WAL
+(consensus/wal.go:43-104) and mempool WAL (mempool/mempool.go:111-124).
+
+Semantics kept from the reference:
+- append lines to "head"; rotate to numbered chunks (path.000, path.001, ...)
+  when the head exceeds a size limit;
+- `search_for_end_height` scans backwards across chunks for the last
+  occurrence of a marker line (the "#ENDHEIGHT: h" convention,
+  consensus/replay.go:107-126) and returns a reader positioned just after it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+class Group:
+    def __init__(self, head_path: str, chunk_size: int = 10 * 1024 * 1024):
+        self._head_path = head_path
+        self._chunk_size = chunk_size
+        self._mtx = threading.RLock()
+        os.makedirs(os.path.dirname(head_path) or ".", exist_ok=True)
+        self._head = open(head_path, "ab")
+
+    # -- writing -----------------------------------------------------------
+
+    def write_line(self, line: str) -> None:
+        with self._mtx:
+            self._head.write(line.encode() + b"\n")
+
+    def flush(self, sync: bool = False) -> None:
+        with self._mtx:
+            self._head.flush()
+            if sync:
+                os.fsync(self._head.fileno())
+            if self._head.tell() >= self._chunk_size:
+                self._rotate()
+
+    def _rotate(self) -> None:
+        self._head.close()
+        idx = self._max_index() + 1
+        os.replace(self._head_path, f"{self._head_path}.{idx:03d}")
+        self._head = open(self._head_path, "ab")
+
+    def _max_index(self) -> int:
+        d = os.path.dirname(self._head_path) or "."
+        base = os.path.basename(self._head_path)
+        mx = -1
+        for fn in os.listdir(d):
+            if fn.startswith(base + "."):
+                suffix = fn[len(base) + 1 :]
+                if suffix.isdigit():
+                    mx = max(mx, int(suffix))
+        return mx
+
+    def close(self) -> None:
+        with self._mtx:
+            self._head.flush()
+            self._head.close()
+
+    # -- reading -----------------------------------------------------------
+
+    def _chunk_paths(self) -> list[str]:
+        """All chunk files oldest→newest, head last."""
+        paths = [
+            f"{self._head_path}.{i:03d}"
+            for i in range(self._max_index() + 1)
+            if os.path.exists(f"{self._head_path}.{i:03d}")
+        ]
+        if os.path.exists(self._head_path):
+            paths.append(self._head_path)
+        return paths
+
+    def read_all_lines(self) -> list[str]:
+        with self._mtx:
+            self._head.flush()
+            lines: list[str] = []
+            for p in self._chunk_paths():
+                with open(p, "rb") as f:
+                    for raw in f.read().splitlines():
+                        lines.append(raw.decode(errors="replace"))
+            return lines
+
+    def search_lines_after_marker(self, marker: str) -> list[str] | None:
+        """Lines strictly after the LAST line equal to `marker`; None if the
+        marker never occurs (the caller then treats the whole log as fresh,
+        matching autofile.Group.Search miss behavior).
+
+        Scans chunks newest-to-oldest and stops at the first chunk containing
+        the marker, so a long WAL only costs one chunk read in the common
+        case (the reference's reverse Search, consensus/replay.go:107-126).
+        """
+        with self._mtx:
+            self._head.flush()
+            tail: list[str] = []
+            for p in reversed(self._chunk_paths()):
+                with open(p, "rb") as f:
+                    lines = [ln.decode(errors="replace") for ln in f.read().splitlines()]
+                for i in range(len(lines) - 1, -1, -1):
+                    if lines[i] == marker:
+                        return lines[i + 1 :] + tail
+                tail = lines + tail
+            return None

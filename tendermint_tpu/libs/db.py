@@ -1,0 +1,176 @@
+"""KV store abstraction (reference: tmlibs/db — LevelDB/MemDB used for the
+block store, state, tx index, addr book; chosen at node/node.go:51-53).
+
+Two implementations:
+- MemDB: in-memory dict (tests, fast-path).
+- FileDB: dict snapshot persisted atomically to a single file. The access
+  patterns in this framework (point get/set by height-derived keys plus a
+  tiny iteration surface) don't need an LSM; an append-journal + periodic
+  compaction keeps restart-recovery semantics without external deps.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+
+
+class DB:
+    def get(self, key: bytes) -> bytes | None:
+        raise NotImplementedError
+
+    def set(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def set_sync(self, key: bytes, value: bytes) -> None:
+        self.set(key, value)
+
+    def delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def has(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def iterate_prefix(self, prefix: bytes):
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemDB(DB):
+    def __init__(self):
+        self._data: dict[bytes, bytes] = {}
+        self._mtx = threading.RLock()
+
+    def get(self, key: bytes) -> bytes | None:
+        with self._mtx:
+            return self._data.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._mtx:
+            self._data[bytes(key)] = bytes(value)
+
+    def delete(self, key: bytes) -> None:
+        with self._mtx:
+            self._data.pop(key, None)
+
+    def iterate_prefix(self, prefix: bytes):
+        with self._mtx:
+            items = sorted(
+                (k, v) for k, v in self._data.items() if k.startswith(prefix)
+            )
+        yield from items
+
+    def __len__(self):
+        with self._mtx:
+            return len(self._data)
+
+
+_REC = struct.Struct("<BII")  # op, klen, vlen
+
+
+class FileDB(DB):
+    """Append-only journal of (op, key, value) records with load-time replay
+    and size-triggered compaction. fsync on set_sync for the durability the
+    reference gets from LevelDB's WAL."""
+
+    _OP_SET = 1
+    _OP_DEL = 2
+
+    def __init__(self, path: str, compact_threshold: int = 64 * 1024 * 1024):
+        self._path = path
+        self._mtx = threading.RLock()
+        self._data: dict[bytes, bytes] = {}
+        self._compact_threshold = compact_threshold
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._load()
+        self._f = open(path, "ab")
+
+    def _load(self) -> None:
+        if not os.path.exists(self._path):
+            return
+        with open(self._path, "rb") as f:
+            buf = f.read()
+        off = 0
+        valid_end = 0
+        while off + _REC.size <= len(buf):
+            op, klen, vlen = _REC.unpack_from(buf, off)
+            off += _REC.size
+            if off + klen + vlen > len(buf):
+                break  # torn tail record from a crash: drop it
+            key = buf[off : off + klen]
+            off += klen
+            val = buf[off : off + vlen]
+            off += vlen
+            valid_end = off
+            if op == self._OP_SET:
+                self._data[key] = val
+            elif op == self._OP_DEL:
+                self._data.pop(key, None)
+        if valid_end < len(buf):
+            # truncate the torn tail so subsequent appends don't concatenate
+            # onto garbage and corrupt the journal for the next restart
+            with open(self._path, "r+b") as f:
+                f.truncate(valid_end)
+
+    def _append(self, op: int, key: bytes, value: bytes, sync: bool) -> None:
+        rec = _REC.pack(op, len(key), len(value)) + key + value
+        self._f.write(rec)
+        self._f.flush()
+        if sync:
+            os.fsync(self._f.fileno())
+        if self._f.tell() > self._compact_threshold:
+            self._compact()
+
+    def _compact(self) -> None:
+        tmp = self._path + ".compact"
+        with open(tmp, "wb") as f:
+            for k, v in self._data.items():
+                f.write(_REC.pack(self._OP_SET, len(k), len(v)) + k + v)
+            f.flush()
+            os.fsync(f.fileno())
+        self._f.close()
+        os.replace(tmp, self._path)
+        self._f = open(self._path, "ab")
+
+    def get(self, key: bytes) -> bytes | None:
+        with self._mtx:
+            return self._data.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._mtx:
+            key, value = bytes(key), bytes(value)
+            self._data[key] = value
+            self._append(self._OP_SET, key, value, sync=False)
+
+    def set_sync(self, key: bytes, value: bytes) -> None:
+        with self._mtx:
+            key, value = bytes(key), bytes(value)
+            self._data[key] = value
+            self._append(self._OP_SET, key, value, sync=True)
+
+    def delete(self, key: bytes) -> None:
+        with self._mtx:
+            if key in self._data:
+                del self._data[key]
+                self._append(self._OP_DEL, key, b"", sync=False)
+
+    def iterate_prefix(self, prefix: bytes):
+        with self._mtx:
+            items = sorted(
+                (k, v) for k, v in self._data.items() if k.startswith(prefix)
+            )
+        yield from items
+
+    def close(self) -> None:
+        with self._mtx:
+            self._f.close()
+
+
+def db_provider(name: str, backend: str, db_dir: str) -> DB:
+    """node/node.go:51-53 DefaultDBProvider equivalent."""
+    if backend in ("memdb", "mem"):
+        return MemDB()
+    return FileDB(os.path.join(db_dir, name + ".db"))

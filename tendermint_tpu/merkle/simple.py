@@ -1,0 +1,129 @@
+"""Simple Merkle tree + SimpleProof (CPU reference implementation).
+
+Equivalent of tmlibs/merkle (SURVEY.md 2.2), per the reference's merkle spec
+(docs/specification/merkle.rst): a compact binary tree over a static list;
+when the count is odd the LEFT side gets the extra leaf — the split point is
+(n+1)//2, matching types/tx.go:33-46 and the spec's diagrams. Hashes are
+RIPEMD-160 (20 bytes), computed over length-prefixed operands so leaf/inner
+domains can't collide by concatenation games.
+
+The vectorized TPU variant (tendermint_tpu/ops/merkle.py) must reproduce
+these digests byte-for-byte; tests cross-check the two.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.codec.binary import encode_bytes, encode_string
+from tendermint_tpu.crypto.hashing import ripemd160
+
+
+def leaf_hash(item: bytes) -> bytes:
+    """SimpleHashFromBinary equivalent: hash of the length-prefixed item."""
+    return ripemd160(encode_bytes(item))
+
+
+def inner_hash(left: bytes, right: bytes) -> bytes:
+    """SimpleHashFromTwoHashes equivalent."""
+    return ripemd160(encode_bytes(left) + encode_bytes(right))
+
+
+def kv_hash(key: str, value: bytes) -> bytes:
+    """KVPair leaf (used by Header.Hash / SimpleHashFromMap,
+    types/block.go:173-188)."""
+    return ripemd160(encode_string(key) + encode_bytes(value))
+
+
+def simple_hash_from_hashes(hashes: list[bytes]) -> bytes:
+    n = len(hashes)
+    if n == 0:
+        return b""
+    if n == 1:
+        return hashes[0]
+    mid = (n + 1) // 2
+    return inner_hash(
+        simple_hash_from_hashes(hashes[:mid]), simple_hash_from_hashes(hashes[mid:])
+    )
+
+
+def simple_hash_from_byteslices(items: list[bytes]) -> bytes:
+    return simple_hash_from_hashes([leaf_hash(it) for it in items])
+
+
+def simple_hash_from_map(kvs: dict[str, bytes]) -> bytes:
+    """Merkle root of a string-keyed map: KVPair leaves in sorted key order."""
+    return simple_hash_from_hashes([kv_hash(k, kvs[k]) for k in sorted(kvs)])
+
+
+@dataclass
+class SimpleProof:
+    """Inclusion proof: the aunt hashes bottom-up (reference
+    tmlibs/merkle SimpleProof; verified per part at types/part_set.go:204)."""
+
+    aunts: list[bytes] = field(default_factory=list)
+
+    def verify(self, index: int, total: int, leaf: bytes, root: bytes) -> bool:
+        if index < 0 or total <= 0 or index >= total:
+            return False
+        computed = _compute_hash_from_aunts(index, total, leaf, list(self.aunts))
+        return computed is not None and computed == root
+
+    def to_json(self):
+        return {"aunts": [a.hex().upper() for a in self.aunts]}
+
+    @classmethod
+    def from_json(cls, obj) -> "SimpleProof":
+        return cls([bytes.fromhex(a) for a in obj["aunts"]])
+
+
+def _compute_hash_from_aunts(
+    index: int, total: int, leaf: bytes, aunts: list[bytes]
+) -> bytes | None:
+    if total == 1:
+        if aunts:
+            return None
+        return leaf
+    mid = (total + 1) // 2
+    if not aunts:
+        return None
+    aunt = aunts[-1]
+    rest = aunts[:-1]
+    if index < mid:
+        left = _compute_hash_from_aunts(index, mid, leaf, rest)
+        if left is None:
+            return None
+        return inner_hash(left, aunt)
+    right = _compute_hash_from_aunts(index - mid, total - mid, leaf, rest)
+    if right is None:
+        return None
+    return inner_hash(aunt, right)
+
+
+def simple_proofs_from_hashes(hashes: list[bytes]) -> tuple[bytes, list[SimpleProof]]:
+    """Root + a proof per leaf (NewPartSetFromData builds these for every
+    part, types/part_set.go:95-122)."""
+    n = len(hashes)
+    proofs = [SimpleProof() for _ in range(n)]
+    root = _build(hashes, list(range(n)), proofs)
+    return root, proofs
+
+
+def _build(hashes: list[bytes], idxs: list[int], proofs: list[SimpleProof]) -> bytes:
+    n = len(hashes)
+    if n == 0:
+        return b""
+    if n == 1:
+        return hashes[0]
+    mid = (n + 1) // 2
+    left = _build(hashes[:mid], idxs[:mid], proofs)
+    right = _build(hashes[mid:], idxs[mid:], proofs)
+    for i in idxs[:mid]:
+        proofs[i].aunts.append(right)
+    for i in idxs[mid:]:
+        proofs[i].aunts.append(left)
+    return inner_hash(left, right)
+
+
+def simple_proofs_from_byteslices(items: list[bytes]) -> tuple[bytes, list[SimpleProof]]:
+    return simple_proofs_from_hashes([leaf_hash(it) for it in items])

@@ -1,0 +1,8 @@
+"""Version constants (reference: version/version.go:3-18)."""
+
+MAJ = "0"
+MIN = "1"
+FIX = "0"
+
+__version__ = f"{MAJ}.{MIN}.{FIX}"
+VERSION = __version__

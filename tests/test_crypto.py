@@ -1,0 +1,284 @@
+"""Crypto tests: RFC 8032 vectors, pure-py vs OpenSSL parity, RIPEMD-160
+known-answer tests, codec determinism, merkle tree + proofs."""
+
+import hashlib
+
+import pytest
+
+from tendermint_tpu.codec.binary import (
+    Decoder,
+    Encoder,
+    encode_bytes,
+    encode_uvarint,
+    encode_varint,
+)
+from tendermint_tpu.codec.canonical import canonical_dumps, sign_bytes
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.crypto.hashing import _ripemd160_py, ripemd160, sha256
+from tendermint_tpu.crypto.keys import (
+    PrivKeyEd25519,
+    PubKeyEd25519,
+    SignatureEd25519,
+    gen_priv_key_ed25519,
+)
+from tendermint_tpu.merkle.simple import (
+    SimpleProof,
+    inner_hash,
+    leaf_hash,
+    simple_hash_from_byteslices,
+    simple_hash_from_hashes,
+    simple_hash_from_map,
+    simple_proofs_from_byteslices,
+)
+
+# RFC 8032 section 7.1 test vectors (secret, public, message, signature)
+RFC8032_VECTORS = [
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+class TestEd25519PurePython:
+    @pytest.mark.parametrize("sk,pk,msg,sig", RFC8032_VECTORS)
+    def test_rfc8032_keygen(self, sk, pk, msg, sig):
+        assert ed25519.public_key_py(bytes.fromhex(sk)).hex() == pk
+
+    @pytest.mark.parametrize("sk,pk,msg,sig", RFC8032_VECTORS)
+    def test_rfc8032_sign(self, sk, pk, msg, sig):
+        assert ed25519.sign_py(bytes.fromhex(sk), bytes.fromhex(msg)).hex() == sig
+
+    @pytest.mark.parametrize("sk,pk,msg,sig", RFC8032_VECTORS)
+    def test_rfc8032_verify(self, sk, pk, msg, sig):
+        assert ed25519.verify_py(
+            bytes.fromhex(pk), bytes.fromhex(msg), bytes.fromhex(sig)
+        )
+
+    def test_verify_rejects_bad_sig(self):
+        sk, pk, msg, sig = RFC8032_VECTORS[2]
+        bad = bytearray(bytes.fromhex(sig))
+        bad[0] ^= 1
+        assert not ed25519.verify_py(bytes.fromhex(pk), bytes.fromhex(msg), bytes(bad))
+        assert not ed25519.verify_py(
+            bytes.fromhex(pk), b"wrong message", bytes.fromhex(sig)
+        )
+
+    def test_verify_rejects_high_s(self):
+        sk, pk, msg, sig = RFC8032_VECTORS[0]
+        raw = bytearray(bytes.fromhex(sig))
+        s = int.from_bytes(raw[32:], "little") + ed25519.L
+        raw[32:] = s.to_bytes(32, "little")
+        assert not ed25519.verify_py(bytes.fromhex(pk), bytes.fromhex(msg), bytes(raw))
+
+
+class TestEd25519Backends:
+    def test_backend_parity(self):
+        """OpenSSL fast path and pure python agree on keygen/sign/verify."""
+        seed = hashlib.sha256(b"parity-seed").digest()
+        msg = b"the quick brown fox"
+        assert ed25519.public_key(seed) == ed25519.public_key_py(seed)
+        sig_fast = ed25519.sign(seed, msg)
+        sig_py = ed25519.sign_py(seed, msg)
+        assert sig_fast == sig_py  # ed25519 signing is deterministic
+        assert ed25519.verify(ed25519.public_key(seed), msg, sig_fast)
+        assert ed25519.verify_py(ed25519.public_key(seed), msg, sig_fast)
+
+    def test_keys_api(self):
+        priv = gen_priv_key_ed25519(b"some-seed-material")
+        pub = priv.pub_key()
+        sig = priv.sign(b"hello")
+        assert pub.verify_bytes(b"hello", sig)
+        assert not pub.verify_bytes(b"goodbye", sig)
+        assert len(pub.address()) == 20
+        # deterministic address
+        assert gen_priv_key_ed25519(b"some-seed-material").pub_key().address() == pub.address()
+
+    def test_key_json_roundtrip(self):
+        priv = gen_priv_key_ed25519(b"json-seed")
+        assert PrivKeyEd25519.from_json(priv.to_json()) == priv
+        pub = priv.pub_key()
+        assert PubKeyEd25519.from_json(pub.to_json()) == pub
+        sig = priv.sign(b"m")
+        assert SignatureEd25519.from_json(sig.to_json()) == sig
+
+
+class TestHashing:
+    # Known-answer tests from the RIPEMD-160 paper (Bosselaers & Preneel)
+    KATS = [
+        (b"", "9c1185a5c5e9fc54612808977ee8f548b2258d31"),
+        (b"a", "0bdc9d2d256b3ee9daae347be6f4dc835a467ffe"),
+        (b"abc", "8eb208f7e05d987a9b044a8e98c6b087f15a0bfc"),
+        (b"message digest", "5d0689ef49d2fae572b881b123a85ffa21595f36"),
+        (
+            b"abcdefghijklmnopqrstuvwxyz",
+            "f71c27109c692c1b56bbdceb5b9d2865b3708dbc",
+        ),
+        (
+            b"1234567890" * 8,
+            "9b752e45573d4b39f4dbd3323cab82bf63326bfb",
+        ),
+    ]
+
+    @pytest.mark.parametrize("msg,digest", KATS)
+    def test_ripemd160_pure(self, msg, digest):
+        assert _ripemd160_py(msg).hex() == digest
+
+    @pytest.mark.parametrize("msg,digest", KATS)
+    def test_ripemd160_dispatch(self, msg, digest):
+        assert ripemd160(msg).hex() == digest
+
+    def test_ripemd160_long_input(self):
+        data = bytes(range(256)) * 300
+        assert _ripemd160_py(data) == ripemd160(data)
+
+    def test_sha256(self):
+        assert (
+            sha256(b"abc").hex()
+            == "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"
+        )
+
+
+class TestBinaryCodec:
+    def test_uvarint_spec_examples(self):
+        # from docs/specification/wire-protocol.rst
+        assert encode_uvarint(0) == bytes.fromhex("00")
+        assert encode_uvarint(1) == bytes.fromhex("0101")
+        assert encode_uvarint(2) == bytes.fromhex("0102")
+        assert encode_uvarint(256) == bytes.fromhex("020100")
+
+    def test_varint_spec_examples(self):
+        assert encode_varint(0) == bytes.fromhex("00")
+        assert encode_varint(1) == bytes.fromhex("0101")
+        assert encode_varint(-1) == bytes.fromhex("8101")
+        assert encode_varint(-2) == bytes.fromhex("8102")
+        assert encode_varint(-256) == bytes.fromhex("820100")
+
+    def test_struct_spec_example(self):
+        # Foo{"626172" (i.e. "bar"), MaxUint32} -> 0103626172FFFFFFFF
+        e = Encoder().write_string("bar").write_u32(0xFFFFFFFF)
+        assert e.buf().hex().upper() == "0103626172FFFFFFFF"
+
+    def test_roundtrip(self):
+        e = (
+            Encoder()
+            .write_varint(-12345)
+            .write_uvarint(98765)
+            .write_bytes(b"payload")
+            .write_string("hello")
+            .write_u64(2**63)
+            .write_i64(-42)
+            .write_time_ns(1500000000 * 10**9)
+            .write_list([1, 2, 3], lambda enc, x: enc.write_varint(x))
+        )
+        d = Decoder(e.buf())
+        assert d.read_varint() == -12345
+        assert d.read_uvarint() == 98765
+        assert d.read_bytes() == b"payload"
+        assert d.read_string() == "hello"
+        assert d.read_u64() == 2**63
+        assert d.read_i64() == -42
+        assert d.read_time_ns() == 1500000000 * 10**9
+        assert d.read_list(lambda dec: dec.read_varint()) == [1, 2, 3]
+        assert d.done()
+
+    def test_decode_truncated_raises(self):
+        with pytest.raises(ValueError):
+            Decoder(b"\x05ab").read_bytes()
+
+    def test_decode_rejects_non_canonical(self):
+        # negative zero
+        with pytest.raises(ValueError):
+            Decoder(b"\x80").read_varint()
+        # leading zero bodies
+        with pytest.raises(ValueError):
+            Decoder(b"\x02\x00\x01").read_varint()
+        with pytest.raises(ValueError):
+            Decoder(b"\x02\x00\x01").read_uvarint()
+
+
+class TestCanonicalJSON:
+    def test_deterministic_sorted_compact(self):
+        out = canonical_dumps({"b": 1, "a": {"d": 2, "c": b"\xab\xcd"}})
+        assert out == b'{"a":{"c":"ABCD","d":2},"b":1}'
+
+    def test_sign_bytes_shape(self):
+        # mirrors the docs' vote sign-bytes example shape
+        payload = {
+            "block_id": {
+                "hash": bytes.fromhex("611801F57B4CE378DF1A3FFF1216656E89209A99"),
+                "parts": {
+                    "hash": bytes.fromhex("B46697379DBE0774CC2C3B656083F07CA7E0F9CE"),
+                    "total": 123,
+                },
+            },
+            "height": 1234,
+            "round": 1,
+            "type": 2,
+        }
+        out = sign_bytes("my_chain", "vote", payload)
+        assert out.startswith(b'{"chain_id":"my_chain","vote":{"block_id"')
+        assert b'"height":1234' in out
+        assert out.index(b'"chain_id"') < out.index(b'"vote"')
+
+    def test_floats_rejected(self):
+        with pytest.raises(TypeError):
+            canonical_dumps({"x": 1.5})
+
+
+class TestMerkle:
+    def test_empty_and_single(self):
+        assert simple_hash_from_hashes([]) == b""
+        h = leaf_hash(b"item")
+        assert simple_hash_from_hashes([h]) == h
+
+    def test_left_heavy_split(self):
+        """With 3 leaves the split is 2|1 per the spec diagrams."""
+        hs = [leaf_hash(bytes([i])) for i in range(3)]
+        expected = inner_hash(inner_hash(hs[0], hs[1]), hs[2])
+        assert simple_hash_from_hashes(hs) == expected
+
+    def test_five_leaves_shape(self):
+        hs = [leaf_hash(bytes([i])) for i in range(5)]
+        # split 3|2; left splits 2|1; right splits 1|1
+        left = inner_hash(inner_hash(hs[0], hs[1]), hs[2])
+        right = inner_hash(hs[3], hs[4])
+        assert simple_hash_from_hashes(hs) == inner_hash(left, right)
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 6, 7, 8, 9, 16, 17, 100])
+    def test_proofs_verify(self, n):
+        items = [b"item-%d" % i for i in range(n)]
+        root, proofs = simple_proofs_from_byteslices(items)
+        assert root == simple_hash_from_byteslices(items)
+        for i, item in enumerate(items):
+            assert proofs[i].verify(i, n, leaf_hash(item), root)
+            # wrong index / wrong leaf fail
+            assert not proofs[i].verify((i + 1) % n, n, leaf_hash(item), root) or n == 1
+            assert not proofs[i].verify(i, n, leaf_hash(b"evil"), root)
+
+    def test_proof_json_roundtrip(self):
+        _, proofs = simple_proofs_from_byteslices([b"a", b"b", b"c"])
+        p = proofs[1]
+        assert SimpleProof.from_json(p.to_json()).aunts == p.aunts
+
+    def test_map_hash_order_independent(self):
+        a = simple_hash_from_map({"x": b"1", "y": b"2", "z": b"3"})
+        b = simple_hash_from_map({"z": b"3", "x": b"1", "y": b"2"})
+        assert a == b and len(a) == 20

@@ -1,0 +1,243 @@
+"""Tests for the runtime primitives (tmlibs equivalents)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.libs.autofile import Group
+from tendermint_tpu.libs.bitarray import BitArray
+from tendermint_tpu.libs.clist import CList
+from tendermint_tpu.libs.db import FileDB, MemDB
+from tendermint_tpu.libs.events import EventCache, EventSwitch
+from tendermint_tpu.libs.service import BaseService
+
+
+class TestBaseService:
+    def test_start_stop_idempotent(self):
+        events = []
+
+        class Svc(BaseService):
+            def on_start(self):
+                events.append("start")
+
+            def on_stop(self):
+                events.append("stop")
+
+        s = Svc()
+        assert s.start() is True
+        assert s.start() is False
+        assert s.is_running()
+        assert s.stop() is True
+        assert s.stop() is False
+        assert not s.is_running()
+        assert events == ["start", "stop"]
+
+    def test_wait_unblocks_on_stop(self):
+        s = BaseService()
+        s.start()
+        t = threading.Thread(target=lambda: (time.sleep(0.05), s.stop()))
+        t.start()
+        assert s.wait(timeout=2.0)
+        t.join()
+
+    def test_no_restart(self):
+        s = BaseService()
+        s.start()
+        s.stop()
+        with pytest.raises(RuntimeError):
+            s.start()
+
+
+class TestBitArray:
+    def test_basics(self):
+        ba = BitArray(10)
+        assert not ba.get_index(3)
+        assert ba.set_index(3, True)
+        assert ba.get_index(3)
+        assert not ba.set_index(10, True)  # out of range
+        assert ba.num_true_bits() == 1
+
+    def test_algebra(self):
+        a = BitArray.from_indices(8, [0, 1, 2])
+        b = BitArray.from_indices(8, [1, 2, 3])
+        assert a.or_(b).indices() == [0, 1, 2, 3]
+        assert a.and_(b).indices() == [1, 2]
+        assert a.sub(b).indices() == [0]
+        assert a.not_().indices() == [3, 4, 5, 6, 7]
+
+    def test_full_empty(self):
+        assert BitArray(0).is_empty()
+        full = BitArray.from_indices(3, [0, 1, 2])
+        assert full.is_full()
+        assert not BitArray.from_indices(3, [0]).is_full()
+
+    def test_pick_random(self):
+        ba = BitArray.from_indices(64, [5, 17])
+        seen = set()
+        for _ in range(100):
+            i, ok = ba.pick_random()
+            assert ok
+            seen.add(i)
+        assert seen == {5, 17}
+        _, ok = BitArray(4).pick_random()
+        assert not ok
+
+    def test_json_roundtrip(self):
+        ba = BitArray.from_indices(12, [0, 7, 11])
+        assert BitArray.from_json(ba.to_json()) == ba
+
+
+class TestCList:
+    def test_push_iterate(self):
+        cl = CList()
+        els = [cl.push_back(i) for i in range(5)]
+        assert [e.value for e in cl] == [0, 1, 2, 3, 4]
+        assert len(cl) == 5
+        cl.remove(els[2])
+        assert [e.value for e in cl] == [0, 1, 3, 4]
+        # removed element still navigates forward
+        assert els[2].next().value == 3
+
+    def test_front_wait_blocks_until_push(self):
+        cl = CList()
+        got = []
+
+        def consumer():
+            el = cl.front_wait(timeout=2.0)
+            got.append(el.value if el else None)
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.05)
+        cl.push_back("tx")
+        t.join()
+        assert got == ["tx"]
+
+    def test_next_wait(self):
+        cl = CList()
+        el = cl.push_back(1)
+        t = threading.Thread(target=lambda: (time.sleep(0.05), cl.push_back(2)))
+        t.start()
+        nxt = el.next_wait(timeout=2.0)
+        t.join()
+        assert nxt.value == 2
+
+
+class TestEvents:
+    def test_fire_and_remove(self):
+        sw = EventSwitch()
+        got = []
+        sw.add_listener_for_event("l1", "ev", lambda d: got.append(("l1", d)))
+        sw.add_listener_for_event("l2", "ev", lambda d: got.append(("l2", d)))
+        sw.fire_event("ev", 1)
+        assert sorted(got) == [("l1", 1), ("l2", 1)]
+        sw.remove_listener("l1")
+        got.clear()
+        sw.fire_event("ev", 2)
+        assert got == [("l2", 2)]
+
+    def test_cache_flush_order(self):
+        sw = EventSwitch()
+        got = []
+        sw.add_listener_for_event("l", "a", lambda d: got.append(("a", d)))
+        sw.add_listener_for_event("l", "b", lambda d: got.append(("b", d)))
+        cache = EventCache(sw)
+        cache.fire_event("a", 1)
+        cache.fire_event("b", 2)
+        assert got == []
+        cache.flush()
+        assert got == [("a", 1), ("b", 2)]
+        cache.flush()
+        assert got == [("a", 1), ("b", 2)]
+
+
+class TestDB:
+    def test_memdb(self):
+        db = MemDB()
+        db.set(b"k1", b"v1")
+        db.set(b"k2", b"v2")
+        assert db.get(b"k1") == b"v1"
+        assert db.get(b"missing") is None
+        db.delete(b"k1")
+        assert not db.has(b"k1")
+        assert list(db.iterate_prefix(b"k")) == [(b"k2", b"v2")]
+
+    def test_filedb_persistence(self, tmp_path):
+        path = str(tmp_path / "test.db")
+        db = FileDB(path)
+        db.set(b"a", b"1")
+        db.set_sync(b"b", b"2")
+        db.delete(b"a")
+        db.close()
+        db2 = FileDB(path)
+        assert db2.get(b"a") is None
+        assert db2.get(b"b") == b"2"
+        db2.close()
+
+    def test_filedb_torn_tail(self, tmp_path):
+        path = str(tmp_path / "torn.db")
+        db = FileDB(path)
+        db.set_sync(b"good", b"val")
+        db.close()
+        with open(path, "ab") as f:
+            f.write(b"\x01\x05\x00\x00")  # truncated record
+        db2 = FileDB(path)
+        assert db2.get(b"good") == b"val"
+        # writes after torn-tail recovery must survive ANOTHER restart
+        db2.set_sync(b"newkey", b"newval")
+        db2.close()
+        db3 = FileDB(path)
+        assert db3.get(b"newkey") == b"newval"
+        assert db3.get(b"good") == b"val"
+        assert len(db3._data) == 2
+        db3.close()
+
+    def test_filedb_compaction(self, tmp_path):
+        path = str(tmp_path / "compact.db")
+        db = FileDB(path, compact_threshold=2000)
+        for i in range(100):
+            db.set(b"key", str(i).encode() * 10)
+        db.close()
+        assert os.path.getsize(path) < 2000
+        db2 = FileDB(path)
+        assert db2.get(b"key") == b"99" * 10
+        db2.close()
+
+
+class TestAutofile:
+    def test_write_and_search(self, tmp_path):
+        g = Group(str(tmp_path / "wal"))
+        g.write_line("msg1")
+        g.write_line("#ENDHEIGHT: 1")
+        g.write_line("msg2")
+        g.write_line("msg3")
+        g.flush()
+        assert g.search_lines_after_marker("#ENDHEIGHT: 1") == ["msg2", "msg3"]
+        assert g.search_lines_after_marker("#ENDHEIGHT: 99") is None
+        g.close()
+
+    def test_rotation(self, tmp_path):
+        g = Group(str(tmp_path / "wal"), chunk_size=100)
+        for i in range(50):
+            g.write_line(f"line-{i:04d}")
+            g.flush()
+        assert g.read_all_lines() == [f"line-{i:04d}" for i in range(50)]
+        # marker search spans chunks
+        g.write_line("#M")
+        g.write_line("after")
+        g.flush()
+        assert g.search_lines_after_marker("#M") == ["after"]
+        g.close()
+
+    def test_reopen_appends(self, tmp_path):
+        path = str(tmp_path / "wal")
+        g = Group(path)
+        g.write_line("first")
+        g.close()
+        g2 = Group(path)
+        g2.write_line("second")
+        g2.flush()
+        assert g2.read_all_lines() == ["first", "second"]
+        g2.close()
