@@ -1,0 +1,60 @@
+"""Consensus data model (reference: types/ — SURVEY.md section 2.1).
+
+Blocks, votes, validator sets, part sets, transactions, proposals,
+genesis docs, the priv-validator signing guard, and the event taxonomy.
+Everything signed or hashed routes through codec.canonical / codec.binary
+so the CPU and TPU verification planes agree byte-for-byte.
+"""
+
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+from tendermint_tpu.types.part_set import Part, PartSet
+from tendermint_tpu.types.vote import (
+    ConflictingVotesError,
+    VOTE_TYPE_PRECOMMIT,
+    VOTE_TYPE_PREVOTE,
+    Vote,
+    VoteError,
+    is_vote_type_valid,
+)
+from tendermint_tpu.types.tx import Tx, TxProof, TxResult, txs_hash, txs_proof
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.block import Block, Commit, Data, Header
+from tendermint_tpu.types.vote_set import VoteSet
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.heartbeat import Heartbeat
+from tendermint_tpu.types.params import ConsensusParams
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.priv_validator import PrivValidator, PrivValidatorFS
+
+__all__ = [
+    "BlockID",
+    "PartSetHeader",
+    "Part",
+    "PartSet",
+    "Vote",
+    "VoteError",
+    "ConflictingVotesError",
+    "VOTE_TYPE_PREVOTE",
+    "VOTE_TYPE_PRECOMMIT",
+    "is_vote_type_valid",
+    "Tx",
+    "TxProof",
+    "TxResult",
+    "txs_hash",
+    "txs_proof",
+    "Validator",
+    "ValidatorSet",
+    "Block",
+    "Header",
+    "Data",
+    "Commit",
+    "VoteSet",
+    "Proposal",
+    "Heartbeat",
+    "ConsensusParams",
+    "GenesisDoc",
+    "GenesisValidator",
+    "PrivValidator",
+    "PrivValidatorFS",
+]

@@ -1,0 +1,83 @@
+"""GenesisDoc: chain bootstrap document (reference: types/genesis.go)."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from tendermint_tpu.crypto.keys import PubKeyEd25519
+from tendermint_tpu.types.params import ConsensusParams
+
+
+@dataclass
+class GenesisValidator:
+    pub_key: PubKeyEd25519
+    power: int
+    name: str = ""
+
+    def to_json(self):
+        return {"pub_key": self.pub_key.to_json(), "power": self.power, "name": self.name}
+
+    @classmethod
+    def from_json(cls, obj) -> "GenesisValidator":
+        return cls(PubKeyEd25519.from_json(obj["pub_key"]), obj["power"], obj.get("name", ""))
+
+
+@dataclass
+class GenesisDoc:
+    genesis_time_ns: int
+    chain_id: str
+    validators: list[GenesisValidator] = field(default_factory=list)
+    app_hash: bytes = b""
+    consensus_params: ConsensusParams = field(default_factory=ConsensusParams)
+
+    def validate_and_complete(self) -> None:
+        """types/genesis.go:55-84: ensure chain id, >=1 validator with
+        positive power, valid consensus params."""
+        if not self.chain_id:
+            raise ValueError("genesis doc must include non-empty chain_id")
+        err = self.consensus_params.validate()
+        if err:
+            raise ValueError(err)
+        if not self.validators:
+            raise ValueError("genesis doc must include at least one validator")
+        for v in self.validators:
+            if v.power <= 0:
+                raise ValueError(f"validator {v.name!r} has non-positive power")
+
+    def validator_hash(self) -> bytes:
+        from tendermint_tpu.types.validator import Validator
+        from tendermint_tpu.types.validator_set import ValidatorSet
+
+        vs = ValidatorSet([Validator.new(v.pub_key, v.power) for v in self.validators])
+        return vs.hash()
+
+    def to_json(self):
+        return {
+            "genesis_time": self.genesis_time_ns,
+            "chain_id": self.chain_id,
+            "validators": [v.to_json() for v in self.validators],
+            "app_hash": self.app_hash.hex().upper(),
+            "consensus_params": self.consensus_params.to_json(),
+        }
+
+    def save_as(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=2)
+
+    @classmethod
+    def from_json(cls, obj) -> "GenesisDoc":
+        doc = cls(
+            genesis_time_ns=obj.get("genesis_time", 0),
+            chain_id=obj["chain_id"],
+            validators=[GenesisValidator.from_json(v) for v in obj.get("validators", [])],
+            app_hash=bytes.fromhex(obj.get("app_hash", "")),
+            consensus_params=ConsensusParams.from_json(obj.get("consensus_params")),
+        )
+        doc.validate_and_complete()
+        return doc
+
+    @classmethod
+    def from_file(cls, path: str) -> "GenesisDoc":
+        with open(path) as f:
+            return cls.from_json(json.load(f))

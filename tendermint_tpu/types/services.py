@@ -1,0 +1,103 @@
+"""Abstract service interfaces shared across layers, with mocks for tests
+(reference: types/services.go)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+class MempoolI:
+    """types/services.go:21-35."""
+
+    def lock(self) -> None:
+        raise NotImplementedError
+
+    def unlock(self) -> None:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def check_tx(self, tx: bytes, cb: Callable | None = None):
+        raise NotImplementedError
+
+    def reap(self, max_txs: int) -> list[bytes]:
+        raise NotImplementedError
+
+    def update(self, height: int, txs: list[bytes]) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        raise NotImplementedError
+
+    def txs_available(self):
+        """Queue-like object signaling txs exist; None unless enabled."""
+        raise NotImplementedError
+
+    def enable_txs_available(self) -> None:
+        raise NotImplementedError
+
+
+class MockMempool(MempoolI):
+    """No-op mempool (types/services.go:37-48) — used by replay and tests."""
+
+    def __init__(self):
+        import queue
+
+        self._avail = queue.Queue()
+
+    def lock(self) -> None:
+        pass
+
+    def unlock(self) -> None:
+        pass
+
+    def size(self) -> int:
+        return 0
+
+    def check_tx(self, tx: bytes, cb: Callable | None = None):
+        return None
+
+    def reap(self, max_txs: int) -> list[bytes]:
+        return []
+
+    def update(self, height: int, txs: list[bytes]) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def txs_available(self):
+        return self._avail
+
+    def enable_txs_available(self) -> None:
+        pass
+
+
+class BlockStoreRPC:
+    """Read surface (types/services.go:55-64)."""
+
+    def height(self) -> int:
+        raise NotImplementedError
+
+    def load_block_meta(self, height: int):
+        raise NotImplementedError
+
+    def load_block(self, height: int):
+        raise NotImplementedError
+
+    def load_block_part(self, height: int, index: int):
+        raise NotImplementedError
+
+    def load_block_commit(self, height: int):
+        raise NotImplementedError
+
+    def load_seen_commit(self, height: int):
+        raise NotImplementedError
+
+
+class BlockStoreI(BlockStoreRPC):
+    """Full store (types/services.go:66-71)."""
+
+    def save_block(self, block, part_set, seen_commit) -> None:
+        raise NotImplementedError

@@ -1,0 +1,227 @@
+"""ValidatorSet: address-sorted validator set with weighted-round-robin
+proposer selection and commit verification (reference:
+types/validator_set.go).
+
+verify_commit is the HOTTEST path in the reference (sequential Ed25519
+verifies, types/validator_set.go:220-264; called from block validation at
+state/execution.go:198 and per fast-sync block at blockchain/reactor.go:235).
+Here it accepts a pluggable batch verifier so the whole commit's signatures
+flush to the TPU kernel in one batch while preserving the exact CPU
+accept/reject semantics.
+"""
+
+from __future__ import annotations
+
+import bisect
+
+from tendermint_tpu.merkle.simple import simple_hash_from_hashes
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT
+
+
+class CommitError(Exception):
+    pass
+
+
+class ValidatorSet:
+    def __init__(self, validators: list[Validator] | None):
+        vals = sorted((v.copy() for v in (validators or [])), key=lambda v: v.address)
+        self.validators: list[Validator] = vals
+        self.proposer: Validator | None = None
+        self._total_voting_power = 0
+        if validators:
+            self.increment_accum(1)
+
+    # -- lookups -----------------------------------------------------------
+
+    def _addresses(self) -> list[bytes]:
+        return [v.address for v in self.validators]
+
+    def has_address(self, address: bytes) -> bool:
+        i = bisect.bisect_left(self._addresses(), address)
+        return i < len(self.validators) and self.validators[i].address == address
+
+    def get_by_address(self, address: bytes) -> tuple[int, Validator | None]:
+        i = bisect.bisect_left(self._addresses(), address)
+        if i < len(self.validators) and self.validators[i].address == address:
+            return i, self.validators[i].copy()
+        return 0, None
+
+    def get_by_index(self, index: int) -> tuple[bytes, Validator | None]:
+        if index < 0 or index >= len(self.validators):
+            return b"", None
+        v = self.validators[index]
+        return v.address, v.copy()
+
+    def size(self) -> int:
+        return len(self.validators)
+
+    def total_voting_power(self) -> int:
+        if self._total_voting_power == 0:
+            self._total_voting_power = sum(v.voting_power for v in self.validators)
+        return self._total_voting_power
+
+    # -- proposer rotation -------------------------------------------------
+
+    def increment_accum(self, times: int) -> None:
+        """Each validator gains VotingPower*times accum; `times` times, the
+        richest validator is decremented by the total power; the last
+        decremented one becomes proposer (types/validator_set.go:52-69)."""
+        for v in self.validators:
+            v.accum += v.voting_power * times
+        for i in range(times):
+            mostest = None
+            for v in self.validators:
+                mostest = v.compare_accum(mostest)
+            assert mostest is not None
+            if i == times - 1:
+                self.proposer = mostest
+            mostest.accum -= self.total_voting_power()
+
+    def get_proposer(self) -> Validator | None:
+        if not self.validators:
+            return None
+        if self.proposer is None:
+            p = None
+            for v in self.validators:
+                p = v.compare_accum(p)
+            self.proposer = p
+        return self.proposer.copy()
+
+    # -- membership changes (applied from ABCI EndBlock diffs,
+    #    state/execution.go:120-159) --------------------------------------
+
+    def _invalidate(self) -> None:
+        self.proposer = None
+        self._total_voting_power = 0
+
+    def add(self, val: Validator) -> bool:
+        val = val.copy()
+        i = bisect.bisect_left(self._addresses(), val.address)
+        if i < len(self.validators) and self.validators[i].address == val.address:
+            return False
+        self.validators.insert(i, val)
+        self._invalidate()
+        return True
+
+    def update(self, val: Validator) -> bool:
+        i, existing = self.get_by_address(val.address)
+        if existing is None:
+            return False
+        self.validators[i] = val.copy()
+        self._invalidate()
+        return True
+
+    def remove(self, address: bytes) -> tuple[Validator | None, bool]:
+        i = bisect.bisect_left(self._addresses(), address)
+        if i >= len(self.validators) or self.validators[i].address != address:
+            return None, False
+        removed = self.validators.pop(i)
+        self._invalidate()
+        return removed, True
+
+    def copy(self) -> "ValidatorSet":
+        vs = ValidatorSet(None)
+        vs.validators = [v.copy() for v in self.validators]
+        vs.proposer = self.proposer.copy() if self.proposer else None
+        vs._total_voting_power = self._total_voting_power
+        return vs
+
+    def hash(self) -> bytes:
+        """Merkle root of validator identity hashes
+        (types/validator_set.go:140-148)."""
+        if not self.validators:
+            return b""
+        return simple_hash_from_hashes([v.hash() for v in self.validators])
+
+    # -- commit verification (TPU-batched hot path) ------------------------
+
+    def verify_commit(
+        self,
+        chain_id: str,
+        block_id: BlockID,
+        height: int,
+        commit,
+        batch_verifier=None,
+    ) -> None:
+        """Raise CommitError unless +2/3 of this set signed the commit
+        (types/validator_set.go:220-264 semantics, preserved exactly).
+
+        batch_verifier: callable(list[(pubkey32, msg, sig64)]) -> list[bool].
+        When given, all structural checks run first, then every signature in
+        the commit is verified in ONE batch (the TPU kernel); per-signature
+        results feed the same accept/reject logic the sequential loop has.
+        """
+        if self.size() != len(commit.precommits):
+            raise CommitError(
+                f"wrong set size: {self.size()} vs {len(commit.precommits)}"
+            )
+        if height != commit.height():
+            raise CommitError(f"wrong height: {height} vs {commit.height()}")
+
+        round_ = commit.round_()
+        # structural pass + signature item collection
+        items = []  # (idx, precommit, pubkey, sign_bytes, sig)
+        for idx, precommit in enumerate(commit.precommits):
+            if precommit is None:
+                continue  # validator skipped: fine
+            if precommit.height != height:
+                raise CommitError(f"wrong precommit height at {idx}")
+            if precommit.round_ != round_:
+                raise CommitError(f"wrong precommit round at {idx}")
+            if precommit.type_ != VOTE_TYPE_PRECOMMIT:
+                raise CommitError(f"not a precommit at index {idx}")
+            _, val = self.get_by_index(idx)
+            assert val is not None
+            if precommit.signature is None:
+                raise CommitError(f"missing signature at index {idx}")
+            items.append(
+                (idx, precommit, val, precommit.sign_bytes(chain_id), precommit.signature)
+            )
+
+        if batch_verifier is not None:
+            oks = batch_verifier(
+                [(val.pub_key.raw, sb, sig.raw) for _, _, val, sb, sig in items]
+            )
+        else:
+            oks = [
+                val.pub_key.verify_bytes(sb, sig) for _, _, val, sb, sig in items
+            ]
+
+        tallied = 0
+        for (idx, precommit, val, _, _), ok in zip(items, oks):
+            if not ok:
+                raise CommitError(f"invalid signature: {precommit!r}")
+            if block_id != precommit.block_id:
+                continue  # not an error, but doesn't count toward quorum
+            tallied += val.voting_power
+
+        if tallied <= self.total_voting_power() * 2 // 3:
+            raise CommitError(
+                f"insufficient voting power: got {tallied}, "
+                f"needed {self.total_voting_power() * 2 // 3 + 1}"
+            )
+
+    def to_json(self):
+        return {
+            "validators": [v.to_json() for v in self.validators],
+            "proposer": self.proposer.to_json() if self.proposer else None,
+        }
+
+    @classmethod
+    def from_json(cls, obj) -> "ValidatorSet":
+        vs = cls(None)
+        vs.validators = [Validator.from_json(v) for v in obj["validators"]]
+        if obj.get("proposer"):
+            p = Validator.from_json(obj["proposer"])
+            # alias the in-set object when present (the reference's heap holds
+            # pointers into the validator list)
+            vs.proposer = next(
+                (v for v in vs.validators if v.address == p.address), p
+            )
+        return vs
+
+    def __repr__(self):
+        prop = self.get_proposer()
+        return f"ValidatorSet{{n:{self.size()} proposer:{prop!r}}}"

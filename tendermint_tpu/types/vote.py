@@ -1,0 +1,148 @@
+"""Vote: a signed prevote/precommit (reference: types/vote.go).
+
+Sign-bytes are canonical JSON wrapped with the chain id, exactly the
+reference's CanonicalJSONOnceVote layout (types/canonical_json.go:27-33,
+52-55), so a vote's signed payload is reproducible byte-for-byte from its
+fields — the property the TPU batch verifier relies on.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+from tendermint_tpu.codec.binary import Decoder, Encoder
+from tendermint_tpu.codec.canonical import canonical_dumps
+from tendermint_tpu.crypto.keys import SignatureEd25519
+from tendermint_tpu.types.block_id import BlockID
+
+VOTE_TYPE_PREVOTE = 0x01
+VOTE_TYPE_PRECOMMIT = 0x02
+
+
+def is_vote_type_valid(t: int) -> bool:
+    return t in (VOTE_TYPE_PREVOTE, VOTE_TYPE_PRECOMMIT)
+
+
+class VoteError(Exception):
+    pass
+
+
+class UnexpectedStepError(VoteError):
+    pass
+
+
+class InvalidValidatorIndexError(VoteError):
+    pass
+
+
+class InvalidValidatorAddressError(VoteError):
+    pass
+
+
+class InvalidSignatureError(VoteError):
+    pass
+
+
+class ConflictingVotesError(VoteError):
+    def __init__(self, vote_a: "Vote", vote_b: "Vote"):
+        super().__init__("conflicting votes")
+        self.vote_a = vote_a
+        self.vote_b = vote_b
+
+
+@dataclass(frozen=True)
+class Vote:
+    validator_address: bytes
+    validator_index: int
+    height: int
+    round_: int
+    type_: int
+    block_id: BlockID
+    signature: SignatureEd25519 | None = None
+
+    def canonical(self) -> dict:
+        """CanonicalJSONVote field set — excludes the signature and the
+        validator identity (types/canonical_json.go:27-33)."""
+        return {
+            "block_id": self.block_id.canonical(),
+            "height": self.height,
+            "round": self.round_,
+            "type": self.type_,
+        }
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        return canonical_dumps({"chain_id": chain_id, "vote": self.canonical()})
+
+    def with_signature(self, sig: SignatureEd25519) -> "Vote":
+        return replace(self, signature=sig)
+
+    # -- binary (for commit hashing / wire / WAL) --------------------------
+
+    def encode(self, e: Encoder) -> None:
+        e.write_bytes(self.validator_address)
+        e.write_varint(self.validator_index)
+        e.write_varint(self.height)
+        e.write_varint(self.round_)
+        e.write_u8(self.type_)
+        self.block_id.encode(e)
+        if self.signature is None:
+            e.write_u8(0)
+        else:
+            e.write_raw(self.signature.bytes_())
+
+    def to_bytes(self) -> bytes:
+        e = Encoder()
+        self.encode(e)
+        return e.buf()
+
+    @classmethod
+    def decode(cls, d: Decoder) -> "Vote":
+        addr = d.read_bytes()
+        idx = d.read_varint()
+        height = d.read_varint()
+        rnd = d.read_varint()
+        typ = d.read_u8()
+        bid = BlockID.decode(d)
+        sig_type = d.read_u8()
+        sig = None
+        if sig_type == SignatureEd25519.TYPE:
+            sig = SignatureEd25519(d._take(64))
+        elif sig_type != 0:
+            raise ValueError(f"unknown signature type {sig_type}")
+        return cls(addr, idx, height, rnd, typ, bid, sig)
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "Vote":
+        return cls.decode(Decoder(b))
+
+    def to_json(self):
+        return {
+            "validator_address": self.validator_address.hex().upper(),
+            "validator_index": self.validator_index,
+            "height": self.height,
+            "round": self.round_,
+            "type": self.type_,
+            "block_id": self.block_id.to_json(),
+            "signature": self.signature.to_json() if self.signature else None,
+        }
+
+    @classmethod
+    def from_json(cls, obj) -> "Vote":
+        return cls(
+            bytes.fromhex(obj["validator_address"]),
+            obj["validator_index"],
+            obj["height"],
+            obj["round"],
+            obj["type"],
+            BlockID.from_json(obj["block_id"]),
+            SignatureEd25519.from_json(obj["signature"]) if obj["signature"] else None,
+        )
+
+    def __repr__(self):
+        t = {VOTE_TYPE_PREVOTE: "Prevote", VOTE_TYPE_PRECOMMIT: "Precommit"}.get(
+            self.type_, f"?{self.type_}"
+        )
+        return (
+            f"Vote{{{self.validator_index}:{self.validator_address.hex()[:8]} "
+            f"{self.height}/{self.round_:02d}/{t} {self.block_id.hash.hex()[:8]}}}"
+        )
