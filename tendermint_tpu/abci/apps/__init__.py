@@ -1,0 +1,9 @@
+"""Example ABCI applications (reference: the abci package's dummy /
+persistent_dummy / counter / nilapp, selected by name at
+proxy/client.go:64-76)."""
+
+from tendermint_tpu.abci.apps.kvstore import KVStoreApp, PersistentKVStoreApp
+from tendermint_tpu.abci.apps.counter import CounterApp
+from tendermint_tpu.abci.apps.nilapp import NilApp
+
+__all__ = ["KVStoreApp", "PersistentKVStoreApp", "CounterApp", "NilApp"]

@@ -1,0 +1,162 @@
+"""KVStore app — the reference's "dummy" Merkle key-value store, the app
+behind the 4-node testnet north star and most consensus tests
+(consensus/common_test.go:26-27).
+
+Txs are "key=value" (or raw bytes stored as key=key). The app hash is the
+Merkle root over sorted kv pairs, so all correct nodes agree on state.
+The persistent variant survives restarts (handshake/replay tests) and
+accepts validator-set change txs: "val:<pubkey_hex>/<power>" — the
+reference's persistent_dummy behavior.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from tendermint_tpu.abci.types import (
+    ABCIValidator,
+    Application,
+    CODE_OK,
+    CODE_UNAUTHORIZED,
+    Header,
+    ResponseCheckTx,
+    ResponseCommit,
+    ResponseDeliverTx,
+    ResponseEndBlock,
+    ResponseInfo,
+    ResponseQuery,
+)
+from tendermint_tpu.merkle.simple import simple_hash_from_map
+
+VAL_TX_PREFIX = b"val:"
+
+
+class KVStoreApp(Application):
+    def __init__(self):
+        self.state: dict[str, bytes] = {}
+        self.height = 0
+        self.app_hash = b""
+
+    def info(self) -> ResponseInfo:
+        return ResponseInfo(
+            data=f"{{\"size\":{len(self.state)}}}",
+            last_block_height=self.height,
+            last_block_app_hash=self.app_hash,
+        )
+
+    def check_tx(self, tx: bytes) -> ResponseCheckTx:
+        return ResponseCheckTx(code=CODE_OK)
+
+    def deliver_tx(self, tx: bytes) -> ResponseDeliverTx:
+        if b"=" in tx:
+            k, v = tx.split(b"=", 1)
+        else:
+            k, v = tx, tx
+        self.state[k.decode(errors="replace")] = v
+        return ResponseDeliverTx(code=CODE_OK)
+
+    def commit(self) -> ResponseCommit:
+        self.height += 1
+        self.app_hash = (
+            simple_hash_from_map(self.state) if self.state else b""
+        )
+        return ResponseCommit(code=CODE_OK, data=self.app_hash)
+
+    def query(self, data: bytes, path: str = "", height: int = 0, prove: bool = False) -> ResponseQuery:
+        key = data.decode(errors="replace")
+        value = self.state.get(key)
+        if value is None:
+            return ResponseQuery(code=CODE_OK, key=data, log="does not exist")
+        return ResponseQuery(code=CODE_OK, key=data, value=value, log="exists")
+
+
+class PersistentKVStoreApp(KVStoreApp):
+    """KVStore plus disk persistence and validator-set changes via
+    val-txs; the backbone of the crash-restart test tier
+    (test/persist/*.sh in the reference)."""
+
+    def __init__(self, db_dir: str):
+        super().__init__()
+        self.db_path = os.path.join(db_dir, "kvstore_app.json")
+        os.makedirs(db_dir, exist_ok=True)
+        self.val_diffs: list[ABCIValidator] = []
+        self.validators: dict[str, int] = {}  # pubkey hex -> power
+        self._load()
+
+    # -- persistence -------------------------------------------------------
+
+    def _load(self) -> None:
+        if not os.path.exists(self.db_path):
+            return
+        with open(self.db_path) as f:
+            obj = json.load(f)
+        self.height = obj["height"]
+        self.app_hash = bytes.fromhex(obj["app_hash"])
+        self.state = {k: bytes.fromhex(v) for k, v in obj["state"].items()}
+        self.validators = obj.get("validators", {})
+
+    def _save(self) -> None:
+        tmp = self.db_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "height": self.height,
+                    "app_hash": self.app_hash.hex(),
+                    "state": {k: v.hex() for k, v in self.state.items()},
+                    "validators": self.validators,
+                },
+                f,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.db_path)
+
+    # -- validator updates -------------------------------------------------
+
+    def init_chain(self, validators: list[ABCIValidator]) -> None:
+        for v in validators:
+            self.validators[v.pub_key_json[1]] = v.power
+
+    def begin_block(self, block_hash: bytes, header: Header) -> None:
+        self.val_diffs = []
+
+    def check_tx(self, tx: bytes) -> ResponseCheckTx:
+        if tx.startswith(VAL_TX_PREFIX):
+            err = self._parse_val_tx(tx) is None
+            if err:
+                return ResponseCheckTx(code=CODE_UNAUTHORIZED, log="bad val tx")
+        return ResponseCheckTx(code=CODE_OK)
+
+    def _parse_val_tx(self, tx: bytes):
+        try:
+            body = tx[len(VAL_TX_PREFIX) :].decode()
+            pubkey_hex, power_s = body.split("/")
+            bytes.fromhex(pubkey_hex)
+            return pubkey_hex.upper(), int(power_s)
+        except (ValueError, IndexError):
+            return None
+
+    def deliver_tx(self, tx: bytes) -> ResponseDeliverTx:
+        if tx.startswith(VAL_TX_PREFIX):
+            parsed = self._parse_val_tx(tx)
+            if parsed is None:
+                return ResponseDeliverTx(code=CODE_UNAUTHORIZED, log="bad val tx")
+            pubkey_hex, power = parsed
+            if power == 0:
+                self.validators.pop(pubkey_hex, None)
+            else:
+                self.validators[pubkey_hex] = power
+            from tendermint_tpu.crypto.keys import TYPE_ED25519
+
+            self.val_diffs.append(ABCIValidator([TYPE_ED25519, pubkey_hex], power))
+            return ResponseDeliverTx(code=CODE_OK)
+        return super().deliver_tx(tx)
+
+    def end_block(self, height: int) -> ResponseEndBlock:
+        return ResponseEndBlock(diffs=list(self.val_diffs))
+
+    def commit(self) -> ResponseCommit:
+        res = super().commit()
+        self._save()
+        return res

@@ -1,0 +1,8 @@
+"""NilApp: accepts everything, stores nothing (abci nilapp; reference
+proxy/client.go:75)."""
+
+from tendermint_tpu.abci.types import Application
+
+
+class NilApp(Application):
+    pass

@@ -1,0 +1,26 @@
+"""TPU data plane: batched crypto kernels in JAX.
+
+This is the layer the reference doesn't have (it is 100% Go; SURVEY.md §2):
+the embarrassingly-parallel crypto loops of the consensus hot path —
+
+- per-vote Ed25519 verification (types/vote_set.go:175)
+- VerifyCommit's sequential verify loop (types/validator_set.go:247-250)
+- fast-sync per-block commit verification (blockchain/reactor.go:235)
+- PartSet/tx-tree Merkle hashing (types/part_set.go:95, types/tx.go:33)
+
+— re-expressed as wide batches over TPU lanes:
+
+- `hashing`: RIPEMD-160 / SHA-256 compression functions in pure uint32
+  jnp ops, vectorized over messages, lax.scan over blocks.
+- `merkle`:  level-by-level tree hashing with host-computed structure.
+- `ed25519`: batched signature verification on limb-based GF(2^255-19)
+  arithmetic (radix 2^15, int32 lanes; no 64-bit ops needed).
+- `gateway`: the batching gateway the consensus layer talks to — flush
+  policies, CPU fallback below a batch-size threshold, byte-identical
+  semantics with the crypto package, and shard_map sharding over a
+  jax.sharding.Mesh for multi-chip scale.
+
+Everything is jittable with static shapes (bucketed padding), bfloat16-free
+(integer ops on the VPU), and designed for XLA fusion rather than
+hand-scheduling.
+"""

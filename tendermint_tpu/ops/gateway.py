@@ -1,0 +1,218 @@
+"""The batching gateway: where the host consensus plane meets the TPU
+data plane.
+
+The reference verifies signatures one at a time, inline, at three call
+sites (types/vote_set.go:175, types/validator_set.go:247,
+blockchain/reactor.go:235). Here those sites call a Verifier; the gateway
+decides per batch whether the TPU kernel or the CPU loop runs, with
+IDENTICAL accept/reject semantics (BASELINE.md north star: byte-identical
+behavior, CPU fallback below a size threshold).
+
+Policies:
+- batches below `min_tpu_batch` run on CPU (kernel launch + host marshal
+  overhead beats the win for small batches; single votes stay CPU);
+- TPU failures (no device, compile error) permanently fall back to CPU;
+- `mesh` sharding: on a multi-chip jax.sharding.Mesh the batch axis is
+  sharded across devices — pure data parallelism over independent
+  signatures, no collectives needed in the kernel itself.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+import numpy as np
+
+from tendermint_tpu.crypto import ed25519 as ed_cpu
+
+logger = logging.getLogger("ops.gateway")
+
+Item = tuple[bytes, bytes, bytes]  # (pubkey32, message, signature64)
+
+
+def _cpu_verify_batch(items: list[Item]) -> list[bool]:
+    return [ed_cpu.verify(pk, msg, sig) for pk, msg, sig in items]
+
+
+class Verifier:
+    """Batch signature verifier with TPU acceleration and CPU fallback."""
+
+    def __init__(self, min_tpu_batch: int = 32, use_tpu: bool | None = None):
+        if use_tpu is None:
+            use_tpu = os.environ.get("TENDERMINT_TPU_DISABLE", "") == ""
+        self.min_tpu_batch = min_tpu_batch
+        self._tpu_ok = use_tpu
+        self._mtx = threading.Lock()
+        self._stats = {"tpu_batches": 0, "tpu_sigs": 0, "cpu_sigs": 0}
+
+    # -- core API ----------------------------------------------------------
+
+    def verify_batch(self, items: list[Item]) -> list[bool]:
+        n = len(items)
+        if n == 0:
+            return []
+        if self._tpu_ok and n >= self.min_tpu_batch:
+            try:
+                import jax
+
+                if jax.devices()[0].platform == "tpu":
+                    # hand-written Pallas ladder: VMEM-resident limbs
+                    from tendermint_tpu.ops import ed25519_pallas as ops_ed
+                else:
+                    # XLA-composed variant (CPU/GPU backends, tests)
+                    from tendermint_tpu.ops import ed25519 as ops_ed
+
+                out = ops_ed.verify_batch(items)
+                with self._mtx:
+                    self._stats["tpu_batches"] += 1
+                    self._stats["tpu_sigs"] += n
+                return [bool(b) for b in out]
+            except Exception:
+                logger.exception("TPU verify failed; falling back to CPU")
+                self._tpu_ok = False
+        with self._mtx:
+            self._stats["cpu_sigs"] += n
+        return _cpu_verify_batch(items)
+
+    def verify_one(self, pubkey: bytes, msg: bytes, sig: bytes) -> bool:
+        """Single-signature path (vote-by-vote arrival): CPU — latency over
+        throughput. Exists so VoteSet can take one pluggable callable."""
+        with self._mtx:
+            self._stats["cpu_sigs"] += 1
+        return ed_cpu.verify(pubkey, msg, sig)
+
+    def stats(self) -> dict:
+        with self._mtx:
+            return dict(self._stats)
+
+    # -- adapters for the call sites --------------------------------------
+
+    def commit_batch_verifier(self):
+        """For ValidatorSet.verify_commit(batch_verifier=...)."""
+        return self.verify_batch
+
+    def vote_verifier(self):
+        """For VoteSet.add_vote(verifier=...)."""
+        return self.verify_one
+
+
+class ShardedVerifier(Verifier):
+    """Verifier whose kernel inputs are sharded over a device mesh along the
+    batch axis. Each chip verifies its slice; results gather to host. This
+    is how a 10k-validator commit rides a v5e pod slice: 10k lanes split
+    over N chips on ICI."""
+
+    def __init__(self, mesh, min_tpu_batch: int = 32):
+        super().__init__(min_tpu_batch=min_tpu_batch, use_tpu=True)
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as PS
+
+        from tendermint_tpu.ops import ed25519 as ops_ed
+
+        self.mesh = mesh
+        self._n_dev = mesh.size
+        batch_last = NamedSharding(mesh, PS(None, "batch"))
+        vec = NamedSharding(mesh, PS("batch"))
+        self._verify = jax.jit(
+            ops_ed._verify_impl,
+            in_shardings=(batch_last, batch_last, batch_last, vec, batch_last, batch_last),
+            out_shardings=vec,
+        )
+
+    def verify_batch(self, items: list[Item]) -> list[bool]:
+        n = len(items)
+        if n == 0:
+            return []
+        if not self._tpu_ok or n < self.min_tpu_batch:
+            return super().verify_batch(items)
+        try:
+            import jax.numpy as jnp
+
+            from tendermint_tpu.ops import ed25519 as ops_ed
+
+            # bucket so every device gets an equal, stable-shaped slice:
+            # power-of-two rounded up to a multiple of the mesh size
+            m = self._n_dev
+            bucket = ops_ed._next_pow2(max(n, m))
+            if bucket % m:
+                bucket = ((bucket + m - 1) // m) * m
+            ax, ay, ry, rs, s_bits, h_bits, valid = ops_ed.prepare_batch(items, bucket)
+            ok = self._verify(
+                jnp.asarray(ax), jnp.asarray(ay), jnp.asarray(ry),
+                jnp.asarray(rs), jnp.asarray(s_bits), jnp.asarray(h_bits),
+            )
+            with self._mtx:
+                self._stats["tpu_batches"] += 1
+                self._stats["tpu_sigs"] += n
+            return [bool(b) for b in (np.asarray(ok)[:n] & valid[:n])]
+        except Exception:
+            logger.exception("sharded TPU verify failed; falling back to CPU")
+            self._tpu_ok = False
+            return super().verify_batch(items)
+
+
+# -- merkle/hashing gateway --------------------------------------------------
+
+
+class Hasher:
+    """Batched hashing gateway for the PartSet/tx-tree hot paths."""
+
+    def __init__(self, min_tpu_batch: int = 16, use_tpu: bool | None = None):
+        if use_tpu is None:
+            use_tpu = os.environ.get("TENDERMINT_TPU_DISABLE", "") == ""
+        self.min_tpu_batch = min_tpu_batch
+        self._tpu_ok = use_tpu
+
+    def part_leaf_hashes(self, chunks: list[bytes]) -> list[bytes]:
+        """Part.Hash batch — for PartSet.from_data(hasher=...)."""
+        if self._tpu_ok and len(chunks) >= self.min_tpu_batch:
+            try:
+                from tendermint_tpu.ops import merkle as ops_merkle
+
+                return ops_merkle.part_leaf_hashes(chunks)
+            except Exception:
+                logger.exception("TPU part hashing failed; falling back to CPU")
+                self._tpu_ok = False
+        from tendermint_tpu.crypto.hashing import ripemd160
+
+        return [ripemd160(c) for c in chunks]
+
+    def tx_merkle_root(self, txs: list[bytes]) -> bytes:
+        if self._tpu_ok and len(txs) >= self.min_tpu_batch:
+            try:
+                from tendermint_tpu.ops import merkle as ops_merkle
+
+                return ops_merkle.merkle_root_from_leaf_digests(
+                    ops_merkle.leaf_hashes(txs)
+                )
+            except Exception:
+                logger.exception("TPU tx hashing failed; falling back to CPU")
+                self._tpu_ok = False
+        from tendermint_tpu.merkle.simple import simple_hash_from_byteslices
+
+        return simple_hash_from_byteslices(txs)
+
+
+# -- module-level default instances ------------------------------------------
+
+_default_verifier: Verifier | None = None
+_default_hasher: Hasher | None = None
+_default_mtx = threading.Lock()
+
+
+def default_verifier() -> Verifier:
+    global _default_verifier
+    with _default_mtx:
+        if _default_verifier is None:
+            _default_verifier = Verifier()
+        return _default_verifier
+
+
+def default_hasher() -> Hasher:
+    global _default_hasher
+    with _default_mtx:
+        if _default_hasher is None:
+            _default_hasher = Hasher()
+        return _default_hasher

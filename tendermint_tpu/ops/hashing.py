@@ -1,0 +1,213 @@
+"""Batched RIPEMD-160 and SHA-256 for TPU (pure jnp, uint32 lanes).
+
+Layout: a batch of messages is packed host-side (numpy) into a dense
+uint32 word tensor [batch, max_blocks, 16] plus a per-message block count.
+The compression function runs as a lax.scan over the block axis, vmapped
+implicitly by operating on the whole batch per step; messages shorter than
+max_blocks freeze their state via jnp.where masking, so ragged batches of
+similar sizes share one kernel launch. All ops are 32-bit integer adds,
+rotates, and bitwise logic — VPU work that XLA fuses into a handful of
+loops; there is no MXU component to hashing.
+
+Parity: digests are bit-identical to hashlib/crypto.hashing (tests
+cross-check against RIPEMD-160 KATs and random inputs).
+"""
+
+from __future__ import annotations
+
+import struct
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Host-side packing
+# ---------------------------------------------------------------------------
+
+
+def pack_messages(msgs: list[bytes], little_endian: bool, max_blocks: int | None = None):
+    """MD-pad each message and pack to (uint32[B, max_blocks, 16],
+    int32[B] block counts). LE for RIPEMD-160, BE for SHA-256."""
+    n = len(msgs)
+    padded = []
+    nblocks = np.empty(n, dtype=np.int32)
+    for i, m in enumerate(msgs):
+        bitlen = len(m) * 8
+        pad_len = (55 - len(m)) % 64
+        if little_endian:
+            p = m + b"\x80" + b"\x00" * pad_len + struct.pack("<Q", bitlen)
+        else:
+            p = m + b"\x80" + b"\x00" * pad_len + struct.pack(">Q", bitlen)
+        padded.append(p)
+        nblocks[i] = len(p) // 64
+    mb = max_blocks if max_blocks is not None else int(nblocks.max(initial=1))
+    words = np.zeros((n, mb, 16), dtype=np.uint32)
+    fmt = "<16I" if little_endian else ">16I"
+    for i, p in enumerate(padded):
+        for b in range(nblocks[i]):
+            words[i, b] = struct.unpack(fmt, p[b * 64 : (b + 1) * 64])
+    return words, nblocks
+
+
+# ---------------------------------------------------------------------------
+# RIPEMD-160 (constants match crypto/hashing.py; see that module for KATs)
+# ---------------------------------------------------------------------------
+
+from tendermint_tpu.crypto.hashing import _K1, _K2, _R1, _R2, _S1, _S2
+
+_INIT_RIPEMD = np.array(
+    [0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0], dtype=np.uint32
+)
+
+
+def _rol(x, n):
+    return (x << n) | (x >> (32 - n))
+
+
+def _f_ripemd(j, x, y, z):
+    if j == 0:
+        return x ^ y ^ z
+    if j == 1:
+        return (x & y) | (~x & z)
+    if j == 2:
+        return (x | ~y) ^ z
+    if j == 3:
+        return (x & z) | (y & ~z)
+    return x ^ (y | ~z)
+
+
+def _ripemd160_block(state, words):
+    """One compression step. state: (B,5) uint32; words: (B,16) uint32."""
+    h0, h1, h2, h3, h4 = [state[:, i] for i in range(5)]
+    a1, b1, c1, d1, e1 = h0, h1, h2, h3, h4
+    a2, b2, c2, d2, e2 = h0, h1, h2, h3, h4
+    for rnd in range(5):
+        k1 = jnp.uint32(_K1[rnd])
+        k2 = jnp.uint32(_K2[rnd])
+        for i in range(16):
+            t = a1 + _f_ripemd(rnd, b1, c1, d1) + words[:, _R1[rnd][i]] + k1
+            t = _rol(t, _S1[rnd][i]) + e1
+            a1, e1, d1, c1, b1 = e1, d1, _rol(c1, 10), b1, t
+            t = a2 + _f_ripemd(4 - rnd, b2, c2, d2) + words[:, _R2[rnd][i]] + k2
+            t = _rol(t, _S2[rnd][i]) + e2
+            a2, e2, d2, c2, b2 = e2, d2, _rol(c2, 10), b2, t
+    return jnp.stack(
+        [h1 + c1 + d2, h2 + d1 + e2, h3 + e1 + a2, h4 + a1 + b2, h0 + b1 + c2],
+        axis=1,
+    )
+
+
+@partial(jax.jit, static_argnames=())
+def ripemd160_words(words: jax.Array, nblocks: jax.Array) -> jax.Array:
+    """words: uint32[B, NB, 16]; nblocks: int32[B] -> digests uint32[B, 5]
+    (little-endian words)."""
+    B = words.shape[0]
+    init = jnp.broadcast_to(jnp.asarray(_INIT_RIPEMD), (B, 5))
+
+    def step(state, inp):
+        blk_idx, blk_words = inp
+        new_state = _ripemd160_block(state, blk_words)
+        active = (blk_idx < nblocks)[:, None]
+        return jnp.where(active, new_state, state), None
+
+    idxs = jnp.arange(words.shape[1], dtype=jnp.int32)
+    final, _ = jax.lax.scan(step, init, (idxs, jnp.swapaxes(words, 0, 1)))
+    return final
+
+
+def digests_to_bytes_le(digests: np.ndarray) -> list[bytes]:
+    d = np.asarray(digests, dtype="<u4")
+    return [d[i].tobytes() for i in range(d.shape[0])]
+
+
+def ripemd160_batch(msgs: list[bytes]) -> list[bytes]:
+    """Convenience host API: batch-hash arbitrary messages."""
+    if not msgs:
+        return []
+    words, nblocks = pack_messages(msgs, little_endian=True)
+    out = ripemd160_words(jnp.asarray(words), jnp.asarray(nblocks))
+    return digests_to_bytes_le(np.asarray(out))
+
+
+# ---------------------------------------------------------------------------
+# SHA-256
+# ---------------------------------------------------------------------------
+
+_SHA_K = np.array(
+    [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+        0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+        0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+        0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+        0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+        0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ],
+    dtype=np.uint32,
+)
+
+_INIT_SHA = np.array(
+    [0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+     0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19],
+    dtype=np.uint32,
+)
+
+
+def _ror(x, n):
+    return (x >> n) | (x << (32 - n))
+
+
+def _sha256_block(state, words):
+    """state: (B,8); words: (B,16) big-endian-packed."""
+    w = [words[:, i] for i in range(16)]
+    for i in range(16, 64):
+        s0 = _ror(w[i - 15], 7) ^ _ror(w[i - 15], 18) ^ (w[i - 15] >> 3)
+        s1 = _ror(w[i - 2], 17) ^ _ror(w[i - 2], 19) ^ (w[i - 2] >> 10)
+        w.append(w[i - 16] + s0 + w[i - 7] + s1)
+    a, b, c, d, e, f, g, h = [state[:, i] for i in range(8)]
+    for i in range(64):
+        s1 = _ror(e, 6) ^ _ror(e, 11) ^ _ror(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + jnp.uint32(_SHA_K[i]) + w[i]
+        s0 = _ror(a, 2) ^ _ror(a, 13) ^ _ror(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+    new = jnp.stack([a, b, c, d, e, f, g, h], axis=1)
+    return state + new
+
+
+@jax.jit
+def sha256_words(words: jax.Array, nblocks: jax.Array) -> jax.Array:
+    """words: uint32[B, NB, 16] (big-endian packing); -> uint32[B, 8]."""
+    B = words.shape[0]
+    init = jnp.broadcast_to(jnp.asarray(_INIT_SHA), (B, 8))
+
+    def step(state, inp):
+        blk_idx, blk_words = inp
+        new_state = _sha256_block(state, blk_words)
+        active = (blk_idx < nblocks)[:, None]
+        return jnp.where(active, new_state, state), None
+
+    idxs = jnp.arange(words.shape[1], dtype=jnp.int32)
+    final, _ = jax.lax.scan(step, init, (idxs, jnp.swapaxes(words, 0, 1)))
+    return final
+
+
+def digests_to_bytes_be(digests: np.ndarray) -> list[bytes]:
+    d = np.asarray(digests, dtype=np.uint32).astype(">u4")
+    return [d[i].tobytes() for i in range(d.shape[0])]
+
+
+def sha256_batch(msgs: list[bytes]) -> list[bytes]:
+    if not msgs:
+        return []
+    words, nblocks = pack_messages(msgs, little_endian=False)
+    out = sha256_words(jnp.asarray(words), jnp.asarray(nblocks))
+    return digests_to_bytes_be(np.asarray(out))

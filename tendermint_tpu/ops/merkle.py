@@ -1,0 +1,244 @@
+"""Vectorized simple-Merkle-tree hashing for TPU.
+
+Replaces the reference's sequential tree loops (types/part_set.go:95-122
+NewPartSetFromData, types/tx.go:33-46 Txs.Hash) with level-parallel batched
+RIPEMD-160:
+
+1. Host computes the tree SHAPE only — the recursive (n+1)//2 split of
+   merkle/simple.py — as a dense schedule of (left, right, out) node-slot
+   triples grouped into dependency rounds (depth levels). The schedule
+   depends only on n and is lru-cached per exact leaf count (leaves cannot
+   be padded: the tree over the first n leaves of a padded set is a
+   different tree). Part-set sizes repeat heavily so the cache hits;
+   _run_tree jit-specializes on (slots, n_rounds) which collide often.
+2. TPU holds a node-slot buffer of 20-byte digests as uint32[slots, 5] and,
+   per round, gathers children, assembles the 44-byte inner-node preimage
+   (length-prefixed left || length-prefixed right — matching
+   merkle.simple.inner_hash exactly) entirely with integer shifts, and runs
+   one batched compression.
+
+The returned node buffer also yields every internal node, so SimpleProof
+aunts come for free without extra hashing (used by PartSet.from_data).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tendermint_tpu.ops.hashing import (
+    _INIT_RIPEMD,
+    _ripemd160_block,
+    digests_to_bytes_le,
+    pack_messages,
+    ripemd160_words,
+)
+
+# ---------------------------------------------------------------------------
+# Host: tree schedule
+# ---------------------------------------------------------------------------
+
+
+class _TreeSchedule:
+    __slots__ = ("n", "slots", "rounds", "root_slot", "combines")
+
+    def __init__(self, n: int):
+        """Build the combine schedule for n leaves (slots 0..n-1 = leaves).
+        combines: list of (left, right, out); rounds: list of index ranges
+        into combines, grouped by dependency depth."""
+        self.n = n
+        next_slot = n
+        combines: list[tuple[int, int, int]] = []
+        depths: list[int] = []
+
+        def build(lo: int, hi: int) -> tuple[int, int]:
+            """Return (slot, depth) of subtree over leaves [lo, hi)."""
+            nonlocal next_slot
+            count = hi - lo
+            if count == 1:
+                return lo, 0
+            mid = lo + (count + 1) // 2
+            ls, ld = build(lo, mid)
+            rs, rd = build(mid, hi)
+            out = next_slot
+            next_slot += 1
+            combines.append((ls, rs, out))
+            depths.append(max(ld, rd) + 1)
+            return out, max(ld, rd) + 1
+
+        if n == 0:
+            self.slots = 0
+            self.rounds = []
+            self.root_slot = -1
+            self.combines = []
+            return
+        root, _ = build(0, n)
+        self.slots = next_slot
+        self.root_slot = root
+        # group by depth
+        order = sorted(range(len(combines)), key=lambda i: depths[i])
+        self.combines = [combines[i] for i in order]
+        self.rounds = []
+        i = 0
+        while i < len(order):
+            d = depths[order[i]]
+            j = i
+            while j < len(order) and depths[order[j]] == d:
+                j += 1
+            self.rounds.append((i, j))
+            i = j
+
+
+@lru_cache(maxsize=64)
+def _dense_schedule(n_bucket: int):
+    """Dense schedule arrays for one exact leaf count:
+    left/right/out: int32[max_rounds, max_width]; counts: int32[max_rounds].
+    Entries beyond a round's count are no-ops (combine slot 0,0 -> scratch).
+    Returns (left, right, out, scratch_slot, total_slots, py_schedule)."""
+    sched = _TreeSchedule(n_bucket)
+    max_width = max((j - i for i, j in sched.rounds), default=0)
+    n_rounds = len(sched.rounds)
+    scratch = sched.slots  # one extra slot absorbs no-op writes
+    left = np.zeros((n_rounds, max_width), dtype=np.int32)
+    right = np.zeros((n_rounds, max_width), dtype=np.int32)
+    out = np.full((n_rounds, max_width), scratch, dtype=np.int32)
+    for r, (i, j) in enumerate(sched.rounds):
+        for k, (ls, rs, os_) in enumerate(sched.combines[i:j]):
+            left[r, k] = ls
+            right[r, k] = rs
+            out[r, k] = os_
+    return left, right, out, scratch, sched.slots + 1, sched
+
+
+# ---------------------------------------------------------------------------
+# TPU: inner-node preimage assembly + per-round hashing
+# ---------------------------------------------------------------------------
+
+# 44-byte preimage: 0x01 0x14 | left(20) | 0x01 0x14 | right(20), then MD
+# padding: 0x80 at byte 44, zeros, bit length 352 in LE at bytes 56..63.
+
+
+def _bytes_from_words(w: jax.Array) -> jax.Array:
+    """uint32[B,5] -> uint32[B,20] byte values (LE)."""
+    shifts = jnp.arange(4, dtype=jnp.uint32) * 8
+    b = (w[:, :, None] >> shifts[None, None, :]) & jnp.uint32(0xFF)
+    return b.reshape(w.shape[0], 20)
+
+
+def _inner_preimage_words(left: jax.Array, right: jax.Array) -> jax.Array:
+    """left/right digests uint32[B,5] -> one padded block uint32[B,16]."""
+    B = left.shape[0]
+    lb = _bytes_from_words(left)
+    rb = _bytes_from_words(right)
+    buf = jnp.zeros((B, 64), dtype=jnp.uint32)
+    pre = jnp.uint32(0x01), jnp.uint32(0x14)
+    buf = buf.at[:, 0].set(pre[0]).at[:, 1].set(pre[1])
+    buf = jax.lax.dynamic_update_slice(buf, lb, (0, 2))
+    buf = buf.at[:, 22].set(pre[0]).at[:, 23].set(pre[1])
+    buf = jax.lax.dynamic_update_slice(buf, rb, (0, 24))
+    buf = buf.at[:, 44].set(jnp.uint32(0x80))
+    buf = buf.at[:, 56].set(jnp.uint32(0x60)).at[:, 57].set(jnp.uint32(0x01))
+    shifts = jnp.arange(4, dtype=jnp.uint32) * 8
+    words = (buf.reshape(B, 16, 4) << shifts[None, None, :]).sum(
+        axis=2, dtype=jnp.uint32
+    )
+    return words
+
+
+def _inner_hash_batch(left: jax.Array, right: jax.Array) -> jax.Array:
+    """Batched inner_hash on digests uint32[B,5] -> uint32[B,5]."""
+    words = _inner_preimage_words(left, right)
+    init = jnp.broadcast_to(jnp.asarray(_INIT_RIPEMD), (left.shape[0], 5))
+    return _ripemd160_block(init, words)
+
+
+@partial(jax.jit, static_argnames=("n_rounds",))
+def _run_tree(nodes: jax.Array, left: jax.Array, right: jax.Array, out: jax.Array,
+              n_rounds: int) -> jax.Array:
+    """nodes: uint32[slots,5] with leaves filled; returns all slots filled."""
+
+    def round_body(r, nodes):
+        l = nodes[left[r]]
+        rt = nodes[right[r]]
+        h = _inner_hash_batch(l, rt)
+        return nodes.at[out[r]].set(h)
+
+    return jax.lax.fori_loop(0, n_rounds, round_body, nodes)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+def tree_hash_from_leaf_digests(digests: list[bytes]) -> tuple[bytes, list[list[bytes]]]:
+    """Root + per-leaf aunt lists (bottom-up order) from 20-byte leaf
+    digests. TPU does all hashing; host assembles proofs from the node
+    buffer. Mirrors merkle.simple.simple_proofs_from_hashes output."""
+    n = len(digests)
+    if n == 0:
+        return b"", []
+    if n == 1:
+        return digests[0], [[]]
+    left, right, out, scratch, slots, sched = _dense_schedule(n)
+    nodes_np = np.zeros((slots, 5), dtype=np.uint32)
+    for i, d in enumerate(digests):
+        nodes_np[i] = np.frombuffer(d, dtype="<u4")
+    nodes = _run_tree(
+        jnp.asarray(nodes_np), jnp.asarray(left), jnp.asarray(right),
+        jnp.asarray(out), len(sched.rounds),
+    )
+    nodes_host = np.asarray(nodes)
+    all_hashes = digests_to_bytes_le(nodes_host)
+    root = all_hashes[sched.root_slot]
+
+    # host-side proof assembly: walk the recursion again (shape-only)
+    aunts: list[list[bytes]] = [[] for _ in range(n)]
+    combine_map = {(ls, rs): o for ls, rs, o in sched.combines}
+
+    def walk(lo: int, hi: int) -> int:
+        count = hi - lo
+        if count == 1:
+            return lo
+        mid = lo + (count + 1) // 2
+        ls = walk(lo, mid)
+        rs = walk(mid, hi)
+        for i in range(lo, mid):
+            aunts[i].append(all_hashes[rs])
+        for i in range(mid, hi):
+            aunts[i].append(all_hashes[ls])
+        return combine_map[(ls, rs)]
+
+    walk(0, n)
+    return root, aunts
+
+
+def merkle_root_from_leaf_digests(digests: list[bytes]) -> bytes:
+    root, _ = tree_hash_from_leaf_digests(digests)
+    return root
+
+
+def part_leaf_hashes(chunks: list[bytes]) -> list[bytes]:
+    """Batched Part.Hash: raw ripemd160 over each chunk (the per-64KB-part
+    hashing hot path, types/part_set.go:32-41)."""
+    if not chunks:
+        return []
+    words, nblocks = pack_messages(chunks, little_endian=True)
+    out = ripemd160_words(jnp.asarray(words), jnp.asarray(nblocks))
+    return digests_to_bytes_le(np.asarray(out))
+
+
+def leaf_hashes(items: list[bytes]) -> list[bytes]:
+    """Batched merkle.simple.leaf_hash: ripemd160 of length-prefixed items
+    (tx leaves, commit vote leaves)."""
+    from tendermint_tpu.codec.binary import encode_bytes
+
+    if not items:
+        return []
+    msgs = [encode_bytes(it) for it in items]
+    words, nblocks = pack_messages(msgs, little_endian=True)
+    out = ripemd160_words(jnp.asarray(words), jnp.asarray(nblocks))
+    return digests_to_bytes_le(np.asarray(out))

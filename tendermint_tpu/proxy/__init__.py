@@ -1,0 +1,23 @@
+from tendermint_tpu.proxy.app_conn import (
+    AppConnConsensus,
+    AppConnMempool,
+    AppConnQuery,
+)
+from tendermint_tpu.proxy.client_creator import (
+    ClientCreator,
+    LocalClientCreator,
+    RemoteClientCreator,
+    default_client_creator,
+)
+from tendermint_tpu.proxy.multi_app_conn import AppConns
+
+__all__ = [
+    "AppConnConsensus",
+    "AppConnMempool",
+    "AppConnQuery",
+    "ClientCreator",
+    "LocalClientCreator",
+    "RemoteClientCreator",
+    "default_client_creator",
+    "AppConns",
+]

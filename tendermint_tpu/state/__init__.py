@@ -1,0 +1,14 @@
+from tendermint_tpu.state.state import ABCIResponses, State
+from tendermint_tpu.state.execution import (
+    apply_block,
+    exec_commit_block,
+    validate_block,
+)
+
+__all__ = [
+    "State",
+    "ABCIResponses",
+    "apply_block",
+    "exec_commit_block",
+    "validate_block",
+]

@@ -1,0 +1,33 @@
+"""Crash-injection points (reference dep: ebuchman/fail-test; call sites at
+state/execution.go:224-243 and consensus/state.go:1284-1345, driven by
+FAIL_TEST_INDEX in test/persist/test_failure_indices.sh).
+
+When FAIL_TEST_INDEX=i is set, the i-th fail point hit in this process
+aborts hard (os._exit) — simulating a power failure at exactly that
+point for the crash-recovery test tier."""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_counter = 0
+_mtx = threading.Lock()
+
+
+def fail_point() -> None:
+    target = os.environ.get("FAIL_TEST_INDEX")
+    if target is None:
+        return
+    global _counter
+    with _mtx:
+        idx = _counter
+        _counter += 1
+    if idx == int(target):
+        os._exit(99)
+
+
+def reset() -> None:
+    global _counter
+    with _mtx:
+        _counter = 0

@@ -1,0 +1,60 @@
+"""Transaction indexing (reference: state/txindex/ — interface, KV impl
+keyed by tx hash, and null impl)."""
+
+from __future__ import annotations
+
+import json
+
+from tendermint_tpu.libs.db import DB
+from tendermint_tpu.types.tx import TxResult, tx_hash
+
+
+class Batch:
+    def __init__(self):
+        self.ops: list[TxResult] = []
+
+    def add(self, result: TxResult) -> None:
+        self.ops.append(result)
+
+
+class TxIndexer:
+    def add_batch(self, batch: Batch) -> None:
+        raise NotImplementedError
+
+    def get(self, h: bytes) -> TxResult | None:
+        raise NotImplementedError
+
+
+class NullTxIndexer(TxIndexer):
+    """state/txindex/null: stores nothing."""
+
+    def add_batch(self, batch: Batch) -> None:
+        pass
+
+    def get(self, h: bytes) -> TxResult | None:
+        return None
+
+
+class KVTxIndexer(TxIndexer):
+    """state/txindex/kv: tx-hash -> TxResult in a KV store."""
+
+    def __init__(self, db: DB):
+        self.db = db
+
+    def add_batch(self, batch: Batch) -> None:
+        for result in batch.ops:
+            self.db.set(tx_hash(result.tx), json.dumps(result.to_json()).encode())
+
+    def get(self, h: bytes) -> TxResult | None:
+        from tendermint_tpu.abci.types import ResponseDeliverTx
+
+        buf = self.db.get(h)
+        if buf is None:
+            return None
+        obj = json.loads(buf)
+        return TxResult(
+            height=obj["height"],
+            index=obj["index"],
+            tx=bytes.fromhex(obj["tx"]),
+            result=ResponseDeliverTx.from_json(obj["result"]) if obj["result"] else None,
+        )

@@ -13,3 +13,22 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The ed25519 ladder programs take minutes to compile on the CPU backend;
+# persist compiled artifacts across test runs.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+
+# The environment's TPU-tunnel plugin re-forces jax_platforms="axon,cpu" at
+# interpreter startup, overriding the JAX_PLATFORMS env var — which makes
+# every jax.devices() call dial the TPU even in CPU-only tests (and hang
+# hard if the tunnel is unavailable). Win the override war: the config
+# update below happens before any backend is initialized.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    # CPU-backend persistent caching needs the XLA-level caches enabled too
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+except Exception:
+    pass
