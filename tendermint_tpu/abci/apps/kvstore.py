@@ -53,7 +53,9 @@ class KVStoreApp(Application):
             k, v = tx.split(b"=", 1)
         else:
             k, v = tx, tx
-        self.state[k.decode(errors="replace")] = v
+        # latin-1 is a lossless byte<->str bijection: distinct byte keys
+        # stay distinct (the reference dummy app keys on raw bytes)
+        self.state[k.decode("latin-1")] = v
         return ResponseDeliverTx(code=CODE_OK)
 
     def commit(self) -> ResponseCommit:
@@ -64,7 +66,7 @@ class KVStoreApp(Application):
         return ResponseCommit(code=CODE_OK, data=self.app_hash)
 
     def query(self, data: bytes, path: str = "", height: int = 0, prove: bool = False) -> ResponseQuery:
-        key = data.decode(errors="replace")
+        key = data.decode("latin-1")
         value = self.state.get(key)
         if value is None:
             return ResponseQuery(code=CODE_OK, key=data, log="does not exist")

@@ -275,7 +275,9 @@ class SocketClient(ABCIClient):
                     tx_hex = obj.get("_tx")
                     tx = bytes.fromhex(tx_hex) if tx_hex else None
                     self._res_cb(rr.req_type, tx, res)
-        except (OSError, json.JSONDecodeError, IndexError) as e:
+        except Exception as e:
+            # any decode/callback failure must surface via error(), not
+            # silently kill the receive thread and strand pending waiters
             self._err = e
 
     @staticmethod

@@ -1,0 +1,3 @@
+from tendermint_tpu.blockchain.store import BlockStore
+
+__all__ = ["BlockStore"]

@@ -1,0 +1,116 @@
+"""Persistent block store (reference: blockchain/store.go).
+
+Per height: BlockMeta, the block's parts (so gossip can serve individual
+parts without reassembly), the block's LastCommit under height-1 ("C:"),
+and the SeenCommit — the +2/3 precommits actually observed, which may be
+for a different round than the canonical LastCommit ("SC:",
+blockchain/store.go:34-38). A height watermark JSON is written LAST so a
+crash mid-save leaves the previous height authoritative
+(blockchain/store.go:217-240).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from tendermint_tpu.libs.db import DB
+from tendermint_tpu.types import Block, Commit, Part, PartSet
+from tendermint_tpu.types.block_meta import BlockMeta
+
+_STORE_KEY = b"blockStore"
+
+
+def _meta_key(height: int) -> bytes:
+    return b"H:%d" % height
+
+
+def _part_key(height: int, index: int) -> bytes:
+    return b"P:%d:%d" % (height, index)
+
+
+def _commit_key(height: int) -> bytes:
+    return b"C:%d" % height
+
+
+def _seen_commit_key(height: int) -> bytes:
+    return b"SC:%d" % height
+
+
+class BlockStore:
+    def __init__(self, db: DB):
+        self.db = db
+        self._mtx = threading.Lock()
+        self._height = 0
+        buf = db.get(_STORE_KEY)
+        if buf:
+            self._height = json.loads(buf)["height"]
+
+    def height(self) -> int:
+        with self._mtx:
+            return self._height
+
+    # -- loads -------------------------------------------------------------
+
+    def _get_json(self, key: bytes):
+        buf = self.db.get(key)
+        return json.loads(buf) if buf else None
+
+    def load_block_meta(self, height: int) -> BlockMeta | None:
+        obj = self._get_json(_meta_key(height))
+        return BlockMeta.from_json(obj) if obj else None
+
+    def load_block_part(self, height: int, index: int) -> Part | None:
+        obj = self._get_json(_part_key(height, index))
+        return Part.from_json(obj) if obj else None
+
+    def load_block(self, height: int) -> Block | None:
+        """Reassemble from parts (blockchain/store.go:60-81)."""
+        meta = self.load_block_meta(height)
+        if meta is None:
+            return None
+        data = b""
+        for i in range(meta.block_id.parts_header.total):
+            part = self.load_block_part(height, i)
+            if part is None:
+                return None
+            data += part.bytes_
+        return Block.from_bytes(data)
+
+    def load_block_commit(self, height: int) -> Commit | None:
+        """The canonical commit for `height`, i.e. block height+1's
+        LastCommit (blockchain/store.go:102-110)."""
+        obj = self._get_json(_commit_key(height))
+        return Commit.from_json(obj) if obj else None
+
+    def load_seen_commit(self, height: int) -> Commit | None:
+        obj = self._get_json(_seen_commit_key(height))
+        return Commit.from_json(obj) if obj else None
+
+    # -- save --------------------------------------------------------------
+
+    def save_block(self, block: Block, block_parts: PartSet, seen_commit: Commit) -> None:
+        """blockchain/store.go:147-172. Height watermark is flushed sync,
+        last."""
+        height = block.header.height
+        if height != self.height() + 1:
+            raise ValueError(f"BlockStore can only save contiguous blocks. Wanted {self.height() + 1}, got {height}")
+        if not block_parts.is_complete():
+            raise ValueError("BlockStore can only save complete block part sets")
+
+        meta = BlockMeta.from_block(block, block_parts)
+        self.db.set(_meta_key(height), json.dumps(meta.to_json(), sort_keys=True).encode())
+        for i in range(block_parts.total):
+            part = block_parts.get_part(i)
+            self.db.set(_part_key(height, i), json.dumps(part.to_json(), sort_keys=True).encode())
+        self.db.set(
+            _commit_key(height - 1),
+            json.dumps(block.last_commit.to_json(), sort_keys=True).encode(),
+        )
+        self.db.set(
+            _seen_commit_key(height),
+            json.dumps(seen_commit.to_json(), sort_keys=True).encode(),
+        )
+        with self._mtx:
+            self._height = height
+        self.db.set_sync(_STORE_KEY, json.dumps({"height": height}).encode())

@@ -1,0 +1,203 @@
+"""Typed configuration tree (reference: config/config.go).
+
+One Config struct per subsystem — Base, RPC, P2P, Mempool, Consensus —
+with defaults mirroring the reference's (config/config.go:10-19 structs,
+367-385 consensus timeout schedule) and faster "test" presets. Consensus-
+critical parameters (block size limits etc.) do NOT live here; they travel
+in the genesis doc (types/params.py), exactly as in the reference.
+
+Durations are seconds as floats (the reference uses milliseconds — values
+converted, not renamed). Timeouts follow the reference's linear round
+schedule: timeout_X + round * timeout_X_delta (config/config.go:338-357).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, replace
+
+
+@dataclass
+class BaseConfig:
+    """Top-level node options (config/config.go:57-135)."""
+
+    root_dir: str = ""
+    chain_id: str = ""
+    genesis: str = "genesis.json"
+    priv_validator: str = "priv_validator.json"
+    moniker: str = "anonymous"
+    proxy_app: str = "tcp://127.0.0.1:46658"
+    abci: str = "socket"  # socket | grpc (in-proc apps use names: kvstore, ...)
+    log_level: str = "info"
+    prof_laddr: str = ""
+    fast_sync: bool = True
+    filter_peers: bool = False
+    tx_index: str = "kv"  # kv | null
+    db_backend: str = "memdb"  # memdb | filedb
+    db_path: str = "data"
+
+    def genesis_file(self) -> str:
+        return _root_join(self.root_dir, self.genesis)
+
+    def priv_validator_file(self) -> str:
+        return _root_join(self.root_dir, self.priv_validator)
+
+    def db_dir(self) -> str:
+        return _root_join(self.root_dir, self.db_path)
+
+
+@dataclass
+class RPCConfig:
+    """RPC server options (config/config.go:163-193)."""
+
+    root_dir: str = ""
+    laddr: str = "tcp://0.0.0.0:46657"
+    grpc_laddr: str = ""
+    unsafe: bool = False
+
+
+@dataclass
+class P2PConfig:
+    """Peer-to-peer options (config/config.go:199-253)."""
+
+    root_dir: str = ""
+    laddr: str = "tcp://0.0.0.0:46656"
+    seeds: str = ""  # comma-separated host:port
+    skip_upnp: bool = False
+    addr_book_file: str = "addrbook.json"
+    addr_book_strict: bool = True
+    pex_reactor: bool = False
+    max_num_peers: int = 50
+    flush_throttle_timeout: float = 0.100
+    max_msg_packet_payload_size: int = 1024
+    send_rate: int = 512_000  # bytes/sec (p2p/connection.go:33-34)
+    recv_rate: int = 512_000
+
+    def addr_book(self) -> str:
+        return _root_join(self.root_dir, self.addr_book_file)
+
+
+@dataclass
+class MempoolConfig:
+    """Mempool options (config/config.go:267-291)."""
+
+    root_dir: str = ""
+    recheck: bool = True
+    recheck_empty: bool = True
+    broadcast: bool = True
+    wal_path: str = "data/mempool.wal"
+
+    def wal_dir(self) -> str:
+        return _root_join(self.root_dir, self.wal_path)
+
+
+@dataclass
+class ConsensusConfig:
+    """Consensus timeouts + policies (config/config.go:295-385).
+
+    Defaults match DefaultConsensusConfig (config/config.go:367-385):
+    3s propose (+0.5s/round), 1s prevote/precommit (+0.5s/round),
+    1s commit; empty blocks on, 0s empty-blocks interval.
+    """
+
+    root_dir: str = ""
+    wal_path: str = "data/cs.wal/wal"
+    wal_light: bool = False
+
+    timeout_propose: float = 3.0
+    timeout_propose_delta: float = 0.5
+    timeout_prevote: float = 1.0
+    timeout_prevote_delta: float = 0.5
+    timeout_precommit: float = 1.0
+    timeout_precommit_delta: float = 0.5
+    timeout_commit: float = 1.0
+    skip_timeout_commit: bool = False
+
+    max_block_size_txs: int = 10000
+    max_block_size_bytes: int = 1  # unused in reference too (config/config.go:309)
+
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval: float = 0.0
+
+    peer_gossip_sleep_duration: float = 0.100
+    peer_query_maj23_sleep_duration: float = 2.0
+
+    def wal_file(self) -> str:
+        return _root_join(self.root_dir, self.wal_path)
+
+    # -- round-indexed timeout schedule (config/config.go:338-357) --------
+
+    def propose(self, round_: int) -> float:
+        return self.timeout_propose + self.timeout_propose_delta * round_
+
+    def prevote(self, round_: int) -> float:
+        return self.timeout_prevote + self.timeout_prevote_delta * round_
+
+    def precommit(self, round_: int) -> float:
+        return self.timeout_precommit + self.timeout_precommit_delta * round_
+
+    def commit(self, wall_time: float, block_time: float) -> float:
+        """Absolute deadline for starting the next height: block time +
+        timeout_commit, as a delay from wall_time (config/config.go:353-357)."""
+        return max(0.0, block_time + self.timeout_commit - wall_time)
+
+
+@dataclass
+class Config:
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+
+    def set_root(self, root: str) -> "Config":
+        self.base.root_dir = root
+        self.rpc.root_dir = root
+        self.p2p.root_dir = root
+        self.mempool.root_dir = root
+        self.consensus.root_dir = root
+        return self
+
+    def copy(self) -> "Config":
+        return Config(
+            replace(self.base),
+            replace(self.rpc),
+            replace(self.p2p),
+            replace(self.mempool),
+            replace(self.consensus),
+        )
+
+
+def _root_join(root: str, path: str) -> str:
+    if os.path.isabs(path) or not root:
+        return path
+    return os.path.join(root, path)
+
+
+def default_config() -> Config:
+    return Config()
+
+
+def test_config() -> Config:
+    """Fast preset for tests (Test*Config variants in config/config.go):
+    10x-shorter consensus timeouts, skip timeout-commit, ephemeral ports,
+    in-memory db."""
+    cfg = Config()
+    cfg.base.chain_id = "tendermint_test"
+    cfg.base.proxy_app = "kvstore"
+    cfg.base.fast_sync = False
+    cfg.base.db_backend = "memdb"
+    cfg.rpc.laddr = "tcp://0.0.0.0:36657"
+    cfg.p2p.laddr = "tcp://0.0.0.0:36656"
+    cfg.p2p.skip_upnp = True
+    c = cfg.consensus
+    c.wal_light = True
+    c.timeout_propose = 0.1
+    c.timeout_propose_delta = 0.001
+    c.timeout_prevote = 0.01
+    c.timeout_prevote_delta = 0.001
+    c.timeout_precommit = 0.01
+    c.timeout_precommit_delta = 0.001
+    c.timeout_commit = 0.01
+    c.skip_timeout_commit = True
+    return cfg

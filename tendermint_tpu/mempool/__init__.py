@@ -1,0 +1,3 @@
+from tendermint_tpu.mempool.mempool import Mempool, TxInCacheError
+
+__all__ = ["Mempool", "TxInCacheError"]

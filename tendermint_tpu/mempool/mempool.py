@@ -1,0 +1,247 @@
+"""Concurrent transaction pool (reference: mempool/mempool.go).
+
+Good txs live in a CList walked concurrently by the reactor's per-peer
+broadcast routines; an LRU cache (100k entries, mempool/mempool.go:51)
+dedups everything ever seen; CheckTx goes to the app over the async ABCI
+mempool connection; after each commit the surviving txs are re-checked
+(mempool/mempool.go:331-357,379); `txs_available` fires once per height
+when the pool first becomes non-empty (no-empty-blocks mode).
+
+Consensus holds lock()/unlock() around app-Commit + update so no CheckTx
+interleaves with state transition (state/execution.py commit path).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from tendermint_tpu.abci.types import ResponseCheckTx
+from tendermint_tpu.libs.autofile import Group
+from tendermint_tpu.libs.clist import CList
+
+CACHE_SIZE = 100_000
+
+
+class TxInCacheError(Exception):
+    """Tx already seen (mempool/mempool.go:162)."""
+
+
+class MemTx:
+    """A good tx in the pool, tagged with the height it was checked at
+    (mempool/mempool.go:407-410)."""
+
+    __slots__ = ("counter", "height", "tx")
+
+    def __init__(self, counter: int, height: int, tx: bytes):
+        self.counter = counter
+        self.height = height
+        self.tx = tx
+
+
+class TxCache:
+    """Bounded FIFO-evicting dedup set (mempool/mempool.go:412-471)."""
+
+    def __init__(self, size: int = CACHE_SIZE):
+        self._size = size
+        self._map: OrderedDict[bytes, None] = OrderedDict()
+        self._mtx = threading.Lock()
+
+    def exists(self, tx: bytes) -> bool:
+        with self._mtx:
+            return tx in self._map
+
+    def push(self, tx: bytes) -> bool:
+        with self._mtx:
+            if tx in self._map:
+                return False
+            if len(self._map) >= self._size:
+                self._map.popitem(last=False)
+            self._map[tx] = None
+            return True
+
+    def remove(self, tx: bytes) -> None:
+        with self._mtx:
+            self._map.pop(tx, None)
+
+    def reset(self) -> None:
+        with self._mtx:
+            self._map.clear()
+
+
+class Mempool:
+    def __init__(self, config, proxy_app_conn):
+        self.config = config
+        self.proxy_app_conn = proxy_app_conn
+        self.txs = CList()
+        self.counter = 0
+        self.height = 0
+        self.cache = TxCache()
+        self.wal: Group | None = None
+        # recheck cursor: txs in [recheck_cursor, recheck_end] are being
+        # re-validated post-commit (mempool/mempool.go:72-75)
+        self.recheck_cursor = None
+        self.recheck_end = None
+        self.notified_txs_available = False
+        self._txs_available_cb = None
+        self._mtx = threading.RLock()  # the proxy mtx (mempool/mempool.go:58)
+        proxy_app_conn.set_response_callback(self._res_cb)
+
+    # -- wal ---------------------------------------------------------------
+
+    def init_wal(self) -> None:
+        """Append-only log of every tx entering CheckTx
+        (mempool/mempool.go:111-124)."""
+        import os
+
+        path = self.config.wal_dir()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.wal = Group(path)
+
+    def close_wal(self) -> None:
+        with self._mtx:
+            if self.wal is not None:
+                self.wal.close()
+                self.wal = None
+
+    # -- locking around commit --------------------------------------------
+
+    def lock(self) -> None:
+        self._mtx.acquire()
+
+    def unlock(self) -> None:
+        self._mtx.release()
+
+    def size(self) -> int:
+        return len(self.txs)
+
+    def flush_app_conn(self) -> None:
+        self.proxy_app_conn.flush_sync()
+
+    def flush(self) -> None:
+        """Drop everything (unsafe_flush_mempool RPC)."""
+        with self._mtx:
+            self.cache.reset()
+            el = self.txs.front()
+            while el is not None:
+                nxt = el.next()
+                self.txs.remove(el)
+                el = nxt
+
+    def txs_front(self):
+        return self.txs.front()
+
+    def txs_front_wait(self, timeout: float | None = None):
+        return self.txs.front_wait(timeout)
+
+    # -- checktx -----------------------------------------------------------
+
+    def check_tx(self, tx: bytes, cb=None) -> None:
+        """Validate tx against the app; good txs enter the pool when the
+        async response lands (mempool/mempool.go:166-205)."""
+        with self._mtx:
+            if not self.cache.push(tx):
+                raise TxInCacheError(tx.hex()[:16])
+            if self.wal is not None:
+                self.wal.write_line(tx.hex())
+                self.wal.flush()
+            reqres = self.proxy_app_conn.check_tx_async(tx)
+            if cb is not None:
+                reqres.set_callback(lambda res: cb(res))
+
+    def _res_cb(self, req_type: str, tx, res) -> None:
+        """Routed to normal or recheck mode by cursor state
+        (mempool/mempool.go:208-214)."""
+        if req_type != "check_tx":
+            return
+        if self.recheck_cursor is None:
+            self._res_cb_normal(tx, res)
+        else:
+            self._res_cb_recheck(tx, res)
+
+    def _res_cb_normal(self, tx: bytes, res: ResponseCheckTx) -> None:
+        if res.is_ok:
+            self.counter += 1
+            self.txs.push_back(MemTx(self.counter, self.height, tx))
+            self._notify_txs_available()
+        else:
+            # bad tx: allow future resubmission (mempool/mempool.go:231)
+            self.cache.remove(tx)
+
+    def _res_cb_recheck(self, tx: bytes, res: ResponseCheckTx) -> None:
+        cursor = self.recheck_cursor
+        assert cursor is not None
+        memtx: MemTx = cursor.value
+        if memtx.tx != tx:
+            raise RuntimeError(
+                f"recheck response for unexpected tx {tx.hex()[:16]} != {memtx.tx.hex()[:16]}"
+            )
+        if not res.is_ok:
+            # tx invalidated by the last block: evict
+            self.txs.remove(cursor)
+        if cursor is self.recheck_end:
+            self.recheck_cursor = None
+            self.recheck_end = None
+            if self.size() > 0:
+                self._notify_txs_available()
+        else:
+            self.recheck_cursor = cursor.next()
+
+    # -- txs-available signal ---------------------------------------------
+
+    def enable_txs_available(self, cb) -> None:
+        """cb() fires at most once per height when the pool goes non-empty
+        (mempool/mempool.go:280-297)."""
+        self._txs_available_cb = cb
+
+    def _notify_txs_available(self) -> None:
+        if self._txs_available_cb is not None and not self.notified_txs_available:
+            self.notified_txs_available = True
+            self._txs_available_cb()
+
+    # -- consensus interface ----------------------------------------------
+
+    def reap(self, max_txs: int) -> list[bytes]:
+        """Up to max_txs good txs in order; -1 = all (mempool/mempool.go:300-327).
+        Waits for outstanding CheckTx responses first."""
+        with self._mtx:
+            if self.height > 0:
+                self.proxy_app_conn.flush_sync()
+            out = []
+            el = self.txs.front()
+            while el is not None and (max_txs < 0 or len(out) < max_txs):
+                out.append(el.value.tx)
+                el = el.next()
+            return out
+
+    def update(self, height: int, txs: list[bytes]) -> None:
+        """Remove committed txs; recheck survivors against the new app
+        state. Caller must hold lock() (mempool/mempool.go:331-357)."""
+        self.proxy_app_conn.flush_sync()
+        self.height = height
+        self.notified_txs_available = False
+        committed = set(txs)
+        good = self._filter_txs(committed)
+        # Recheck && (RecheckEmpty || block had txs) — mempool/mempool.go:351
+        if good and self.config.recheck and (self.config.recheck_empty or txs):
+            self._recheck_txs(good)
+            # fires _res_cb_recheck for each in-flight response
+            self.proxy_app_conn.flush_async()
+
+    def _filter_txs(self, block_txs: set[bytes]) -> list:
+        good = []
+        el = self.txs.front()
+        while el is not None:
+            nxt = el.next()
+            if el.value.tx in block_txs:
+                self.txs.remove(el)
+            else:
+                good.append(el)
+            el = nxt
+        return good
+
+    def _recheck_txs(self, good_elements: list) -> None:
+        self.recheck_cursor = good_elements[0]
+        self.recheck_end = good_elements[-1]
+        for el in good_elements:
+            self.proxy_app_conn.check_tx_async(el.value.tx)

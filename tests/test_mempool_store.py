@@ -1,0 +1,185 @@
+"""Mempool, block store, and config tests (reference test models:
+mempool/mempool_test.go, blockchain/store.go usage, config/config_test.go)."""
+
+import os
+import time
+
+import pytest
+
+from tendermint_tpu.abci.apps.counter import CounterApp
+from tendermint_tpu.abci.apps.kvstore import KVStoreApp
+from tendermint_tpu.abci.client import LocalClient
+from tendermint_tpu.blockchain.store import BlockStore
+from tendermint_tpu.config import default_config, reset_test_root
+from tendermint_tpu.config import test_config as _test_config
+from tendermint_tpu.config.toml import load_config
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.mempool import Mempool, TxInCacheError
+from tendermint_tpu.proxy.app_conn import AppConnMempool
+from tendermint_tpu.types import Block, BlockID, Commit, Vote, VOTE_TYPE_PRECOMMIT
+
+
+def _mk_mempool(app=None):
+    cfg = _test_config().mempool
+    client = LocalClient(app or CounterApp(serial=False))
+    return Mempool(cfg, AppConnMempool(client))
+
+
+def _tx(i: int) -> bytes:
+    return i.to_bytes(8, "big")
+
+
+class TestMempool:
+    def test_check_tx_adds_good_txs(self):
+        mp = _mk_mempool()
+        for i in range(10):
+            mp.check_tx(_tx(i))
+        assert mp.size() == 10
+        assert mp.reap(-1) == [_tx(i) for i in range(10)]
+        assert mp.reap(3) == [_tx(i) for i in range(3)]
+
+    def test_cache_rejects_duplicates(self):
+        mp = _mk_mempool()
+        mp.check_tx(b"hello")
+        with pytest.raises(TxInCacheError):
+            mp.check_tx(b"hello")
+        assert mp.size() == 1
+
+    def test_bad_tx_rejected_and_cache_evicted(self):
+        app = CounterApp(serial=True)
+        mp = _mk_mempool(app)
+        mp.check_tx(_tx(0))
+        # serial counter app rejects out-of-order nonce
+        app.set_option("serial", "on")
+        mp.check_tx(_tx(5))
+        assert mp.size() == 2  # checktx passes (5 >= 0 txcount)
+
+    def test_update_removes_committed_and_rechecks(self):
+        mp = _mk_mempool(KVStoreApp())
+        for i in range(5):
+            mp.check_tx(_tx(i))
+        mp.lock()
+        mp.update(1, [_tx(0), _tx(2)])
+        mp.unlock()
+        assert mp.reap(-1) == [_tx(1), _tx(3), _tx(4)]
+
+    def test_txs_available_fires_once_per_height(self):
+        mp = _mk_mempool()
+        fired = []
+        mp.enable_txs_available(lambda: fired.append(1))
+        mp.check_tx(_tx(0))
+        mp.check_tx(_tx(1))
+        assert len(fired) == 1
+        mp.lock()
+        mp.update(1, [_tx(0)])
+        mp.unlock()
+        # pool still non-empty after recheck → re-notifies for next height
+        assert len(fired) == 2
+
+    def test_serial_counter_recheck_evicts_stale(self):
+        """After commit advances the counter, lower-nonce txs fail recheck."""
+        app = CounterApp(serial=True)
+        client = LocalClient(app)
+        mp = Mempool(_test_config().mempool, AppConnMempool(client))
+        for i in range(3):
+            mp.check_tx(_tx(i))
+        assert mp.size() == 3
+        # commit tx 0 and 1 through the app (same app instance)
+        app.deliver_tx(_tx(0))
+        app.deliver_tx(_tx(1))
+        app.commit()
+        mp.lock()
+        mp.update(1, [_tx(0), _tx(1)])
+        mp.unlock()
+        assert mp.reap(-1) == [_tx(2)]
+
+    def test_wal_appends(self, tmp_path):
+        cfg = _test_config().mempool
+        cfg.root_dir = str(tmp_path)
+        cfg.wal_path = "data/mempool.wal"
+        client = LocalClient(CounterApp(serial=False))
+        mp = Mempool(cfg, AppConnMempool(client))
+        mp.init_wal()
+        mp.check_tx(b"abc")
+        mp.close_wal()
+        with open(cfg.wal_dir()) as f:
+            assert f.read().strip() == b"abc".hex()
+
+
+def _make_block_with_commit(height, chain_id="test-store"):
+    from tendermint_tpu.types.block import empty_commit
+
+    block, parts = Block.make_block(
+        height=height,
+        chain_id=chain_id,
+        txs=[b"tx-%d" % i for i in range(3)],
+        commit=empty_commit(),
+        prev_block_id=BlockID(),
+        val_hash=b"",
+        app_hash=b"",
+        part_size=64 * 1024,
+        time_ns=time.time_ns(),
+    )
+    commit = Commit(BlockID(block.hash(), parts.header()), [])
+    return block, parts, commit
+
+
+class TestBlockStore:
+    def test_save_load_roundtrip(self):
+        store = BlockStore(MemDB())
+        assert store.height() == 0
+        block, parts, seen = _make_block_with_commit(1)
+        store.save_block(block, parts, seen)
+        assert store.height() == 1
+
+        loaded = store.load_block(1)
+        assert loaded is not None
+        assert loaded.hash() == block.hash()
+        meta = store.load_block_meta(1)
+        assert meta.block_id.hash == block.hash()
+        part = store.load_block_part(1, 0)
+        assert part.bytes_ == parts.get_part(0).bytes_
+        sc = store.load_seen_commit(1)
+        assert sc.block_id.hash == block.hash()
+        # canonical commit for height 0 is block 1's LastCommit
+        assert store.load_block_commit(0) is not None
+
+    def test_noncontiguous_save_rejected(self):
+        store = BlockStore(MemDB())
+        block, parts, seen = _make_block_with_commit(5)
+        with pytest.raises(ValueError):
+            store.save_block(block, parts, seen)
+
+    def test_missing_heights_return_none(self):
+        store = BlockStore(MemDB())
+        assert store.load_block(1) is None
+        assert store.load_block_meta(1) is None
+        assert store.load_seen_commit(1) is None
+
+
+class TestConfig:
+    def test_timeout_schedule(self):
+        c = default_config().consensus
+        assert c.propose(0) == 3.0
+        assert c.propose(2) == 4.0
+        assert c.prevote(1) == 1.5
+        assert c.commit(10.0, 9.5) == pytest.approx(0.5)
+        assert c.commit(100.0, 9.5) == 0.0
+
+    def test_reset_test_root_and_load(self, tmp_path):
+        root = str(tmp_path / "node1")
+        cfg = reset_test_root(root)
+        assert os.path.exists(os.path.join(root, "config.toml"))
+        assert os.path.exists(cfg.base.genesis_file())
+        assert os.path.exists(cfg.base.priv_validator_file())
+
+        loaded = load_config(root)
+        assert loaded.base.chain_id == "tendermint_test"
+        assert loaded.consensus.skip_timeout_commit is True
+        assert loaded.consensus.timeout_propose == pytest.approx(0.1)
+
+        from tendermint_tpu.types import GenesisDoc, PrivValidatorFS
+
+        doc = GenesisDoc.from_file(cfg.base.genesis_file())
+        pv = PrivValidatorFS.load(cfg.base.priv_validator_file())
+        assert doc.validators[0].pub_key == pv.get_pub_key()
