@@ -276,9 +276,15 @@ class SocketClient(ABCIClient):
                     tx = bytes.fromhex(tx_hex) if tx_hex else None
                     self._res_cb(rr.req_type, tx, res)
         except Exception as e:
-            # any decode/callback failure must surface via error(), not
-            # silently kill the receive thread and strand pending waiters
             self._err = e
+        # receive loop is done (EOF or error): release every in-flight
+        # waiter now instead of letting each block out its full timeout
+        if self._err is None:
+            self._err = ConnectionError("abci socket closed")
+        with self._pending_mtx:
+            pending, self._pending = self._pending, []
+        for rr in pending:
+            rr.complete(None)
 
     @staticmethod
     def _decode(req_type: str, obj: dict):

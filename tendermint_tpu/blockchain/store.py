@@ -69,13 +69,13 @@ class BlockStore:
         meta = self.load_block_meta(height)
         if meta is None:
             return None
-        data = b""
+        chunks = []
         for i in range(meta.block_id.parts_header.total):
             part = self.load_block_part(height, i)
             if part is None:
                 return None
-            data += part.bytes_
-        return Block.from_bytes(data)
+            chunks.append(part.bytes_)
+        return Block.from_bytes(b"".join(chunks))
 
     def load_block_commit(self, height: int) -> Commit | None:
         """The canonical commit for `height`, i.e. block height+1's

@@ -1,0 +1,5 @@
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.consensus.ticker import MockTicker, TimeoutTicker
+from tendermint_tpu.consensus.wal import WAL
+
+__all__ = ["ConsensusState", "TimeoutTicker", "MockTicker", "WAL"]

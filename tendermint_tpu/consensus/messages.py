@@ -1,0 +1,231 @@
+"""Consensus wire/WAL messages (reference: consensus/reactor.go:1181-1363).
+
+A tagged-union JSON codec: each message type registers under a short tag
+(the analogue of go-wire's type bytes, consensus/reactor.go:1198-1210).
+The same encoding serves the WAL and the p2p channels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tendermint_tpu.libs.bitarray import BitArray
+from tendermint_tpu.types import BlockID, Heartbeat, Part, Proposal, Vote
+from tendermint_tpu.types.block_id import PartSetHeader
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register(tag: str):
+    def deco(cls):
+        cls.TAG = tag
+        _REGISTRY[tag] = cls
+        return cls
+
+    return deco
+
+
+def msg_to_json(msg) -> dict:
+    return {"type": msg.TAG, "data": msg.to_json()}
+
+
+def msg_from_json(obj: dict):
+    cls = _REGISTRY.get(obj["type"])
+    if cls is None:
+        raise ValueError(f"unknown consensus message type {obj['type']!r}")
+    return cls.from_json(obj["data"])
+
+
+@register("new_round_step")
+@dataclass
+class NewRoundStepMessage:
+    """Broadcast on every step transition (consensus/reactor.go:1225-1251)."""
+
+    height: int
+    round_: int
+    step: int
+    seconds_since_start_time: int
+    last_commit_round: int
+
+    def to_json(self):
+        return {
+            "height": self.height,
+            "round": self.round_,
+            "step": self.step,
+            "seconds_since_start_time": self.seconds_since_start_time,
+            "last_commit_round": self.last_commit_round,
+        }
+
+    @classmethod
+    def from_json(cls, o):
+        return cls(o["height"], o["round"], o["step"], o["seconds_since_start_time"], o["last_commit_round"])
+
+
+@register("commit_step")
+@dataclass
+class CommitStepMessage:
+    """consensus/reactor.go:1256-1268."""
+
+    height: int
+    block_parts_header: PartSetHeader
+    block_parts: BitArray
+
+    def to_json(self):
+        return {
+            "height": self.height,
+            "block_parts_header": self.block_parts_header.to_json(),
+            "block_parts": self.block_parts.to_json(),
+        }
+
+    @classmethod
+    def from_json(cls, o):
+        return cls(
+            o["height"],
+            PartSetHeader.from_json(o["block_parts_header"]),
+            BitArray.from_json(o["block_parts"]),
+        )
+
+
+@register("proposal")
+@dataclass
+class ProposalMessage:
+    proposal: Proposal
+
+    def to_json(self):
+        return {"proposal": self.proposal.to_json()}
+
+    @classmethod
+    def from_json(cls, o):
+        return cls(Proposal.from_json(o["proposal"]))
+
+
+@register("proposal_pol")
+@dataclass
+class ProposalPOLMessage:
+    """Sent when catching a peer up to a POL round (consensus/reactor.go:1289-1300)."""
+
+    height: int
+    proposal_pol_round: int
+    proposal_pol: BitArray
+
+    def to_json(self):
+        return {
+            "height": self.height,
+            "proposal_pol_round": self.proposal_pol_round,
+            "proposal_pol": self.proposal_pol.to_json(),
+        }
+
+    @classmethod
+    def from_json(cls, o):
+        return cls(o["height"], o["proposal_pol_round"], BitArray.from_json(o["proposal_pol"]))
+
+
+@register("block_part")
+@dataclass
+class BlockPartMessage:
+    height: int
+    round_: int
+    part: Part
+
+    def to_json(self):
+        return {"height": self.height, "round": self.round_, "part": self.part.to_json()}
+
+    @classmethod
+    def from_json(cls, o):
+        return cls(o["height"], o["round"], Part.from_json(o["part"]))
+
+
+@register("vote")
+@dataclass
+class VoteMessage:
+    vote: Vote
+
+    def to_json(self):
+        return {"vote": self.vote.to_json()}
+
+    @classmethod
+    def from_json(cls, o):
+        return cls(Vote.from_json(o["vote"]))
+
+
+@register("has_vote")
+@dataclass
+class HasVoteMessage:
+    """Tells peers our vote bit-arrays changed (consensus/reactor.go:1327-1339)."""
+
+    height: int
+    round_: int
+    type_: int
+    index: int
+
+    def to_json(self):
+        return {"height": self.height, "round": self.round_, "type": self.type_, "index": self.index}
+
+    @classmethod
+    def from_json(cls, o):
+        return cls(o["height"], o["round"], o["type"], o["index"])
+
+
+@register("vote_set_maj23")
+@dataclass
+class VoteSetMaj23Message:
+    """Claim of +2/3 for a block (consensus/reactor.go:1344-1355)."""
+
+    height: int
+    round_: int
+    type_: int
+    block_id: BlockID
+
+    def to_json(self):
+        return {
+            "height": self.height,
+            "round": self.round_,
+            "type": self.type_,
+            "block_id": self.block_id.to_json(),
+        }
+
+    @classmethod
+    def from_json(cls, o):
+        return cls(o["height"], o["round"], o["type"], BlockID.from_json(o["block_id"]))
+
+
+@register("vote_set_bits")
+@dataclass
+class VoteSetBitsMessage:
+    """Response to VoteSetMaj23: which of those votes we have
+    (consensus/reactor.go:1360-1372)."""
+
+    height: int
+    round_: int
+    type_: int
+    block_id: BlockID
+    votes: BitArray
+
+    def to_json(self):
+        return {
+            "height": self.height,
+            "round": self.round_,
+            "type": self.type_,
+            "block_id": self.block_id.to_json(),
+            "votes": self.votes.to_json(),
+        }
+
+    @classmethod
+    def from_json(cls, o):
+        return cls(
+            o["height"], o["round"], o["type"],
+            BlockID.from_json(o["block_id"]), BitArray.from_json(o["votes"]),
+        )
+
+
+@register("proposal_heartbeat")
+@dataclass
+class ProposalHeartbeatMessage:
+    heartbeat: Heartbeat
+
+    def to_json(self):
+        return {"heartbeat": self.heartbeat.to_json()}
+
+    @classmethod
+    def from_json(cls, o):
+        return cls(Heartbeat.from_json(o["heartbeat"]))

@@ -1,0 +1,1039 @@
+"""ConsensusState: the Tendermint BFT state machine
+(reference: consensus/state.go — SURVEY.md §3.2 is the call-stack map).
+
+One receive routine serializes ALL inputs — peer messages, our own
+messages, timeouts, the mempool's txs-available signal — into a total
+order, writes each to the WAL before acting, and drives the step cycle
+NewHeight → NewRound → Propose → Prevote(+Wait) → Precommit(+Wait) →
+Commit (consensus/state.go:604-659). That single-owner discipline is what
+makes WAL replay deterministic.
+
+TPU integration: vote signatures verify through `verifier.vote_verifier()`
+(one-at-a-time arrival → CPU latency path) and block validation's
+VerifyCommit through `verifier.commit_batch_verifier()` (wide batch → TPU
+kernel), both from ops.gateway. Accept/reject semantics are identical to
+the reference's sequential loops.
+
+Test seams, as in the reference (consensus/state.go:222-226): the
+decide_proposal / do_prevote / set_proposal methods are assignable, and
+the ticker is injectable (MockTicker fires only NewHeight).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass
+
+from tendermint_tpu.consensus import messages as msgs
+from tendermint_tpu.consensus.height_vote_set import HeightVoteSet
+from tendermint_tpu.consensus.round_state import RoundState, RoundStep
+from tendermint_tpu.consensus.ticker import TickerI, TimeoutInfo, TimeoutTicker
+from tendermint_tpu.consensus.wal import WAL, WALMessage
+from tendermint_tpu.libs.events import EventCache, EventSwitch
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.ops import gateway
+from tendermint_tpu.state import execution as sm
+from tendermint_tpu.state.fail import fail_point
+from tendermint_tpu.types import (
+    VOTE_TYPE_PRECOMMIT,
+    VOTE_TYPE_PREVOTE,
+    Block,
+    BlockID,
+    ConflictingVotesError,
+    Heartbeat,
+    Proposal,
+    Vote,
+    VoteError,
+    VoteSet,
+)
+from tendermint_tpu.types import events as tev
+from tendermint_tpu.types.block import empty_commit
+from tendermint_tpu.types.vote import UnexpectedStepError
+
+
+@dataclass
+class MsgInfo:
+    msg: object
+    peer_id: str = ""  # "" = internal (our own proposal/parts/votes)
+
+
+class ConsensusState(BaseService):
+    def __init__(
+        self,
+        config,
+        state,
+        proxy_app_conn,
+        block_store,
+        mempool,
+        verifier: gateway.Verifier | None = None,
+    ):
+        super().__init__("ConsensusState")
+        self.config = config
+        self.proxy_app_conn = proxy_app_conn
+        self.block_store = block_store
+        self.mempool = mempool
+        self.verifier = verifier or gateway.default_verifier()
+        self.part_hasher = gateway.default_hasher()
+
+        self.priv_validator = None
+        self.rs = RoundState()
+        self.state = None  # sm.State, set by update_to_state
+
+        self.peer_msg_queue: queue.Queue = queue.Queue(maxsize=1000)
+        self.internal_msg_queue: queue.Queue = queue.Queue(maxsize=1000)
+        self.timeout_ticker: TickerI = TimeoutTicker()
+        # combined input queue preserving the reference's select semantics
+        self._inputs: queue.Queue = queue.Queue()
+
+        self.wal: WAL | None = None
+        self.replay_mode = False
+        self.done_height = threading.Event()  # pulses on each commit (tests)
+        self.n_steps = 0
+
+        self.evsw: EventSwitch | None = None
+
+        # test seams (consensus/state.go:222-226)
+        self.decide_proposal = self.default_decide_proposal
+        self.do_prevote = self.default_do_prevote
+        self.set_proposal = self.default_set_proposal
+
+        self._thread: threading.Thread | None = None
+        self._forwarders: list[threading.Thread] = []
+        self._stopping = threading.Event()
+
+        self.update_to_state(state)
+        self.reconstruct_last_commit(state)
+
+    # -- wiring ------------------------------------------------------------
+
+    def set_event_switch(self, evsw: EventSwitch) -> None:
+        self.evsw = evsw
+
+    def set_priv_validator(self, pv) -> None:
+        self.priv_validator = pv
+
+    def set_timeout_ticker(self, ticker: TickerI) -> None:
+        self.timeout_ticker = ticker
+
+    def get_round_state(self) -> RoundState:
+        return self.rs  # single-writer; readers treat as snapshot
+
+    def is_proposer(self) -> bool:
+        proposer = self.rs.validators.get_proposer()
+        return (
+            self.priv_validator is not None
+            and proposer is not None
+            and proposer.address == self.priv_validator.get_address()
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def on_start(self) -> None:
+        if self.wal is None and not self.replay_mode:
+            self.open_wal(self.config.wal_file())
+        self.timeout_ticker.start()
+        self._stopping.clear()
+
+        # WAL catchup BEFORE accepting new inputs (consensus/state.go:337-344)
+        if self.wal is not None and not self.replay_mode:
+            from tendermint_tpu.consensus.replay import catchup_replay
+
+            catchup_replay(self, self.rs.height)
+
+        self._start_forwarders()
+        self._thread = threading.Thread(
+            target=self.receive_routine, args=(0,), daemon=True, name="cs.receiveRoutine"
+        )
+        self._thread.start()
+        self.schedule_round_0(self.rs)
+
+    def start_routines(self, max_steps: int = 0) -> None:
+        """Test entry (consensus/state.go:363-370): start ticker +
+        routines without WAL replay or round-0 scheduling."""
+        self.timeout_ticker.start()
+        self._stopping.clear()
+        self._start_forwarders()
+        self._thread = threading.Thread(
+            target=self.receive_routine, args=(max_steps,), daemon=True,
+            name="cs.receiveRoutine",
+        )
+        self._thread.start()
+
+    def _start_forwarders(self) -> None:
+        """Drain the three source queues into the combined input queue."""
+
+        def fwd(src: queue.Queue, tag: str):
+            while not self._stopping.is_set():
+                try:
+                    item = src.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                if item is None:
+                    continue
+                self._inputs.put((tag, item))
+
+        for src, tag in (
+            (self.peer_msg_queue, "msg"),
+            (self.internal_msg_queue, "msg"),
+            (self.timeout_ticker.chan, "timeout"),
+        ):
+            t = threading.Thread(target=fwd, args=(src, tag), daemon=True)
+            t.start()
+            self._forwarders.append(t)
+
+        if hasattr(self.mempool, "enable_txs_available") and not self.config.create_empty_blocks:
+            self.mempool.enable_txs_available(lambda: self._inputs.put(("txs_available", None)))
+
+    def on_stop(self) -> None:
+        self._stopping.set()
+        self.timeout_ticker.stop()
+        self._inputs.put(("quit", None))
+        if self._thread:
+            self._thread.join(timeout=5)
+        if self.wal is not None:
+            self.wal.stop()
+
+    def open_wal(self, wal_file: str) -> None:
+        wal = WAL(wal_file, light=self.config.wal_light)
+        wal.start()
+        self.wal = wal
+
+    # -- queues ------------------------------------------------------------
+
+    def send_internal_message(self, mi: MsgInfo) -> None:
+        self.internal_msg_queue.put(mi)
+
+    def add_peer_message(self, msg, peer_id: str) -> None:
+        self.peer_msg_queue.put(MsgInfo(msg, peer_id))
+
+    def set_proposal_msg(self, proposal: Proposal, peer_id: str = "") -> None:
+        (self.peer_msg_queue if peer_id else self.internal_msg_queue).put(
+            MsgInfo(msgs.ProposalMessage(proposal), peer_id)
+        )
+
+    def add_vote_msg(self, vote: Vote, peer_id: str = "") -> None:
+        (self.peer_msg_queue if peer_id else self.internal_msg_queue).put(
+            MsgInfo(msgs.VoteMessage(vote), peer_id)
+        )
+
+    # -- state sync --------------------------------------------------------
+
+    def reconstruct_last_commit(self, state) -> None:
+        """Rebuild rs.last_commit from the block store's seen commit
+        (consensus/state.go:407-429)."""
+        if state.last_block_height == 0:
+            return
+        seen_commit = self.block_store.load_seen_commit(state.last_block_height)
+        if seen_commit is None:
+            raise RuntimeError(
+                f"failed to reconstruct last commit; seen commit for height {state.last_block_height} missing"
+            )
+        last_precommits = VoteSet(
+            state.chain_id,
+            state.last_block_height,
+            seen_commit.round_(),
+            VOTE_TYPE_PRECOMMIT,
+            state.last_validators,
+        )
+        for pc in seen_commit.precommits:
+            if pc is None:
+                continue
+            added = last_precommits.add_vote(pc, verifier=self.verifier.vote_verifier())
+            if not added:
+                raise RuntimeError("failed to reconstruct last commit: vote not added")
+        if not last_precommits.has_two_thirds_majority():
+            raise RuntimeError("failed to reconstruct last commit: no +2/3")
+        self.rs.last_commit = last_precommits
+
+    def update_to_state(self, state) -> None:
+        """Reset RoundState for the next height (consensus/state.go:432-488)."""
+        rs = self.rs
+        if rs.commit_round > -1 and 0 < rs.height != state.last_block_height:
+            raise RuntimeError(
+                f"update_to_state expected state height {rs.height}, got {state.last_block_height}"
+            )
+        if self.state is not None and self.state.last_block_height + 1 != rs.height:
+            raise RuntimeError(
+                f"inconsistent internal state: {self.state.last_block_height + 1} vs cs height {rs.height}"
+            )
+        # ignore stale states (consensus/state.go:449-455)
+        if self.state is not None and state.last_block_height <= self.state.last_block_height:
+            self.logger.debug("ignoring update_to_state for stale height")
+            return
+
+        validators = state.validators
+        # the +2/3 precommits we just committed with become the next
+        # height's last_commit (consensus/state.go:457-464); on cold start
+        # (commit_round == -1) reconstruct_last_commit fills it instead
+        last_precommits = None
+        if rs.commit_round > -1 and rs.votes is not None:
+            pc = rs.votes.precommits(rs.commit_round)
+            if pc is None or not pc.has_two_thirds_majority():
+                raise RuntimeError("update_to_state called but last precommit round lacks +2/3")
+            last_precommits = pc
+
+        height = state.last_block_height + 1
+        rs.height = height
+        rs.round_ = 0
+        rs.step = RoundStep.NEW_HEIGHT
+        if rs.commit_time == 0:
+            rs.start_time = time.time() + self.config.timeout_commit
+        else:
+            rs.start_time = rs.commit_time + self.config.timeout_commit
+        rs.commit_time = 0.0
+        rs.validators = validators
+        rs.proposal = None
+        rs.proposal_block = None
+        rs.proposal_block_parts = None
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        rs.votes = HeightVoteSet(state.chain_id, height, validators)
+        rs.commit_round = -1
+        rs.last_commit = last_precommits
+        rs.last_validators = state.last_validators
+        self.state = state
+        self.new_step()
+
+    def new_step(self) -> None:
+        rs_event = self.rs.round_state_event()
+        if self.wal is not None:
+            self.wal.save(WALMessage.event_round_state(rs_event))
+        self.n_steps += 1
+        if self.evsw is not None:
+            self.evsw.fire_event(tev.EVENT_NEW_ROUND_STEP, rs_event)
+
+    # -- the receive routine ----------------------------------------------
+
+    def receive_routine(self, max_steps: int) -> None:
+        """consensus/state.go:609-659. max_steps=0 means run forever."""
+        steps = 0
+        while True:
+            if max_steps > 0 and steps >= max_steps:
+                self.logger.debug("receive_routine reached max_steps")
+                return
+            try:
+                tag, item = self._inputs.get(timeout=0.5)
+            except queue.Empty:
+                if self._stopping.is_set():
+                    return
+                continue
+            if tag == "quit":
+                return
+            steps += 1
+            try:
+                if tag == "msg":
+                    mi: MsgInfo = item
+                    if self.wal is not None:
+                        self.wal.save(WALMessage.msg_info(mi.msg, mi.peer_id))
+                    self.handle_msg(mi)
+                elif tag == "timeout":
+                    ti: TimeoutInfo = item
+                    if self.wal is not None:
+                        self.wal.save(WALMessage.timeout(ti))
+                    self.handle_timeout(ti)
+                elif tag == "txs_available":
+                    self.handle_txs_available(self.rs.height)
+            except Exception:
+                self.logger.exception("error in receive routine handling %s", tag)
+
+    def handle_msg(self, mi: MsgInfo) -> None:
+        """consensus/state.go:662-698."""
+        msg, peer_id = mi.msg, mi.peer_id
+        if isinstance(msg, msgs.ProposalMessage):
+            self.set_proposal(msg.proposal)
+        elif isinstance(msg, msgs.BlockPartMessage):
+            self.add_proposal_block_part(msg.height, msg.part, verify=bool(peer_id))
+        elif isinstance(msg, msgs.VoteMessage):
+            self.try_add_vote(msg.vote, peer_id)
+        else:
+            self.logger.warning("unknown msg type %r", type(msg))
+
+    def handle_timeout(self, ti: TimeoutInfo) -> None:
+        """consensus/state.go:701-745."""
+        rs = self.rs
+        if ti.height != rs.height or ti.round_ < rs.round_ or (
+            ti.round_ == rs.round_ and ti.step < rs.step
+        ):
+            self.logger.debug("ignoring tock because we're ahead: %s", ti)
+            return
+        if ti.step == RoundStep.NEW_HEIGHT:
+            self.enter_new_round(ti.height, 0)
+        elif ti.step == RoundStep.NEW_ROUND:
+            self.enter_propose(ti.height, 0)
+        elif ti.step == RoundStep.PROPOSE:
+            self._fire(tev.EVENT_TIMEOUT_PROPOSE, rs.round_state_event())
+            self.enter_prevote(ti.height, ti.round_)
+        elif ti.step == RoundStep.PREVOTE_WAIT:
+            self._fire(tev.EVENT_TIMEOUT_WAIT, rs.round_state_event())
+            self.enter_precommit(ti.height, ti.round_)
+        elif ti.step == RoundStep.PRECOMMIT_WAIT:
+            self._fire(tev.EVENT_TIMEOUT_WAIT, rs.round_state_event())
+            self.enter_new_round(ti.height, ti.round_ + 1)
+        else:
+            raise ValueError(f"invalid timeout step {ti.step}")
+
+    def handle_txs_available(self, height: int) -> None:
+        """consensus/state.go:747-750."""
+        self.enter_propose(height, 0)
+
+    def _fire(self, event: str, data) -> None:
+        if self.evsw is not None:
+            self.evsw.fire_event(event, data)
+
+    def _schedule_timeout(self, duration: float, height: int, round_: int, step: int) -> None:
+        self.timeout_ticker.schedule_timeout(TimeoutInfo(duration, height, round_, step))
+
+    def schedule_round_0(self, rs: RoundState) -> None:
+        sleep = max(0.0, rs.start_time - time.time())
+        self._schedule_timeout(sleep, rs.height, 0, RoundStep.NEW_HEIGHT)
+
+    # -- step: new round ---------------------------------------------------
+
+    def enter_new_round(self, height: int, round_: int) -> None:
+        """consensus/state.go:753-804."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round_ or (
+            rs.round_ == round_ and rs.step != RoundStep.NEW_HEIGHT
+        ):
+            self.logger.debug(
+                "enter_new_round(%d/%d): invalid args, currently %d/%d/%d",
+                height, round_, rs.height, rs.round_, rs.step,
+            )
+            return
+        self.logger.info("enter_new_round(%d/%d)", height, round_)
+
+        validators = rs.validators
+        if rs.round_ < round_:
+            validators = validators.copy()
+            validators.increment_accum(round_ - rs.round_)
+
+        rs.round_ = round_
+        rs.step = RoundStep.NEW_ROUND
+        rs.validators = validators
+        if round_ != 0:
+            # round 0 keeps proposal from NewHeight setup; later rounds reset
+            rs.proposal = None
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.votes.set_round(round_ + 1)  # track next-round votes too
+
+        self._fire(tev.EVENT_NEW_ROUND, rs.round_state_event())
+
+        # no-empty-blocks: wait for txs before proposing (state.go:786-803)
+        wait_for_txs = (
+            not self.config.create_empty_blocks and round_ == 0 and not self.need_proof_block(height)
+        )
+        if wait_for_txs:
+            if self.config.create_empty_blocks_interval > 0:
+                self._schedule_timeout(
+                    self.config.create_empty_blocks_interval, height, round_, RoundStep.NEW_ROUND
+                )
+            if self.mempool.size() > 0:
+                # txs already waiting — the one-shot signal may have fired
+                # before we subscribed at this height
+                self.enter_propose(height, round_)
+            elif not self.replay_mode:
+                self._maybe_start_heartbeat(height, round_)
+        else:
+            self.enter_propose(height, round_)
+
+    def need_proof_block(self, height: int) -> bool:
+        """Propose an empty block anyway if the app hash changed — it
+        "proves" the app results (consensus/state.go:806-816)."""
+        if height == 1:
+            return True
+        last_block_meta = self.block_store.load_block_meta(height - 1)
+        if last_block_meta is None:
+            return False
+        return self.state.app_hash != last_block_meta.header.app_hash
+
+    def _maybe_start_heartbeat(self, height: int, round_: int) -> None:
+        """Proposer liveness beacon while waiting for txs
+        (consensus/state.go:818-848)."""
+        if self.priv_validator is None or not self.is_proposer():
+            return
+
+        def beat():
+            counter = 0
+            addr = self.priv_validator.get_address()
+            while self.is_running():
+                rs = self.rs
+                if rs.height != height or rs.round_ != round_ or rs.step != RoundStep.NEW_ROUND:
+                    return
+                val_index, _ = rs.validators.get_by_address(addr)
+                hb = Heartbeat(
+                    validator_address=addr,
+                    validator_index=val_index,
+                    height=height,
+                    round_=round_,
+                    sequence=counter,
+                )
+                hb = self.priv_validator.sign_heartbeat(self.state.chain_id, hb)
+                self._fire(tev.EVENT_PROPOSAL_HEARTBEAT, tev.EventDataProposalHeartbeat(hb))
+                counter += 1
+                time.sleep(self.config.peer_gossip_sleep_duration * 2)
+
+        threading.Thread(target=beat, daemon=True, name="cs.heartbeat").start()
+
+    # -- step: propose -----------------------------------------------------
+
+    def enter_propose(self, height: int, round_: int) -> None:
+        """consensus/state.go:850-895."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round_ or (
+            rs.round_ == round_ and rs.step >= RoundStep.PROPOSE
+        ):
+            return
+        self.logger.info("enter_propose(%d/%d)", height, round_)
+
+        def defer_():
+            rs.round_ = round_
+            rs.step = RoundStep.PROPOSE
+            self.new_step()
+            if self.is_proposal_complete():
+                self.enter_prevote(height, rs.round_)
+
+        self._schedule_timeout(self.config.propose(round_), height, round_, RoundStep.PROPOSE)
+
+        if self.priv_validator is not None and self.is_proposer():
+            self.decide_proposal(height, round_)
+        defer_()
+
+    def default_decide_proposal(self, height: int, round_: int) -> None:
+        """consensus/state.go:897-944."""
+        rs = self.rs
+        if rs.locked_block is not None:
+            block, block_parts = rs.locked_block, rs.locked_block_parts
+        else:
+            block, block_parts = self.create_proposal_block()
+            if block is None:
+                return  # nothing to propose (no txs and no commit yet)
+
+        pol_round, pol_block_id = rs.votes.pol_info()
+        proposal = Proposal(
+            height=height,
+            round_=round_,
+            block_parts_header=block_parts.header(),
+            pol_round=pol_round,
+            pol_block_id=pol_block_id or BlockID(),
+        )
+        try:
+            proposal = self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+        except Exception:
+            if not self.replay_mode:
+                self.logger.exception("enter_propose: error signing proposal")
+            return
+
+        self.send_internal_message(MsgInfo(msgs.ProposalMessage(proposal)))
+        for i in range(block_parts.total):
+            part = block_parts.get_part(i)
+            self.send_internal_message(MsgInfo(msgs.BlockPartMessage(rs.height, rs.round_, part)))
+        self.logger.info("signed proposal %d/%d", height, round_)
+
+    def is_proposal_complete(self) -> bool:
+        """consensus/state.go:946-957."""
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_block is None:
+            return False
+        if rs.proposal.pol_round < 0:
+            return True
+        prevotes = rs.votes.prevotes(rs.proposal.pol_round)
+        return prevotes is not None and prevotes.has_two_thirds_majority()
+
+    def create_proposal_block(self):
+        """consensus/state.go:959-985: reap mempool, build block+parts.
+        PartSet leaf hashing routes through the TPU hasher."""
+        rs = self.rs
+        if rs.height == 1:
+            commit = empty_commit()
+        elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
+            commit = rs.last_commit.make_commit()
+        else:
+            self.logger.error("propose without last commit (+2/3 missing)")
+            return None, None
+        txs = self.mempool.reap(self.config.max_block_size_txs)
+        return Block.make_block(
+            height=rs.height,
+            chain_id=self.state.chain_id,
+            txs=txs,
+            commit=commit,
+            prev_block_id=self.state.last_block_id,
+            val_hash=self.state.validators.hash(),
+            app_hash=self.state.app_hash,
+            part_size=self.state.params().block_gossip.block_part_size_bytes,
+            part_hasher=self.part_hasher.part_leaf_hashes,
+        )
+
+    # -- step: prevote -----------------------------------------------------
+
+    def enter_prevote(self, height: int, round_: int) -> None:
+        """consensus/state.go:987-1017."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round_ or (
+            rs.round_ == round_ and rs.step >= RoundStep.PREVOTE
+        ):
+            return
+        self.logger.info("enter_prevote(%d/%d)", height, round_)
+
+        # fire Polka event if we have one from a previous condition check
+        self.do_prevote(height, round_)
+
+        rs.round_ = round_
+        rs.step = RoundStep.PREVOTE
+        self.new_step()
+        # wait for more prevotes; the 2/3-any case schedules prevote_wait
+
+    def default_do_prevote(self, height: int, round_: int) -> None:
+        """consensus/state.go:1019-1057."""
+        rs = self.rs
+        if rs.locked_block is not None:
+            self.logger.info("prevote: locked block")
+            self.sign_add_vote(VOTE_TYPE_PREVOTE, rs.locked_block.hash(), rs.locked_block_parts.header())
+            return
+        if rs.proposal_block is None:
+            self.logger.info("prevote: proposal block is nil")
+            self.sign_add_vote(VOTE_TYPE_PREVOTE, b"", None)
+            return
+        try:
+            sm.validate_block(
+                self.state, rs.proposal_block,
+                batch_verifier=self.verifier.commit_batch_verifier(),
+            )
+        except sm.InvalidBlockError as e:
+            self.logger.error("prevote: proposal block invalid: %s", e)
+            self.sign_add_vote(VOTE_TYPE_PREVOTE, b"", None)
+            return
+        self.sign_add_vote(
+            VOTE_TYPE_PREVOTE, rs.proposal_block.hash(), rs.proposal_block_parts.header()
+        )
+
+    def enter_prevote_wait(self, height: int, round_: int) -> None:
+        """consensus/state.go:1059-1073."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round_ or (
+            rs.round_ == round_ and rs.step >= RoundStep.PREVOTE_WAIT
+        ):
+            return
+        prevotes = rs.votes.prevotes(round_)
+        if prevotes is None or not prevotes.has_two_thirds_any():
+            raise RuntimeError(f"enter_prevote_wait({height}/{round_}) without +2/3 prevotes")
+        self.logger.info("enter_prevote_wait(%d/%d)", height, round_)
+        rs.round_ = round_
+        rs.step = RoundStep.PREVOTE_WAIT
+        self.new_step()
+        self._schedule_timeout(self.config.prevote(round_), height, round_, RoundStep.PREVOTE_WAIT)
+
+    # -- step: precommit ---------------------------------------------------
+
+    def enter_precommit(self, height: int, round_: int) -> None:
+        """The locking logic (consensus/state.go:1075-1188)."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round_ or (
+            rs.round_ == round_ and rs.step >= RoundStep.PRECOMMIT
+        ):
+            return
+        self.logger.info("enter_precommit(%d/%d)", height, round_)
+
+        def defer_():
+            rs.round_ = round_
+            rs.step = RoundStep.PRECOMMIT
+            self.new_step()
+
+        prevotes = rs.votes.prevotes(round_)
+        block_id = prevotes.two_thirds_majority() if prevotes else None
+
+        # no +2/3 for anything: precommit nil
+        if block_id is None:
+            self.logger.info("precommit: no +2/3 prevotes; precommitting nil")
+            self.sign_add_vote(VOTE_TYPE_PRECOMMIT, b"", None)
+            defer_()
+            return
+
+        self._fire(tev.EVENT_POLKA, rs.round_state_event())
+
+        pol_round, _ = rs.votes.pol_info()
+        if pol_round < round_:
+            raise RuntimeError(f"POLRound {pol_round} < round {round_}")
+
+        # +2/3 for nil: unlock if locked, precommit nil (state.go:1112-1126)
+        if not block_id.hash:
+            if rs.locked_block is None:
+                self.logger.info("precommit: +2/3 prevoted nil")
+            else:
+                self.logger.info("precommit: +2/3 prevoted nil; unlocking")
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+                self._fire(tev.EVENT_UNLOCK, rs.round_state_event())
+            self.sign_add_vote(VOTE_TYPE_PRECOMMIT, b"", None)
+            defer_()
+            return
+
+        # +2/3 for the block we're locked on: relock (state.go:1130-1138)
+        if rs.locked_block is not None and rs.locked_block.hashes_to(block_id.hash):
+            self.logger.info("precommit: relocking")
+            rs.locked_round = round_
+            self._fire(tev.EVENT_RELOCK, rs.round_state_event())
+            self.sign_add_vote(VOTE_TYPE_PRECOMMIT, block_id.hash, block_id.parts_header)
+            defer_()
+            return
+
+        # +2/3 for the proposal block: lock it (state.go:1142-1157)
+        if rs.proposal_block is not None and rs.proposal_block.hashes_to(block_id.hash):
+            try:
+                sm.validate_block(
+                    self.state, rs.proposal_block,
+                    batch_verifier=self.verifier.commit_batch_verifier(),
+                )
+            except sm.InvalidBlockError as e:
+                raise RuntimeError(f"enter_precommit: +2/3 prevoted an invalid block: {e}")
+            rs.locked_round = round_
+            rs.locked_block = rs.proposal_block
+            rs.locked_block_parts = rs.proposal_block_parts
+            self._fire(tev.EVENT_LOCK, rs.round_state_event())
+            self.sign_add_vote(VOTE_TYPE_PRECOMMIT, block_id.hash, block_id.parts_header)
+            defer_()
+            return
+
+        # +2/3 for a block we don't have: unlock, fetch it (state.go:1160-1177)
+        self.logger.info("precommit: +2/3 for unknown block; unlocking and precommitting nil")
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+            block_id.parts_header
+        ):
+            rs.proposal_block = None
+            from tendermint_tpu.types import PartSet
+
+            rs.proposal_block_parts = PartSet.from_header(block_id.parts_header)
+        self._fire(tev.EVENT_UNLOCK, rs.round_state_event())
+        self.sign_add_vote(VOTE_TYPE_PRECOMMIT, b"", None)
+        defer_()
+
+    def enter_precommit_wait(self, height: int, round_: int) -> None:
+        """consensus/state.go:1190-1204."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round_ or (
+            rs.round_ == round_ and rs.step >= RoundStep.PRECOMMIT_WAIT
+        ):
+            return
+        precommits = rs.votes.precommits(round_)
+        if precommits is None or not precommits.has_two_thirds_any():
+            raise RuntimeError(f"enter_precommit_wait({height}/{round_}) without +2/3 precommits")
+        self.logger.info("enter_precommit_wait(%d/%d)", height, round_)
+        rs.round_ = round_
+        rs.step = RoundStep.PRECOMMIT_WAIT
+        self.new_step()
+        self._schedule_timeout(self.config.precommit(round_), height, round_, RoundStep.PRECOMMIT_WAIT)
+
+    # -- step: commit ------------------------------------------------------
+
+    def enter_commit(self, height: int, commit_round: int) -> None:
+        """consensus/state.go:1206-1258."""
+        rs = self.rs
+        if rs.height != height or rs.step >= RoundStep.COMMIT:
+            return
+        self.logger.info("enter_commit(%d/%d)", height, commit_round)
+
+        def defer_():
+            rs.step = RoundStep.COMMIT
+            rs.commit_round = commit_round
+            rs.commit_time = time.time()
+            self.new_step()
+            self.try_finalize_commit(height)
+
+        block_id = rs.votes.precommits(commit_round).two_thirds_majority()
+        if block_id is None:
+            raise RuntimeError("enter_commit expects +2/3 precommits")
+
+        # locked block takes priority if it IS the committed block
+        if rs.locked_block is not None and rs.locked_block.hashes_to(block_id.hash):
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+        if rs.proposal_block is None or not rs.proposal_block.hashes_to(block_id.hash):
+            if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                block_id.parts_header
+            ):
+                self.logger.info("commit is for a block we don't know about; fetching")
+                rs.proposal_block = None
+                from tendermint_tpu.types import PartSet
+
+                rs.proposal_block_parts = PartSet.from_header(block_id.parts_header)
+        defer_()
+
+    def try_finalize_commit(self, height: int) -> None:
+        """consensus/state.go:1236-1256."""
+        rs = self.rs
+        if rs.height != height:
+            raise RuntimeError("try_finalize_commit: height mismatch")
+        block_id = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        if block_id is None or not block_id.hash:
+            return
+        if rs.proposal_block is None or not rs.proposal_block.hashes_to(block_id.hash):
+            return  # haven't received the full block yet
+        self.finalize_commit(height)
+
+    def finalize_commit(self, height: int) -> None:
+        """Save the block, write the WAL marker, apply via the execution
+        pipeline, move to the next height (consensus/state.go:1258-1355)."""
+        rs = self.rs
+        if rs.height != height or rs.step != RoundStep.COMMIT:
+            return
+        block_id = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        block, block_parts = rs.proposal_block, rs.proposal_block_parts
+        if block_id is None or not block.hashes_to(block_id.hash):
+            raise RuntimeError("cannot finalize: proposal block does not hash to commit hash")
+        sm.validate_block(
+            self.state, block, batch_verifier=self.verifier.commit_batch_verifier()
+        )
+        self.logger.info(
+            "finalizing commit of block %d: hash=%s txs=%d",
+            height, block.hash().hex()[:12], block.header.num_txs,
+        )
+
+        fail_point()
+
+        if self.block_store.height() < block.header.height:
+            precommits = rs.votes.precommits(rs.commit_round)
+            seen_commit = precommits.make_commit()
+            self.block_store.save_block(block, block_parts, seen_commit)
+        # else: already saved (e.g. during replay); proceed to apply
+
+        fail_point()
+
+        if self.wal is not None:
+            self.wal.write_end_height(height)
+
+        fail_point()
+
+        state_copy = self.state.copy()
+        event_cache = EventCache(self.evsw) if self.evsw is not None else _NullCache()
+        sm.apply_block(
+            state_copy,
+            event_cache,
+            self.proxy_app_conn,
+            block,
+            block_parts.header(),
+            self.mempool,
+            batch_verifier=self.verifier.commit_batch_verifier(),
+        )
+
+        fail_point()
+
+        # events: NewBlock/NewBlockHeader + cached tx events, post-commit
+        if self.evsw is not None:
+            self.evsw.fire_event(tev.EVENT_NEW_BLOCK, tev.EventDataNewBlock(block))
+            self.evsw.fire_event(
+                tev.EVENT_NEW_BLOCK_HEADER, tev.EventDataNewBlockHeader(block.header)
+            )
+        event_cache.flush()
+
+        fail_point()
+
+        self.update_to_state(state_copy)
+        self.done_height.set()
+        self.done_height.clear()
+        self.schedule_round_0(self.rs)
+
+    # -- proposals ---------------------------------------------------------
+
+    def default_set_proposal(self, proposal: Proposal) -> None:
+        """consensus/state.go:1359-1392."""
+        rs = self.rs
+        if rs.proposal is not None:
+            return
+        if proposal.height != rs.height or proposal.round_ != rs.round_:
+            return
+        if rs.step == RoundStep.COMMIT:
+            return
+        if proposal.pol_round != -1 and not (0 <= proposal.pol_round < proposal.round_):
+            raise ValueError("invalid proposal POL round")
+        proposer = rs.validators.get_proposer()
+        sign_bytes = proposal.sign_bytes(self.state.chain_id)
+        if proposal.signature is None or not self.verifier.verify_one(
+            proposer.pub_key.raw, sign_bytes, proposal.signature.raw
+        ):
+            raise ValueError("invalid proposal signature")
+        rs.proposal = proposal
+        from tendermint_tpu.types import PartSet
+
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet.from_header(proposal.block_parts_header)
+        self.logger.info("received proposal %r", proposal)
+
+    def add_proposal_block_part(self, height: int, part, verify: bool) -> bool:
+        """consensus/state.go:1394-1457. Returns True if added."""
+        rs = self.rs
+        if rs.height != height:
+            return False
+        if rs.proposal_block_parts is None:
+            return False  # no proposal yet; possible DoS — drop
+        added = rs.proposal_block_parts.add_part(part)
+        if added and rs.proposal_block_parts.is_complete():
+            block_bytes = rs.proposal_block_parts.get_data()
+            rs.proposal_block = Block.from_bytes(block_bytes)
+            self.logger.info("received complete proposal block %s", rs.proposal_block.hash().hex()[:12])
+            self._fire(tev.EVENT_COMPLETE_PROPOSAL, rs.round_state_event())
+            if rs.step <= RoundStep.PROPOSE and self.is_proposal_complete():
+                self.enter_prevote(height, rs.round_)
+            elif rs.step == RoundStep.COMMIT:
+                self.try_finalize_commit(height)
+        return added
+
+    # -- votes -------------------------------------------------------------
+
+    def try_add_vote(self, vote: Vote, peer_id: str) -> None:
+        """consensus/state.go:1430-1457: conflicting votes are evidence,
+        stale/unexpected votes are ignored."""
+        try:
+            self.add_vote(vote, peer_id)
+        except ConflictingVotesError as e:
+            if (
+                self.priv_validator is not None
+                and vote.validator_address == self.priv_validator.get_address()
+            ):
+                self.logger.error(
+                    "found conflicting vote from ourselves! %d/%d/%d",
+                    vote.height, vote.round_, vote.type_,
+                )
+                return
+            # TODO evidence pool hand-off (reference punts too, state.go:1443)
+            self.logger.warning("found conflicting vote: %r vs %r", e.vote_a, e.vote_b)
+        except UnexpectedStepError:
+            pass  # vote for an old height/step — harmless
+        except VoteError as e:
+            self.logger.warning("bad vote from %s: %s", peer_id or "self", e)
+
+    def add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """consensus/state.go:1459-1565."""
+        rs = self.rs
+
+        # precommit for the previous height (late commit vote)
+        if vote.height + 1 == rs.height:
+            if not (vote.type_ == VOTE_TYPE_PRECOMMIT and rs.step == RoundStep.NEW_HEIGHT):
+                return False
+            if rs.last_commit is None:
+                return False
+            added = rs.last_commit.add_vote(vote, verifier=self.verifier.vote_verifier())
+            if added:
+                self.logger.info("added to last_commit: %r", rs.last_commit)
+                self._fire(tev.EVENT_VOTE, tev.EventDataVote(vote))
+                if self.config.skip_timeout_commit and rs.last_commit.has_all():
+                    # all votes in — skip the commit timeout (state.go:1477-1484)
+                    self.enter_new_round(rs.height, 0)
+            return added
+
+        if vote.height != rs.height:
+            self.logger.debug("vote ignored: wrong height %d vs %d", vote.height, rs.height)
+            return False
+
+        added = rs.votes.add_vote(vote, peer_id, verifier=self.verifier.vote_verifier())
+        if not added:
+            return False
+        self._fire(tev.EVENT_VOTE, tev.EventDataVote(vote))
+
+        if vote.type_ == VOTE_TYPE_PREVOTE:
+            self._handle_added_prevote(vote)
+        elif vote.type_ == VOTE_TYPE_PRECOMMIT:
+            self._handle_added_precommit(vote)
+        return added
+
+    def _handle_added_prevote(self, vote: Vote) -> None:
+        """consensus/state.go:1500-1534."""
+        rs = self.rs
+        prevotes = rs.votes.prevotes(vote.round_)
+        self.logger.debug("added prevote %r -> %r", vote, prevotes)
+
+        # unlock on a newer polka (state.go:1507-1521)
+        block_id = prevotes.two_thirds_majority()
+        if (
+            rs.locked_block is not None
+            and rs.locked_round < vote.round_ <= rs.round_
+            and block_id is not None
+            and not rs.locked_block.hashes_to(block_id.hash)
+        ):
+            self.logger.info("unlocking because of POL at round %d", vote.round_)
+            rs.locked_round = -1
+            rs.locked_block = None
+            rs.locked_block_parts = None
+            self._fire(tev.EVENT_UNLOCK, rs.round_state_event())
+
+        if rs.round_ <= vote.round_ and prevotes.has_two_thirds_any():
+            # round skip / advance (state.go:1523-1533)
+            if prevotes.has_two_thirds_majority():
+                self.enter_precommit(rs.height, vote.round_)
+            else:
+                self.enter_new_round(rs.height, vote.round_)  # if vote.round > rs.round
+                self.enter_prevote_wait(rs.height, vote.round_)
+        elif rs.proposal is not None and rs.proposal.pol_round >= 0 and rs.proposal.pol_round == vote.round_:
+            if self.is_proposal_complete():
+                self.enter_prevote(rs.height, rs.round_)
+
+    def _handle_added_precommit(self, vote: Vote) -> None:
+        """consensus/state.go:1535-1557."""
+        rs = self.rs
+        precommits = rs.votes.precommits(vote.round_)
+        self.logger.debug("added precommit %r -> %r", vote, precommits)
+        block_id = precommits.two_thirds_majority()
+        if block_id is not None:
+            # executed as defers in the reference: latest first
+            self.enter_new_round(rs.height, vote.round_)
+            self.enter_precommit(rs.height, vote.round_)
+            if block_id.hash:
+                self.enter_commit(rs.height, vote.round_)
+                if self.config.skip_timeout_commit and precommits.has_all():
+                    self.enter_new_round(rs.height, 0)
+            else:
+                self.enter_precommit_wait(rs.height, vote.round_)
+        elif rs.round_ <= vote.round_ and precommits.has_two_thirds_any():
+            self.enter_new_round(rs.height, vote.round_)
+            self.enter_precommit(rs.height, vote.round_)
+            self.enter_precommit_wait(rs.height, vote.round_)
+
+    # -- signing -----------------------------------------------------------
+
+    def sign_vote(self, type_: int, hash_: bytes, header) -> Vote:
+        """consensus/state.go:1567-1581."""
+        rs = self.rs
+        addr = self.priv_validator.get_address()
+        val_index, _ = rs.validators.get_by_address(addr)
+        from tendermint_tpu.types.block_id import PartSetHeader
+
+        vote = Vote(
+            validator_address=addr,
+            validator_index=val_index,
+            height=rs.height,
+            round_=rs.round_,
+            type_=type_,
+            block_id=BlockID(hash_, header or PartSetHeader()),
+        )
+        return self.priv_validator.sign_vote(self.state.chain_id, vote)
+
+    def sign_add_vote(self, type_: int, hash_: bytes, header) -> Vote | None:
+        """Sign and inject into our own queue (consensus/state.go:1583-1599)."""
+        rs = self.rs
+        if self.priv_validator is None or not rs.validators.has_address(
+            self.priv_validator.get_address()
+        ):
+            return None
+        try:
+            vote = self.sign_vote(type_, hash_, header)
+        except Exception:
+            if not self.replay_mode:
+                self.logger.exception("error signing vote %d/%d", rs.height, rs.round_)
+            return None
+        self.send_internal_message(MsgInfo(msgs.VoteMessage(vote)))
+        self.logger.info("signed and pushed vote %r", vote)
+        return vote
+
+
+class _NullCache:
+    def fire_event(self, event, data):
+        pass
+
+    def flush(self):
+        pass

@@ -177,8 +177,10 @@ class Mempool:
                 f"recheck response for unexpected tx {tx.hex()[:16]} != {memtx.tx.hex()[:16]}"
             )
         if not res.is_ok:
-            # tx invalidated by the last block: evict
+            # tx invalidated by the last block: evict from the pool AND the
+            # cache — it might become good again later (mempool.go:258-259)
             self.txs.remove(cursor)
+            self.cache.remove(tx)
         if cursor is self.recheck_end:
             self.recheck_cursor = None
             self.recheck_end = None

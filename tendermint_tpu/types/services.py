@@ -30,21 +30,14 @@ class MempoolI:
     def flush(self) -> None:
         raise NotImplementedError
 
-    def txs_available(self):
-        """Queue-like object signaling txs exist; None unless enabled."""
-        raise NotImplementedError
-
-    def enable_txs_available(self) -> None:
+    def enable_txs_available(self, cb: Callable | None = None) -> None:
+        """cb() fires (at most once per height) when the pool goes
+        non-empty — the no-empty-blocks signal."""
         raise NotImplementedError
 
 
 class MockMempool(MempoolI):
     """No-op mempool (types/services.go:37-48) — used by replay and tests."""
-
-    def __init__(self):
-        import queue
-
-        self._avail = queue.Queue()
 
     def lock(self) -> None:
         pass
@@ -67,10 +60,7 @@ class MockMempool(MempoolI):
     def flush(self) -> None:
         pass
 
-    def txs_available(self):
-        return self._avail
-
-    def enable_txs_available(self) -> None:
+    def enable_txs_available(self, cb: Callable | None = None) -> None:
         pass
 
 

@@ -1,0 +1,318 @@
+"""Consensus state machine tests (reference: consensus/state_test.go,
+wal/replay tests)."""
+
+import tempfile
+import threading
+import time
+
+import pytest
+
+from consensus_common import (
+    EventCollector,
+    TEST_CHAIN_ID,
+    add_votes,
+    make_cs_and_stubs,
+    new_consensus_state,
+    rand_gen_state,
+    sign_add_votes,
+    wait_for_height,
+)
+from tendermint_tpu.abci.apps.kvstore import KVStoreApp
+from tendermint_tpu.config import test_config as _test_config
+from tendermint_tpu.consensus.height_vote_set import HeightVoteSet
+from tendermint_tpu.consensus.round_state import RoundStep
+from tendermint_tpu.consensus.ticker import MockTicker, TimeoutInfo, TimeoutTicker
+from tendermint_tpu.consensus.wal import WAL, WALMessage, decode_wal_line
+from tendermint_tpu.consensus import messages as msgs
+from tendermint_tpu.types import (
+    VOTE_TYPE_PRECOMMIT,
+    VOTE_TYPE_PREVOTE,
+    BlockID,
+)
+from tendermint_tpu.types import events as tev
+
+
+class TestSingleValidator:
+    def test_makes_blocks(self):
+        """One validator, counter app: the chain advances on its own."""
+        cs, stubs, _ = make_cs_and_stubs(1)
+        blocks = EventCollector(cs.evsw, tev.EVENT_NEW_BLOCK)
+        cs.start()
+        try:
+            assert blocks.wait_for(3, timeout=15), "expected 3 blocks"
+        finally:
+            cs.stop()
+        heights = [d.block.header.height for d in blocks.items[:3]]
+        assert heights == [1, 2, 3]
+
+    def test_commits_txs_and_app_hash_advances(self):
+        cs, stubs, _ = make_cs_and_stubs(1, app=KVStoreApp())
+        blocks = EventCollector(cs.evsw, tev.EVENT_NEW_BLOCK)
+        cs.mempool.check_tx(b"x=1")
+        cs.start()
+        try:
+            assert blocks.wait_for(3, timeout=15)
+        finally:
+            cs.stop()
+        # the tx landed in an early block and the app hash is bound into a
+        # later header
+        all_txs = [tx for d in blocks.items for tx in d.block.data.txs]
+        assert b"x=1" in all_txs
+        assert blocks.items[2].block.header.app_hash != b""
+
+    def test_new_round_event_sequence(self):
+        cs, stubs, _ = make_cs_and_stubs(1)
+        rounds = EventCollector(cs.evsw, tev.EVENT_NEW_ROUND)
+        cs.start()
+        try:
+            assert rounds.wait_for(2, timeout=15)
+        finally:
+            cs.stop()
+        assert rounds.items[0].height == 1
+        assert rounds.items[1].height == 2
+
+
+class TestMultiValidatorQuorum:
+    def test_full_round_with_stub_votes(self):
+        """cs is the round-0 proposer of a 4-validator set; the other 3
+        validators' votes are injected (state_test.go FullRound2 analog)."""
+        cs, stubs, prop_idx = make_cs_and_stubs(4)
+        votes = EventCollector(cs.evsw, tev.EVENT_VOTE)
+        blocks = EventCollector(cs.evsw, tev.EVENT_NEW_BLOCK)
+        cs.start()
+        try:
+            # proposer signs its own prevote
+            assert votes.wait_for(1, timeout=10)
+            own_prevote = votes.items[0].vote
+            assert own_prevote.type_ == VOTE_TYPE_PREVOTE
+            block_id = own_prevote.block_id
+            assert block_id.hash, "proposer should prevote its own proposal"
+
+            sign_add_votes(cs, stubs, VOTE_TYPE_PREVOTE, block_id, prop_idx)
+            # +2/3 prevotes -> cs precommits
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                pcs = [v for v in votes.items if v.vote.type_ == VOTE_TYPE_PRECOMMIT]
+                if pcs:
+                    break
+                time.sleep(0.01)
+            assert pcs and pcs[0].vote.block_id.hash == block_id.hash
+
+            for s in stubs:
+                s.height, s.round_ = 1, 0
+            sign_add_votes(cs, stubs, VOTE_TYPE_PRECOMMIT, block_id, prop_idx)
+            assert blocks.wait_for(1, timeout=10), "block should commit"
+            assert blocks.items[0].block.header.height == 1
+        finally:
+            cs.stop()
+
+    def test_no_quorum_no_commit(self):
+        """With only 1/4 voting, nothing commits."""
+        cs, stubs, _ = make_cs_and_stubs(4)
+        blocks = EventCollector(cs.evsw, tev.EVENT_NEW_BLOCK)
+        cs.start()
+        try:
+            assert not blocks.wait_for(1, timeout=1.0)
+            assert cs.rs.height == 1
+        finally:
+            cs.stop()
+
+    def test_nil_prevotes_precommit_nil_and_new_round(self):
+        """+2/3 nil prevotes -> cs precommits nil; +2/3 nil precommits ->
+        next round, same height."""
+        cs, stubs, prop_idx = make_cs_and_stubs(4)
+        votes = EventCollector(cs.evsw, tev.EVENT_VOTE)
+        rounds = EventCollector(cs.evsw, tev.EVENT_NEW_ROUND)
+        cs.start()
+        try:
+            assert votes.wait_for(1, timeout=10)
+            sign_add_votes(cs, stubs, VOTE_TYPE_PREVOTE, BlockID(), prop_idx)
+            deadline = time.monotonic() + 10
+            nil_pc = None
+            while time.monotonic() < deadline and nil_pc is None:
+                for v in votes.items:
+                    if (
+                        v.vote.type_ == VOTE_TYPE_PRECOMMIT
+                        and v.vote.validator_index != prop_idx  # ours comes via event too
+                        or (v.vote.type_ == VOTE_TYPE_PRECOMMIT)
+                    ):
+                        nil_pc = v.vote
+                        break
+                time.sleep(0.01)
+            assert nil_pc is not None
+            assert not nil_pc.block_id.hash, "precommit should be nil"
+
+            sign_add_votes(cs, stubs, VOTE_TYPE_PRECOMMIT, BlockID(), prop_idx)
+            # +2/3 nil precommits → precommit-wait timeout → round 1
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and cs.rs.round_ == 0:
+                time.sleep(0.01)
+            assert cs.rs.height == 1
+            assert cs.rs.round_ >= 1
+        finally:
+            cs.stop()
+
+
+class TestHeightVoteSet:
+    def test_catchup_round_budget(self):
+        state, pvs = rand_gen_state(2)
+        hvs = HeightVoteSet(TEST_CHAIN_ID, 1, state.validators)
+        from consensus_common import ValidatorStub
+
+        stub = ValidatorStub(pvs[0], 0)
+        added_rounds = []
+        for r in (5, 6, 7):
+            stub.round_ = r
+            v = stub.sign_vote(VOTE_TYPE_PREVOTE, TEST_CHAIN_ID, BlockID())
+            added_rounds.append(hvs.add_vote(v, peer_id="peerX"))
+        # two catchup rounds allowed, third dropped
+        assert added_rounds == [True, True, False]
+
+    def test_pol_info(self):
+        state, pvs = rand_gen_state(1)
+        hvs = HeightVoteSet(TEST_CHAIN_ID, 1, state.validators)
+        from consensus_common import ValidatorStub
+
+        stub = ValidatorStub(pvs[0], 0)
+        assert hvs.pol_info() == (-1, None)
+        bid = BlockID(b"\x01" * 20)
+        v = stub.sign_vote(VOTE_TYPE_PREVOTE, TEST_CHAIN_ID, bid)
+        hvs.add_vote(v, peer_id="")
+        r, pol = hvs.pol_info()
+        assert r == 0 and pol.hash == bid.hash
+
+
+class TestTicker:
+    def test_fires_after_duration(self):
+        t = TimeoutTicker()
+        t.start()
+        t.schedule_timeout(TimeoutInfo(0.05, 1, 0, RoundStep.PROPOSE))
+        ti = t.chan.get(timeout=2)
+        assert ti.height == 1 and ti.step == RoundStep.PROPOSE
+        t.stop()
+
+    def test_newer_replaces_older(self):
+        t = TimeoutTicker()
+        t.start()
+        t.schedule_timeout(TimeoutInfo(0.5, 1, 0, RoundStep.PROPOSE))
+        t.schedule_timeout(TimeoutInfo(0.05, 1, 0, RoundStep.PREVOTE_WAIT))
+        ti = t.chan.get(timeout=2)
+        assert ti.step == RoundStep.PREVOTE_WAIT
+        t.stop()
+
+    def test_stale_ignored(self):
+        t = TimeoutTicker()
+        t.start()
+        t.schedule_timeout(TimeoutInfo(0.05, 5, 0, RoundStep.PROPOSE))
+        t.schedule_timeout(TimeoutInfo(0.01, 1, 0, RoundStep.PROPOSE))  # stale
+        ti = t.chan.get(timeout=2)
+        assert ti.height == 5
+        t.stop()
+
+
+class TestWAL:
+    def test_roundtrip_and_endheight_search(self, tmp_path):
+        wal = WAL(str(tmp_path / "wal"))
+        wal.start()
+        wal.save(WALMessage.timeout(TimeoutInfo(1.0, 1, 0, RoundStep.PROPOSE)))
+        wal.write_end_height(1)
+        vote_msg = None
+        state, pvs = rand_gen_state(1)
+        from consensus_common import ValidatorStub
+
+        stub = ValidatorStub(pvs[0], 0)
+        v = stub.sign_vote(VOTE_TYPE_PREVOTE, TEST_CHAIN_ID, BlockID())
+        wal.save(WALMessage.msg_info(msgs.VoteMessage(v), "peerA"))
+        wal.stop()
+
+        wal2 = WAL(str(tmp_path / "wal"))
+        lines = wal2.lines_after_height(1)
+        assert lines is not None
+        entries = [decode_wal_line(ln) for ln in lines if ln.strip()]
+        kinds = [e[0] for e in entries if e]
+        assert "msg_info" in kinds
+        decoded = next(e for e in entries if e[0] == "msg_info")
+        assert decoded[1].vote.signature == v.signature
+        assert decoded[2] == "peerA"
+        # marker for an uncommitted height: not found
+        assert wal2.lines_after_height(7) is None
+
+    def test_fresh_wal_has_height0_marker(self, tmp_path):
+        wal = WAL(str(tmp_path / "wal"))
+        wal.start()
+        wal.stop()
+        wal2 = WAL(str(tmp_path / "wal"))
+        assert wal2.lines_after_height(0) == []
+
+
+class TestCrashRecovery:
+    def _run_node(self, root, app, state_db, store_db, n_blocks, chain_db_doc):
+        """Run a 1-validator node until n_blocks commit; leave WAL+dbs."""
+        from tendermint_tpu.abci.client import LocalClient
+        from tendermint_tpu.blockchain.store import BlockStore
+        from tendermint_tpu.consensus.state import ConsensusState
+        from tendermint_tpu.libs.events import EventSwitch
+        from tendermint_tpu.mempool import Mempool
+        from tendermint_tpu.proxy.app_conn import AppConnConsensus, AppConnMempool
+        from tendermint_tpu.state.state import State
+        from tendermint_tpu.types import PrivValidatorFS
+
+        cfg = _test_config()
+        cfg.set_root(root)
+        doc = chain_db_doc
+        state = State.get_state(state_db, doc)
+        pv = PrivValidatorFS.load(root + "/priv_validator.json")
+        mtx = threading.RLock()
+        mp = Mempool(cfg.mempool, AppConnMempool(LocalClient(app, mtx)))
+        store = BlockStore(store_db)
+        evsw = EventSwitch()
+        evsw.start()
+        cs = ConsensusState(
+            cfg.consensus, state, AppConnConsensus(LocalClient(app, mtx)), store, mp
+        )
+        cs.set_event_switch(evsw)
+        cs.set_priv_validator(pv)
+        blocks = EventCollector(evsw, tev.EVENT_NEW_BLOCK)
+        cs.start()
+        ok = blocks.wait_for(n_blocks, timeout=20)
+        cs.stop()
+        assert ok
+        return cs
+
+    def test_restart_continues_chain(self, tmp_path):
+        """Stop after 2 blocks; restart with fresh app; handshake replays
+        the chain into the app and consensus continues from height 3."""
+        from tendermint_tpu.config import reset_test_root
+        from tendermint_tpu.consensus.replay import Handshaker
+        from tendermint_tpu.libs.db import MemDB
+        from tendermint_tpu.proxy.multi_app_conn import AppConns
+        from tendermint_tpu.proxy.client_creator import LocalClientCreator
+        from tendermint_tpu.state.state import State
+        from tendermint_tpu.types import GenesisDoc
+
+        root = str(tmp_path / "node")
+        reset_test_root(root, chain_id="crash-test")
+        doc = GenesisDoc.from_file(root + "/genesis.json")
+        state_db, store_db = MemDB(), MemDB()
+        app = KVStoreApp()
+
+        cs1 = self._run_node(root, app, state_db, store_db, 2, doc)
+        committed_height = cs1.state.last_block_height
+        assert committed_height >= 2
+        committed_app_hash = cs1.state.app_hash
+
+        # "crash": new app instance knows nothing; handshake replays it
+        app2 = KVStoreApp()
+        state2 = State.get_state(state_db, doc)
+        from tendermint_tpu.blockchain.store import BlockStore
+
+        store2 = BlockStore(store_db)
+        hs = Handshaker(state2, store2)
+        conns = AppConns(LocalClientCreator(app2), hs)
+        conns.start()
+        assert hs.n_blocks >= 1 or app2.height > 0
+        assert app2.app_hash == committed_app_hash
+
+        # consensus resumes and extends the chain
+        cs2 = self._run_node(root, app2, state_db, store_db, 1, doc)
+        assert cs2.state.last_block_height > committed_height

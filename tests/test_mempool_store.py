@@ -48,11 +48,14 @@ class TestMempool:
     def test_bad_tx_rejected_and_cache_evicted(self):
         app = CounterApp(serial=True)
         mp = _mk_mempool(app)
+        mp.check_tx(_tx(5))  # ok: 5 >= check_count 0; check_count -> 1
+        mp.check_tx(_tx(0))  # rejected: 0 < check_count 1
+        assert mp.size() == 1
+        assert mp.reap(-1) == [_tx(5)]
+        # rejection evicted the cache entry, so resubmission is allowed
+        # (not TxInCacheError) and now still fails CheckTx
         mp.check_tx(_tx(0))
-        # serial counter app rejects out-of-order nonce
-        app.set_option("serial", "on")
-        mp.check_tx(_tx(5))
-        assert mp.size() == 2  # checktx passes (5 >= 0 txcount)
+        assert mp.size() == 1
 
     def test_update_removes_committed_and_rechecks(self):
         mp = _mk_mempool(KVStoreApp())
