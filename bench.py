@@ -1,0 +1,95 @@
+"""Headline benchmark: VerifyCommit throughput (BASELINE.md north star).
+
+Measures batched Ed25519 commit verification — the reference's hottest
+path (types/validator_set.go:220-264: N sequential verifies per block) —
+on the available accelerator, against our own CPU reference loop (the
+Go-equivalent baseline; upstream publishes no numbers, BASELINE.md).
+
+Prints ONE JSON line:
+  {"metric": "verify_commit_sigs_per_sec", "value": N, "unit": "sigs/s",
+   "vs_baseline": N / cpu_sigs_per_sec}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+
+BATCH = int(os.environ.get("BENCH_BATCH", "1024"))
+CPU_SAMPLE = int(os.environ.get("BENCH_CPU_SAMPLE", "256"))
+REPS = int(os.environ.get("BENCH_REPS", "5"))
+
+
+def _make_items(n: int):
+    from tendermint_tpu.crypto import ed25519 as ed
+
+    # 64 distinct validators signing vote-like canonical messages, cycled
+    # to n — matches a real commit (few keys, many (H,R) messages).
+    seeds = [bytes([i]) * 32 for i in range(64)]
+    pubs = [ed.public_key(s) for s in seeds]
+    items = []
+    for i in range(n):
+        k = i % 64
+        msg = (
+            b'{"chain_id":"bench","vote":{"block_id":{},"height":%d,'
+            b'"round":0,"type":2,"validator_index":%d}}' % (1 + i // 64, k)
+        )
+        items.append((pubs[k], msg, ed.sign(seeds[k], msg)))
+    return items
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from tendermint_tpu.crypto import ed25519 as ed_cpu
+    from tendermint_tpu.ops import ed25519 as ops_ed
+
+    items = _make_items(BATCH)
+
+    # --- CPU baseline: the reference-faithful sequential loop ------------
+    t0 = time.perf_counter()
+    for pub, msg, sig in items[:CPU_SAMPLE]:
+        assert ed_cpu.verify(pub, msg, sig)
+    cpu_rate = CPU_SAMPLE / (time.perf_counter() - t0)
+
+    # --- accelerator: one warmup (compile) then timed reps ---------------
+    ok = ops_ed.verify_batch(items)
+    assert bool(np.all(ok)), "warmup verify failed"
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        ok = ops_ed.verify_batch(items)
+        dt = time.perf_counter() - t0
+        assert bool(np.all(ok))
+        best = min(best, dt)
+    rate = BATCH / best
+
+    print(
+        json.dumps(
+            {
+                "metric": "verify_commit_sigs_per_sec",
+                "value": round(rate, 1),
+                "unit": "sigs/s",
+                "vs_baseline": round(rate / cpu_rate, 2),
+                "detail": {
+                    "batch": BATCH,
+                    "best_batch_ms": round(best * 1e3, 2),
+                    "cpu_sigs_per_sec": round(cpu_rate, 1),
+                    "platform": jax.devices()[0].platform,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,52 @@
+"""Chaos-testing stream wrapper (reference: p2p/fuzz.go).
+
+Randomly drops or delays reads/writes so reactor code is exercised under
+packet loss and latency without a real flaky network.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+
+class FuzzedStream:
+    def __init__(
+        self,
+        stream,
+        prob_drop_rw: float = 0.0,
+        prob_sleep: float = 0.0,
+        max_delay: float = 0.1,
+        seed: int | None = None,
+    ):
+        self.stream = stream
+        self.prob_drop_rw = prob_drop_rw
+        self.prob_sleep = prob_sleep
+        self.max_delay = max_delay
+        self._rng = random.Random(seed)
+
+    def _fuzz(self) -> bool:
+        """True => drop this op."""
+        if self._rng.random() < self.prob_drop_rw:
+            return True
+        if self._rng.random() < self.prob_sleep:
+            time.sleep(self._rng.random() * self.max_delay)
+        return False
+
+    def read(self, n: int) -> bytes:
+        # dropping reads would desync framing; only delay them
+        if self._rng.random() < self.prob_sleep:
+            time.sleep(self._rng.random() * self.max_delay)
+        return self.stream.read(n)
+
+    def write(self, data: bytes) -> None:
+        if self._fuzz():
+            return  # silently dropped
+        self.stream.write(data)
+
+    def close(self) -> None:
+        self.stream.close()
+
+    def remote_addr(self) -> str:
+        inner = getattr(self.stream, "remote_addr", None)
+        return inner() if inner else "fuzzed"

@@ -1,0 +1,53 @@
+"""TCP listener (reference: p2p/listener.go, minus UPnP — there is no
+NAT to traverse in the deployment targets; external address detection
+falls back to the bound interface address)."""
+
+from __future__ import annotations
+
+import socket
+
+from tendermint_tpu.p2p.netaddress import NetAddress
+
+
+class Listener:
+    def __init__(self, laddr: str):
+        addr = NetAddress.from_string(laddr) if laddr else NetAddress("0.0.0.0", 0)
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((addr.ip, addr.port))
+        self.sock.listen(64)
+        host, port = self.sock.getsockname()[:2]
+        self._internal = NetAddress(host, port)
+        self._closed = False
+
+    def internal_address(self) -> NetAddress:
+        return self._internal
+
+    def external_address(self) -> NetAddress:
+        """Best-effort: the address a remote would dial. With a wildcard
+        bind, use the primary interface address."""
+        if self._internal.ip not in ("0.0.0.0", "::"):
+            return self._internal
+        try:
+            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            probe.connect(("10.255.255.255", 1))
+            ip = probe.getsockname()[0]
+            probe.close()
+        except OSError:
+            ip = "127.0.0.1"
+        return NetAddress(ip, self._internal.port)
+
+    def accept(self) -> socket.socket | None:
+        try:
+            sock, _ = self.sock.accept()
+            return sock
+        except OSError:
+            return None  # closed
+
+    def stop(self) -> None:
+        if not self._closed:
+            self._closed = True
+            try:
+                self.sock.close()
+            except OSError:
+                pass

@@ -1,0 +1,78 @@
+"""Node identity and compatibility handshake data (reference: p2p/types.go).
+
+NodeInfo is exchanged unencrypted-length-prefixed right after the secret
+handshake; CompatibleWith gates the peering (p2p/types.go:25-56).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from tendermint_tpu.crypto.keys import PubKeyEd25519
+from tendermint_tpu.version import PROTOCOL_VERSION
+
+MAX_NODE_INFO_SIZE = 10240
+
+
+@dataclass
+class NodeInfo:
+    pub_key: PubKeyEd25519
+    moniker: str
+    network: str  # chain id
+    version: str  # "protocol/software", compat gated on protocol part
+    remote_addr: str = ""
+    listen_addr: str = ""
+    channels: bytes = b""  # channel ids this node serves
+    other: list = field(default_factory=list)
+
+    def id(self) -> str:
+        """Peer key: hex of the node pubkey address."""
+        return self.pub_key.address().hex()
+
+    def compatible_with(self, other: "NodeInfo") -> str | None:
+        """None if compatible, else a human-readable reason
+        (p2p/types.go:28-56: same protocol version, same network)."""
+        mine = self.version.split("/", 1)[0]
+        theirs = other.version.split("/", 1)[0]
+        if mine != theirs:
+            return f"protocol version mismatch: {mine} vs {theirs}"
+        if self.network != other.network:
+            return f"network mismatch: {self.network} vs {other.network}"
+        return None
+
+    def to_json(self) -> dict:
+        return {
+            "pub_key": self.pub_key.to_json(),
+            "moniker": self.moniker,
+            "network": self.network,
+            "version": self.version,
+            "remote_addr": self.remote_addr,
+            "listen_addr": self.listen_addr,
+            "channels": self.channels.hex(),
+            "other": self.other,
+        }
+
+    @classmethod
+    def from_json(cls, o: dict) -> "NodeInfo":
+        return cls(
+            pub_key=PubKeyEd25519.from_json(o["pub_key"]),
+            moniker=o["moniker"],
+            network=o["network"],
+            version=o["version"],
+            remote_addr=o.get("remote_addr", ""),
+            listen_addr=o.get("listen_addr", ""),
+            channels=bytes.fromhex(o.get("channels", "")),
+            other=o.get("other", []),
+        )
+
+    def encode(self) -> bytes:
+        return json.dumps(self.to_json(), sort_keys=True).encode()
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "NodeInfo":
+        return cls.from_json(json.loads(raw.decode()))
+
+
+def default_version(software_version: str) -> str:
+    return f"{PROTOCOL_VERSION}/{software_version}"

@@ -1,0 +1,147 @@
+"""Peer: one remote node (reference: p2p/peer.go).
+
+Connection layering: raw stream -> [fuzz wrapper] -> [secret connection]
+-> NodeInfo handshake -> MConnection. AuthEnc defaults on
+(p2p/peer.go:54-77).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.p2p.conn import ChannelDescriptor, MConnConfig, MConnection
+from tendermint_tpu.p2p.node_info import MAX_NODE_INFO_SIZE, NodeInfo
+
+_HS_LEN = struct.Struct(">I")
+
+
+@dataclass
+class PeerConfig:
+    """p2p/peer.go:54-77."""
+
+    auth_enc: bool = True
+    handshake_timeout: float = 20.0
+    dial_timeout: float = 3.0
+    fuzz: bool = False
+    fuzz_config: dict = field(default_factory=dict)
+    mconfig: MConnConfig = field(default_factory=MConnConfig)
+
+
+def exchange_node_info(stream, our_info: NodeInfo, timeout: float) -> NodeInfo:
+    """Concurrent length-prefixed NodeInfo swap (p2p/peer.go:159-200).
+    Write first, then read — both sides do the same, so no deadlock
+    (payloads are far below socket buffer sizes)."""
+    raw = our_info.encode()
+    stream.write(_HS_LEN.pack(len(raw)) + raw)
+
+    def read_exact(n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = stream.read(n - len(buf))
+            if not chunk:
+                raise ConnectionError("stream closed during node-info handshake")
+            buf += chunk
+        return bytes(buf)
+
+    (ln,) = _HS_LEN.unpack(read_exact(_HS_LEN.size))
+    if ln > MAX_NODE_INFO_SIZE:
+        raise ValueError(f"node info too large: {ln}")
+    return NodeInfo.decode(read_exact(ln))
+
+
+class Peer(BaseService):
+    def __init__(
+        self,
+        stream,
+        outbound: bool,
+        channel_descs: list[ChannelDescriptor],
+        on_receive,  # (peer, ch_id, msg_bytes)
+        on_error,  # (peer, exc)
+        config: PeerConfig,
+        node_priv_key,
+        persistent: bool = False,
+    ):
+        super().__init__(name="peer")
+        self.outbound = outbound
+        self.persistent = persistent
+        self.config = config
+        self.node_info: NodeInfo | None = None
+        self.data: dict = {}  # per-peer reactor state (e.g. PeerState)
+
+        if config.fuzz:
+            from tendermint_tpu.p2p.fuzz import FuzzedStream
+
+            stream = FuzzedStream(stream, **config.fuzz_config)
+        if config.auth_enc:
+            from tendermint_tpu.p2p.secret_connection import SecretConnection
+
+            stream = SecretConnection(stream, node_priv_key)
+        self.stream = stream
+
+        self.mconn = MConnection(
+            stream,
+            channel_descs,
+            on_receive=lambda ch, msg: on_receive(self, ch, msg),
+            on_error=lambda exc: on_error(self, exc),
+            config=config.mconfig,
+        )
+
+    # -- handshake (before start) -----------------------------------------
+
+    def handshake(self, our_info: NodeInfo) -> NodeInfo:
+        self.node_info = exchange_node_info(
+            self.stream, our_info, self.config.handshake_timeout
+        )
+        if self.config.auth_enc:
+            # the identity that signed the secret-connection challenge must
+            # be the identity claimed in NodeInfo (p2p/peer.go:181-191)
+            if self.stream.remote_pubkey().raw != self.node_info.pub_key.raw:
+                raise ConnectionError("node info pubkey != secret conn pubkey")
+        self.mconn._name = f"mconn:{self.id()[:8]}"
+        return self.node_info
+
+    # -- identity ----------------------------------------------------------
+
+    def id(self) -> str:
+        return self.node_info.id() if self.node_info else "?"
+
+    def pub_key(self):
+        if self.config.auth_enc:
+            return self.stream.remote_pubkey()
+        return self.node_info.pub_key if self.node_info else None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def on_start(self) -> None:
+        self.mconn.start()
+
+    def on_stop(self) -> None:
+        self.mconn.stop()
+
+    # -- messaging ---------------------------------------------------------
+
+    def send(self, ch_id: int, msg: bytes) -> bool:
+        return self.mconn.send(ch_id, msg)
+
+    def try_send(self, ch_id: int, msg: bytes) -> bool:
+        return self.mconn.try_send(ch_id, msg)
+
+    def can_send(self, ch_id: int) -> bool:
+        return self.mconn.can_send(ch_id)
+
+    def get(self, key: str):
+        return self.data.get(key)
+
+    def set(self, key: str, value) -> None:
+        self.data[key] = value
+
+    def status(self) -> dict:
+        st = self.mconn.status()
+        st["node_info"] = self.node_info.to_json() if self.node_info else None
+        return st
+
+    def __repr__(self) -> str:
+        arrow = "->" if self.outbound else "<-"
+        return f"Peer{{{arrow} {self.id()[:12]}}}"

@@ -1,0 +1,153 @@
+"""Authenticated-encryption transport (reference: p2p/secret_connection.go,
+spec docs/specification/secure-p2p.rst).
+
+Same STS-like shape as the reference, modern primitives (this framework
+defines its own wire protocol, so no nacl-secretbox compatibility):
+
+1. exchange 32-byte ephemeral X25519 pubkeys in the clear;
+2. shared = X25519(eph_priv, remote_eph_pub); per-direction keys via
+   HKDF-SHA256 over the sorted ephemeral pubkeys (lo||hi transcript) —
+   the lexicographically-lower side sends with key1, the higher with key2;
+3. all further traffic is ChaCha20-Poly1305 frames with counter nonces
+   (distinct per direction via the key split);
+4. challenge = SHA256(lo_eph || hi_eph); both sides send
+   (node_pubkey, ed25519_sig(challenge)) over the encrypted channel and
+   verify — authenticating the node identity key (secret_connection.go:49-101).
+
+Frames: [len:2 BE][ciphertext = plaintext+16B tag], plaintext <=1024B.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import struct
+import threading
+
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+from tendermint_tpu.crypto.keys import PrivKeyEd25519, PubKeyEd25519, SignatureEd25519
+
+DATA_MAX_SIZE = 1024
+_LEN = struct.Struct(">H")
+
+
+def _hkdf(secret: bytes, info: bytes, length: int = 64) -> bytes:
+    """HKDF-SHA256 (extract with zero salt + expand)."""
+    prk = hashlib.sha256(b"\x00" * 32 + secret).digest()
+    out, t, i = b"", b"", 1
+    while len(out) < length:
+        t = hashlib.sha256(prk + t + info + bytes([i])).digest()
+        out += t
+        i += 1
+    return out[:length]
+
+
+class SecretConnection:
+    """Wraps a stream; satisfies the stream interface itself."""
+
+    def __init__(self, stream, priv_key: PrivKeyEd25519):
+        self.stream = stream
+        eph_priv = X25519PrivateKey.generate()
+        eph_pub = eph_priv.public_key().public_bytes_raw()
+
+        # 1. ephemeral exchange (concurrent-safe: write then read)
+        stream.write(eph_pub)
+        remote_eph = self._read_exact(32)
+
+        shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(remote_eph))
+        lo, hi = sorted((eph_pub, remote_eph))
+        keys = _hkdf(shared, b"TENDERMINT_TPU_SECRET_CONNECTION" + lo + hi)
+        if eph_pub == lo:
+            send_key, recv_key = keys[:32], keys[32:]
+        else:
+            send_key, recv_key = keys[32:], keys[:32]
+        self._send_aead = ChaCha20Poly1305(send_key)
+        self._recv_aead = ChaCha20Poly1305(recv_key)
+        self._send_nonce = 0
+        self._recv_nonce = 0
+        self._wmtx = threading.Lock()
+        self._rmtx = threading.Lock()
+        self._recv_buf = b""
+
+        # 4. authenticate node keys over the encrypted channel
+        challenge = hashlib.sha256(lo + hi).digest()
+        auth = json.dumps(
+            {
+                "pub_key": priv_key.pub_key().to_json(),
+                "sig": priv_key.sign(challenge).to_json(),
+            }
+        ).encode()
+        self.write(auth)
+        remote_auth = json.loads(self._read_msg().decode())
+        remote_pub = PubKeyEd25519.from_json(remote_auth["pub_key"])
+        remote_sig = SignatureEd25519.from_json(remote_auth["sig"])
+        if not remote_pub.verify_bytes(challenge, remote_sig):
+            stream.close()
+            raise ConnectionError("secret connection: challenge signature invalid")
+        self._remote_pubkey = remote_pub
+
+    def remote_pubkey(self) -> PubKeyEd25519:
+        return self._remote_pubkey
+
+    # -- framing -----------------------------------------------------------
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self.stream.read(n - len(buf))
+            if not chunk:
+                raise ConnectionError("stream closed during secret handshake/read")
+            buf += chunk
+        return bytes(buf)
+
+    def _nonce12(self, counter: int) -> bytes:
+        return counter.to_bytes(12, "big")
+
+    def _write_frame(self, chunk: bytes) -> None:
+        ct = self._send_aead.encrypt(self._nonce12(self._send_nonce), chunk, None)
+        self._send_nonce += 1
+        self.stream.write(_LEN.pack(len(ct)) + ct)
+
+    def _read_msg(self) -> bytes:
+        """One frame's plaintext."""
+        (clen,) = _LEN.unpack(self._read_exact(_LEN.size))
+        ct = self._read_exact(clen)
+        try:
+            pt = self._recv_aead.decrypt(self._nonce12(self._recv_nonce), ct, None)
+        except Exception as exc:
+            # tampering / desync is unrecoverable: poison the connection
+            self.stream.close()
+            raise ConnectionError("secret connection: frame authentication failed") from exc
+        self._recv_nonce += 1
+        return pt
+
+    # -- stream interface --------------------------------------------------
+
+    def write(self, data: bytes) -> None:
+        with self._wmtx:
+            for off in range(0, len(data), DATA_MAX_SIZE):
+                self._write_frame(data[off : off + DATA_MAX_SIZE])
+            if not data:
+                self._write_frame(b"")
+
+    def read(self, n: int) -> bytes:
+        with self._rmtx:
+            if not self._recv_buf:
+                try:
+                    self._recv_buf = self._read_msg()
+                except ConnectionError:
+                    return b""
+            out, self._recv_buf = self._recv_buf[:n], self._recv_buf[n:]
+            return out
+
+    def close(self) -> None:
+        self.stream.close()
+
+    def remote_addr(self) -> str:
+        inner = getattr(self.stream, "remote_addr", None)
+        return inner() if inner else "secret"

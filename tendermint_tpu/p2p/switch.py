@@ -1,0 +1,340 @@
+"""Switch: reactor registry + peer lifecycle (reference: p2p/switch.go).
+
+Reactors register channel descriptors; the switch owns dialing, accepting,
+handshakes, peer filters, broadcast, and persistent-peer reconnection
+(switch.go:15-18, 409-438: 30 attempts x 3s). `make_connected_switches`
+wires N switches over in-process pipes for deterministic multi-node tests
+(switch.go:502-547).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from tendermint_tpu.crypto.keys import PrivKeyEd25519, gen_priv_key_ed25519
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.p2p.conn import ChannelDescriptor
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.p2p.peer import Peer, PeerConfig
+from tendermint_tpu.p2p.peer_set import PeerSet
+from tendermint_tpu.p2p.stream import SocketStream, pipe_pair
+
+RECONNECT_ATTEMPTS = 30
+RECONNECT_INTERVAL = 3.0
+
+
+class Reactor:
+    """Interface (switch.go:20-28). Subclasses are BaseServices too."""
+
+    def set_switch(self, sw: "Switch") -> None:
+        self.switch = sw
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        raise NotImplementedError
+
+    def add_peer(self, peer: Peer) -> None:
+        pass
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        pass
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        pass
+
+
+class Switch(BaseService):
+    def __init__(
+        self,
+        config=None,
+        peer_config: PeerConfig | None = None,
+        node_priv_key: PrivKeyEd25519 | None = None,
+    ):
+        super().__init__(name="p2p.switch")
+        self.config = config
+        self.peer_config = peer_config or PeerConfig()
+        self.reactors: dict[str, Reactor] = {}
+        self.ch_descs: list[ChannelDescriptor] = []
+        self.reactors_by_ch: dict[int, Reactor] = {}
+        self.peers = PeerSet()
+        self.dialing: set[str] = set()
+        self.node_priv_key = node_priv_key or gen_priv_key_ed25519()
+        self.node_info: NodeInfo | None = None
+        self.listeners: list = []
+        self.filter_conn_by_addr = None  # callables raising on rejection
+        self.filter_conn_by_pubkey = None
+        self._reconnecting: set[str] = set()
+        self._mtx = threading.Lock()
+
+    # -- registry (before start) ------------------------------------------
+
+    def add_reactor(self, name: str, reactor: Reactor) -> Reactor:
+        for desc in reactor.get_channels():
+            if desc.id in self.reactors_by_ch:
+                raise ValueError(f"channel {desc.id:#x} already registered")
+            self.ch_descs.append(desc)
+            self.reactors_by_ch[desc.id] = reactor
+        self.reactors[name] = reactor
+        reactor.set_switch(self)
+        return reactor
+
+    def reactor(self, name: str) -> Reactor | None:
+        return self.reactors.get(name)
+
+    def set_node_info(self, info: NodeInfo) -> None:
+        self.node_info = info
+        info.channels = bytes(sorted(d.id for d in self.ch_descs))
+
+    def set_node_key(self, priv: PrivKeyEd25519) -> None:
+        self.node_priv_key = priv
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def on_start(self) -> None:
+        if self.node_info is None:
+            from tendermint_tpu.p2p.node_info import default_version
+            from tendermint_tpu.version import VERSION
+
+            self.set_node_info(
+                NodeInfo(
+                    pub_key=self.node_priv_key.pub_key(),
+                    moniker="anonymous",
+                    network="",
+                    version=default_version(VERSION),
+                )
+            )
+        for reactor in self.reactors.values():
+            reactor.start()
+        for listener in self.listeners:
+            t = threading.Thread(
+                target=self._listener_routine, args=(listener,), daemon=True,
+                name="switch.listener",
+            )
+            t.start()
+
+    def on_stop(self) -> None:
+        for listener in self.listeners:
+            try:
+                listener.stop()
+            except Exception:
+                pass
+        for peer in self.peers.list():
+            self._stop_and_remove(peer, "switch stopping")
+        for reactor in self.reactors.values():
+            reactor.stop()
+
+    # -- listeners ---------------------------------------------------------
+
+    def add_listener(self, listener) -> None:
+        self.listeners.append(listener)
+
+    def _listener_routine(self, listener) -> None:
+        while self.is_running():
+            sock = listener.accept()
+            if sock is None:
+                return
+            try:
+                self.add_peer_from_stream(SocketStream(sock), outbound=False)
+            except Exception as exc:  # noqa: BLE001 — one bad peer can't kill accept
+                self.logger.info("inbound peer rejected: %s", exc)
+
+    # -- peer admission -----------------------------------------------------
+
+    def add_peer_from_stream(
+        self, stream, outbound: bool, persistent: bool = False
+    ) -> Peer:
+        peer = Peer(
+            stream,
+            outbound=outbound,
+            channel_descs=self.ch_descs,
+            on_receive=self._on_peer_receive,
+            on_error=self._on_peer_error,
+            config=self.peer_config,
+            node_priv_key=self.node_priv_key,
+            persistent=persistent,
+        )
+        return self.add_peer(peer)
+
+    def add_peer(self, peer: Peer) -> Peer:
+        """Handshake + filter + register + start (switch.go:216-260)."""
+        if self.filter_conn_by_pubkey and self.peer_config.auth_enc:
+            self.filter_conn_by_pubkey(peer.pub_key())
+        info = peer.handshake(self.node_info)
+        if info.pub_key.raw == self.node_info.pub_key.raw:
+            peer.stream.close()
+            raise ConnectionError("refusing self-connection")
+        reason = self.node_info.compatible_with(info)
+        if reason is not None:
+            peer.stream.close()
+            raise ConnectionError(f"incompatible peer: {reason}")
+        if not self.peers.add(peer):
+            peer.stream.close()
+            raise ConnectionError(f"duplicate peer {peer.id()[:12]}")
+        try:
+            peer.start()
+            for reactor in self.reactors.values():
+                reactor.add_peer(peer)
+        except Exception:
+            self.peers.remove(peer)
+            peer.stop()
+            raise
+        self.logger.info("added peer %s", peer)
+        return peer
+
+    def _on_peer_receive(self, peer: Peer, ch_id: int, msg_bytes: bytes) -> None:
+        reactor = self.reactors_by_ch.get(ch_id)
+        if reactor is not None:
+            reactor.receive(ch_id, peer, msg_bytes)
+
+    def _on_peer_error(self, peer: Peer, exc: Exception) -> None:
+        self.stop_peer_for_error(peer, exc)
+
+    # -- dialing ------------------------------------------------------------
+
+    def dial_peer_with_address(
+        self, addr: NetAddress, persistent: bool = False
+    ) -> Peer:
+        key = str(addr)
+        with self._mtx:
+            if key in self.dialing:
+                raise ConnectionError(f"already dialing {key}")
+            self.dialing.add(key)
+        try:
+            if self.filter_conn_by_addr:
+                self.filter_conn_by_addr(addr)
+            sock = socket.create_connection(
+                addr.dial_string(), timeout=self.peer_config.dial_timeout
+            )
+            sock.settimeout(None)
+            return self.add_peer_from_stream(
+                SocketStream(sock), outbound=True, persistent=persistent
+            )
+        finally:
+            with self._mtx:
+                self.dialing.discard(key)
+
+    def dial_seeds(self, seeds: list[str], addr_book=None) -> None:
+        """Dial in random order, in parallel (switch.go:297-338)."""
+        import random
+
+        addrs = [NetAddress.from_string(s) for s in seeds]
+        if addr_book is not None:
+            for a in addrs:
+                if not a.local():
+                    addr_book.add_address(a, a)
+        random.shuffle(addrs)
+        for a in addrs:
+            threading.Thread(
+                target=self._dial_seed, args=(a,), daemon=True, name="switch.dial"
+            ).start()
+
+    def _dial_seed(self, addr: NetAddress) -> None:
+        try:
+            self.dial_peer_with_address(addr, persistent=True)
+        except Exception as exc:  # noqa: BLE001
+            self.logger.info("error dialing seed %s: %s", addr, exc)
+
+    # -- removal / errors ---------------------------------------------------
+
+    def _stop_and_remove(self, peer: Peer, reason) -> None:
+        self.peers.remove(peer)
+        peer.stop()
+        for reactor in self.reactors.values():
+            reactor.remove_peer(peer, reason)
+
+    def stop_peer_for_error(self, peer: Peer, reason) -> None:
+        if not self.peers.has(peer.id()):
+            return
+        self.logger.info("stopping peer %s for error: %s", peer, reason)
+        self._stop_and_remove(peer, reason)
+        if peer.persistent and self.is_running():
+            info = peer.node_info
+            if info and info.remote_addr:
+                threading.Thread(
+                    target=self._reconnect_routine,
+                    args=(info.remote_addr,),
+                    daemon=True,
+                    name="switch.reconnect",
+                ).start()
+
+    def stop_peer_gracefully(self, peer: Peer) -> None:
+        self._stop_and_remove(peer, None)
+
+    def _reconnect_routine(self, addr_str: str) -> None:
+        with self._mtx:
+            if addr_str in self._reconnecting:
+                return
+            self._reconnecting.add(addr_str)
+        try:
+            addr = NetAddress.from_string(addr_str)
+            for i in range(RECONNECT_ATTEMPTS):
+                if not self.is_running():
+                    return
+                time.sleep(RECONNECT_INTERVAL)
+                try:
+                    self.dial_peer_with_address(addr, persistent=True)
+                    return
+                except Exception as exc:  # noqa: BLE001
+                    self.logger.info(
+                        "reconnect to %s attempt %d failed: %s", addr_str, i + 1, exc
+                    )
+        finally:
+            with self._mtx:
+                self._reconnecting.discard(addr_str)
+
+    # -- messaging ----------------------------------------------------------
+
+    def broadcast(self, ch_id: int, msg_bytes: bytes) -> None:
+        """Fire-and-forget TrySend to every peer (switch.go:375-392)."""
+        for peer in self.peers.list():
+            threading.Thread(
+                target=peer.try_send, args=(ch_id, msg_bytes), daemon=True
+            ).start()
+
+    def num_peers(self) -> tuple[int, int, int]:
+        outbound = sum(1 for p in self.peers.list() if p.outbound)
+        total = self.peers.size()
+        with self._mtx:
+            dialing = len(self.dialing)
+        return outbound, total - outbound, dialing
+
+
+# -- test wiring (switch.go:502-547) -----------------------------------------
+
+
+def make_connected_switches(
+    n: int, init_switch, connect=None
+) -> list[Switch]:
+    """n started switches wired pairwise over in-process pipes."""
+    switches = [init_switch(i, Switch()) for i in range(n)]
+    for sw in switches:
+        sw.start()
+    if connect is None:
+        connect = connect2_switches
+    for i in range(n):
+        for j in range(i + 1, n):
+            connect(switches, i, j)
+    return switches
+
+
+def connect2_switches(switches: list[Switch], i: int, j: int) -> None:
+    """Full peering of switches[i] <-> switches[j] over a pipe pair."""
+    a, b = pipe_pair()
+    errs: list = []
+
+    def add(sw, stream, outbound):
+        try:
+            sw.add_peer_from_stream(stream, outbound=outbound)
+        except Exception as exc:  # noqa: BLE001
+            errs.append(exc)
+
+    ti = threading.Thread(target=add, args=(switches[i], a, True), daemon=True)
+    tj = threading.Thread(target=add, args=(switches[j], b, False), daemon=True)
+    ti.start()
+    tj.start()
+    ti.join(20)
+    tj.join(20)
+    if errs:
+        raise errs[0]

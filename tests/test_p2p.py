@@ -1,0 +1,377 @@
+"""p2p layer tests (reference test models: p2p/switch_test.go,
+connection_test.go, secret_connection_test.go, addrbook_test.go,
+pex_reactor_test.go)."""
+
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.crypto.keys import gen_priv_key_ed25519
+from tendermint_tpu.p2p import (
+    ChannelDescriptor,
+    MConnection,
+    NetAddress,
+    NodeInfo,
+    Reactor,
+    Switch,
+    connect2_switches,
+    make_connected_switches,
+)
+from tendermint_tpu.p2p.addrbook import AddrBook
+from tendermint_tpu.p2p.node_info import default_version
+from tendermint_tpu.p2p.secret_connection import SecretConnection
+from tendermint_tpu.p2p.stream import pipe_pair
+
+
+def wait_until(cond, timeout=5.0, tick=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(tick)
+    return cond()
+
+
+# -- netaddress ---------------------------------------------------------------
+
+
+def test_netaddress_parse_and_classify():
+    a = NetAddress.from_string("127.0.0.1:26656")
+    assert a.ip == "127.0.0.1" and a.port == 26656
+    assert a.valid() and a.local() and not a.routable()
+    assert NetAddress("8.8.8.8", 53).routable()
+    assert not NetAddress("10.0.0.1", 80).routable()
+    assert not NetAddress("notanip", 80).valid()
+    with pytest.raises(ValueError):
+        NetAddress.from_string("nocolon")
+    assert NetAddress("8.8.8.8", 53).same_network(NetAddress("8.8.4.4", 99))
+
+
+# -- secret connection --------------------------------------------------------
+
+
+def test_secret_connection_roundtrip():
+    a, b = pipe_pair()
+    ka, kb = gen_priv_key_ed25519(), gen_priv_key_ed25519()
+    out = {}
+
+    def srv():
+        out["conn"] = SecretConnection(b, kb)
+
+    t = threading.Thread(target=srv, daemon=True)
+    t.start()
+    ca = SecretConnection(a, ka)
+    t.join(5)
+    cb = out["conn"]
+    assert ca.remote_pubkey().raw == kb.pub_key().raw
+    assert cb.remote_pubkey().raw == ka.pub_key().raw
+
+    # large payload crosses frame boundaries
+    payload = bytes(range(256)) * 20  # 5120 bytes > 1024 frame
+    ca.write(payload)
+    got = bytearray()
+    while len(got) < len(payload):
+        got += cb.read(4096)
+    assert bytes(got) == payload
+    # and the other direction
+    cb.write(b"pong")
+    assert ca.read(10) == b"pong"
+    ca.close()
+
+
+def test_secret_connection_tampering_detected():
+    a, b = pipe_pair()
+    ka, kb = gen_priv_key_ed25519(), gen_priv_key_ed25519()
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.update(conn=SecretConnection(b, kb)), daemon=True
+    )
+    t.start()
+    ca = SecretConnection(a, ka)
+    t.join(5)
+    # corrupt a ciphertext frame on the raw stream underneath
+    ca.stream.write(b"\x00\x20" + b"\x00" * 32)
+    assert out["conn"].read(10) == b""  # auth failure -> EOF (conn poisoned)
+    ca.close()
+
+
+# -- mconnection --------------------------------------------------------------
+
+
+def _mconn_pair(descs=None, **cfg_kw):
+    from tendermint_tpu.p2p.conn import MConnConfig
+
+    descs = descs or [ChannelDescriptor(id=0x01, priority=1)]
+    a, b = pipe_pair()
+    recv_a, recv_b = [], []
+    err = []
+    cfg = MConnConfig(**cfg_kw)
+    ma = MConnection(a, descs, lambda ch, m: recv_a.append((ch, m)), lambda e: err.append(e), cfg)
+    mb = MConnection(b, descs, lambda ch, m: recv_b.append((ch, m)), lambda e: err.append(e), cfg)
+    ma.start()
+    mb.start()
+    return ma, mb, recv_a, recv_b, err
+
+
+def test_mconnection_send_recv_multipacket():
+    ma, mb, recv_a, recv_b, _ = _mconn_pair()
+    msg = b"x" * 5000  # > 4 packets
+    assert ma.send(0x01, msg)
+    assert wait_until(lambda: recv_b and recv_b[0] == (0x01, msg))
+    assert mb.send(0x01, b"reply")
+    assert wait_until(lambda: recv_a and recv_a[0] == (0x01, b"reply"))
+    ma.stop()
+    mb.stop()
+
+
+def test_mconnection_unknown_channel_refused():
+    ma, mb, *_ = _mconn_pair()
+    assert not ma.send(0x99, b"nope")
+    assert not ma.try_send(0x99, b"nope")
+    ma.stop()
+    mb.stop()
+
+
+def test_mconnection_ping_pong_keeps_alive():
+    ma, mb, _, recv_b, err = _mconn_pair(ping_interval=0.05, pong_timeout=1.0)
+    time.sleep(0.4)  # several ping cycles
+    assert not err
+    assert ma.send(0x01, b"still here")
+    assert wait_until(lambda: recv_b)
+    ma.stop()
+    mb.stop()
+
+
+def test_mconnection_peer_close_fires_on_error():
+    ma, mb, _, _, err = _mconn_pair()
+    mb.stream.close()
+    assert wait_until(lambda: err)
+    ma.stop()
+    mb.stop()
+
+
+def test_mconnection_priority_fairness():
+    """High-priority channel data is not starved by a bulk channel."""
+    descs = [
+        ChannelDescriptor(id=0x01, priority=1, send_queue_capacity=100),
+        ChannelDescriptor(id=0x02, priority=10, send_queue_capacity=100),
+    ]
+    ma, mb, _, recv_b, _ = _mconn_pair(descs)
+    for _ in range(50):
+        ma.try_send(0x01, b"bulk" * 256)
+    ma.try_send(0x02, b"urgent")
+    assert wait_until(
+        lambda: any(ch == 0x02 for ch, _ in recv_b), timeout=10
+    )
+    ma.stop()
+    mb.stop()
+
+
+# -- switch -------------------------------------------------------------------
+
+
+class EchoReactor(Reactor):
+    """Records messages; replies on the same channel when asked."""
+
+    def __init__(self, ch_id=0x05):
+        self.ch_id = ch_id
+        self.received = []
+        self.peers = []
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=self.ch_id, priority=1, send_queue_capacity=32)]
+
+    def add_peer(self, peer):
+        self.peers.append(peer)
+
+    def remove_peer(self, peer, reason):
+        if peer in self.peers:
+            self.peers.remove(peer)
+
+    def receive(self, ch_id, peer, msg):
+        self.received.append((peer.id(), msg))
+
+
+def _make_net(n):
+    reactors = []
+
+    def init(i, sw):
+        r = EchoReactor()
+        reactors.append(r)
+        sw.add_reactor("echo", r)
+        return sw
+
+    return make_connected_switches(n, init), reactors
+
+
+def test_switch_broadcast_reaches_all_peers():
+    sws, reactors = _make_net(3)
+    try:
+        sws[0].broadcast(0x05, b"fan-out")
+        assert wait_until(lambda: len(reactors[1].received) == 1)
+        assert wait_until(lambda: len(reactors[2].received) == 1)
+        assert reactors[1].received[0][1] == b"fan-out"
+    finally:
+        for sw in sws:
+            sw.stop()
+
+
+def test_switch_refuses_self_and_duplicate_connections():
+    sws, _ = _make_net(2)
+    try:
+        with pytest.raises(ConnectionError):
+            connect2_switches(sws, 0, 1)  # duplicate peering
+    finally:
+        for sw in sws:
+            sw.stop()
+
+
+def test_switch_incompatible_network_rejected():
+    def init_a(i, sw):
+        sw.add_reactor("echo", EchoReactor())
+        return sw
+
+    sw_a, sw_b = Switch(), Switch()
+    sw_a.add_reactor("echo", EchoReactor())
+    sw_b.add_reactor("echo", EchoReactor())
+    for sw, net in ((sw_a, "chain-A"), (sw_b, "chain-B")):
+        sw.set_node_info(
+            NodeInfo(
+                pub_key=sw.node_priv_key.pub_key(),
+                moniker="m",
+                network=net,
+                version=default_version("0.1.0"),
+            )
+        )
+        sw.start()
+    try:
+        with pytest.raises(ConnectionError, match="network mismatch"):
+            connect2_switches([sw_a, sw_b], 0, 1)
+        assert sw_a.peers.size() == 0 and sw_b.peers.size() == 0
+    finally:
+        sw_a.stop()
+        sw_b.stop()
+
+
+def test_switch_stop_peer_for_error_removes_from_reactors():
+    sws, reactors = _make_net(2)
+    try:
+        peer = sws[0].peers.list()[0]
+        sws[0].stop_peer_for_error(peer, "test")
+        assert sws[0].peers.size() == 0
+        assert peer not in reactors[0].peers
+        # remote side notices the close too
+        assert wait_until(lambda: sws[1].peers.size() == 0)
+    finally:
+        for sw in sws:
+            sw.stop()
+
+
+def test_switch_tcp_listener_end_to_end():
+    from tendermint_tpu.p2p.listener import Listener
+
+    sw_a, sw_b = Switch(), Switch()
+    ra, rb = EchoReactor(), EchoReactor()
+    sw_a.add_reactor("echo", ra)
+    sw_b.add_reactor("echo", rb)
+    lst = Listener("127.0.0.1:0")
+    sw_a.add_listener(lst)
+    sw_a.start()
+    sw_b.start()
+    try:
+        addr = lst.internal_address()
+        peer = sw_b.dial_peer_with_address(NetAddress("127.0.0.1", addr.port))
+        assert wait_until(lambda: sw_a.peers.size() == 1)
+        peer.send(0x05, b"over tcp")
+        assert wait_until(lambda: ra.received and ra.received[0][1] == b"over tcp")
+    finally:
+        sw_a.stop()
+        sw_b.stop()
+
+
+# -- addrbook -----------------------------------------------------------------
+
+
+def test_addrbook_add_pick_good(tmp_path):
+    book = AddrBook(str(tmp_path / "addrbook.json"))
+    src = NetAddress("1.2.3.4", 26656)
+    for i in range(50):
+        assert book.add_address(NetAddress(f"5.6.{i}.1", 26656), src) or True
+    assert book.size() > 0
+    picked = book.pick_address()
+    assert picked is not None
+    book.mark_good(picked)
+    # non-routable rejected in strict mode
+    assert not book.add_address(NetAddress("192.168.1.1", 26656), src)
+    book.save()
+
+    book2 = AddrBook(str(tmp_path / "addrbook.json"))
+    assert book2.size() == book.size()
+    assert any(str(picked) == str(ka.addr) and ka.is_old()
+               for ka in book2._addrs.values())
+
+
+def test_addrbook_selection_and_removal():
+    book = AddrBook("", routability_strict=False)
+    src = NetAddress("127.0.0.1", 1)
+    for i in range(20):
+        book.add_address(NetAddress("127.0.0.1", 1000 + i), src)
+    sel = book.get_selection()
+    assert 0 < len(sel) <= 20
+    victim = sel[0]
+    book.remove_address(victim)
+    assert str(victim) not in book._addrs
+
+
+# -- pex ----------------------------------------------------------------------
+
+
+def test_pex_reactor_exchanges_addresses():
+    from tendermint_tpu.p2p.pex import PEXReactor
+
+    books = [AddrBook("", routability_strict=False) for _ in range(2)]
+    books[0].add_address(NetAddress("127.0.0.1", 7771), NetAddress("127.0.0.1", 1))
+
+    def init(i, sw):
+        sw.add_reactor("pex", PEXReactor(books[i], ensure_peers_period=3600))
+        sw.set_node_info(
+            NodeInfo(
+                pub_key=sw.node_priv_key.pub_key(),
+                moniker=f"n{i}",
+                network="test",
+                version=default_version("0.1.0"),
+                listen_addr=f"127.0.0.1:{7000 + i}",
+            )
+        )
+        return sw
+
+    sws = make_connected_switches(2, init)
+    try:
+        # node1's inbound peer (node0... whichever side is inbound) requests
+        # addrs; eventually node1 learns node0's known address
+        assert wait_until(
+            lambda: books[0].size() + books[1].size() >= 3, timeout=5
+        )
+    finally:
+        for sw in sws:
+            sw.stop()
+
+
+# -- fuzz ---------------------------------------------------------------------
+
+
+def test_fuzzed_stream_delays_but_delivers():
+    from tendermint_tpu.p2p.fuzz import FuzzedStream
+
+    a, b = pipe_pair()
+    fa = FuzzedStream(a, prob_sleep=0.5, max_delay=0.01, seed=7)
+    fa.write(b"through the fuzz")
+    assert b.read(100) == b"through the fuzz"
+    fa.close()
