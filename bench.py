@@ -17,11 +17,9 @@ import os
 import sys
 import time
 
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
-)
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+from tendermint_tpu.jitcache import enable as _enable_jit_cache
+
+_enable_jit_cache()
 
 BATCH = int(os.environ.get("BENCH_BATCH", "1024"))
 CPU_SAMPLE = int(os.environ.get("BENCH_CPU_SAMPLE", "256"))

@@ -1,0 +1,253 @@
+"""Fast-sync reactor on channel 0x40 (reference: blockchain/reactor.go).
+
+Downloads blocks in parallel via BlockPool, verifies each `first` block
+with `second.LastCommit` — the fast-sync batch-verify hot path
+(reactor.go:235-236) routed through the TPU gateway — applies it, and
+switches over to consensus when caught up (reactor.go:204-217).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from tendermint_tpu.blockchain.pool import BlockPool
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.p2p.conn import ChannelDescriptor
+from tendermint_tpu.p2p.switch import Reactor
+from tendermint_tpu.types.block import Block
+from tendermint_tpu.types.block_id import BlockID
+
+BLOCKCHAIN_CHANNEL = 0x40
+TRY_SYNC_INTERVAL = 0.1  # reactor.go:28-33
+STATUS_UPDATE_INTERVAL = 10.0
+SWITCH_TO_CONSENSUS_INTERVAL = 1.0
+
+
+def _enc(obj: dict) -> bytes:
+    return json.dumps(obj, sort_keys=True).encode()
+
+
+class BlockchainReactor(Reactor, BaseService):
+    def __init__(
+        self,
+        state,
+        proxy_app_conn,
+        store,
+        fast_sync: bool,
+        event_cache=None,
+        batch_verifier=None,
+        status_update_interval: float = STATUS_UPDATE_INTERVAL,
+    ):
+        BaseService.__init__(self, name="blockchain.reactor")
+        self.status_update_interval = status_update_interval
+        if state.last_block_height != store.height() and \
+           state.last_block_height != store.height() - 1:
+            raise ValueError(
+                f"state ({state.last_block_height}) and store ({store.height()}) heights diverge"
+            )
+        self.state = state
+        self.proxy_app_conn = proxy_app_conn
+        self.store = store
+        self.fast_sync = fast_sync
+        self.event_cache = event_cache
+        self.batch_verifier = batch_verifier
+        self.pool = BlockPool(
+            store.height() + 1,
+            request_fn=self._send_block_request,
+            timeout_fn=self._on_peer_timeout,
+        )
+        self.blocks_synced = 0
+        self.sync_rate = 0.0  # blocks/s, EWMA for bench/introspection
+
+    # -- Reactor interface -------------------------------------------------
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(
+                id=BLOCKCHAIN_CHANNEL,
+                priority=5,
+                send_queue_capacity=100,
+                recv_message_capacity=22020096,
+            )
+        ]
+
+    def add_peer(self, peer) -> None:
+        peer.try_send(
+            BLOCKCHAIN_CHANNEL,
+            _enc({"type": "status_response", "height": self.store.height()}),
+        )
+        # a fast-syncing node must learn this peer's height promptly, not
+        # at the next 10s status tick (the pool's 5s catch-up timeout races
+        # a peer that connected at genesis height otherwise)
+        if self.fast_sync:
+            peer.try_send(
+                BLOCKCHAIN_CHANNEL,
+                _enc({"type": "status_request", "height": self.store.height()}),
+            )
+
+    def remove_peer(self, peer, reason) -> None:
+        self.pool.remove_peer(peer.id())
+
+    def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        try:
+            msg = json.loads(msg_bytes.decode())
+            mtype = msg["type"]
+        except (ValueError, KeyError, UnicodeDecodeError) as exc:
+            self.switch.stop_peer_for_error(peer, exc)
+            return
+        if mtype == "block_request":
+            self._handle_block_request(peer, int(msg["height"]))
+        elif mtype == "block_response":
+            try:
+                block = Block.from_json(msg["block"])
+            except (KeyError, ValueError) as exc:
+                self.switch.stop_peer_for_error(peer, exc)
+                return
+            self.pool.add_block(peer.id(), block, len(msg_bytes))
+        elif mtype == "status_request":
+            peer.try_send(
+                BLOCKCHAIN_CHANNEL,
+                _enc({"type": "status_response", "height": self.store.height()}),
+            )
+        elif mtype == "status_response":
+            self.pool.set_peer_height(peer.id(), int(msg["height"]))
+        elif mtype == "no_block_response":
+            # honest "I don't have it" — free the requester for another peer
+            self.logger.debug(
+                "peer %s has no block at %s", peer.id()[:8], msg.get("height")
+            )
+            self.pool.peer_has_no_block(peer.id(), int(msg["height"]))
+        else:
+            self.switch.stop_peer_for_error(peer, f"unknown bc msg {mtype!r}")
+
+    def _handle_block_request(self, peer, height: int) -> None:
+        block = self.store.load_block(height)
+        if block is not None:
+            peer.try_send(
+                BLOCKCHAIN_CHANNEL,
+                _enc({"type": "block_response", "block": block.to_json()}),
+            )
+        else:
+            peer.try_send(
+                BLOCKCHAIN_CHANNEL,
+                _enc({"type": "no_block_response", "height": height}),
+            )
+
+    # -- pool callbacks ----------------------------------------------------
+
+    def _send_block_request(self, height: int, peer_id: str) -> None:
+        peer = self.switch.peers.get(peer_id)
+        if peer is not None:
+            peer.try_send(
+                BLOCKCHAIN_CHANNEL, _enc({"type": "block_request", "height": height})
+            )
+
+    def _on_peer_timeout(self, peer_id: str, reason) -> None:
+        peer = self.switch.peers.get(peer_id)
+        if peer is not None:
+            self.switch.stop_peer_for_error(peer, reason)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def on_start(self) -> None:
+        if self.fast_sync:
+            self.pool.start()
+            threading.Thread(
+                target=self._pool_routine, daemon=True, name="bc.pool_routine"
+            ).start()
+
+    def on_stop(self) -> None:
+        self.pool.stop()
+
+    # -- the sync loop (reactor.go:174-262) --------------------------------
+
+    def _pool_routine(self) -> None:
+        last_status = 0.0
+        last_switch_check = 0.0
+        last_hundred = time.monotonic()
+        while self.is_running() and self.pool.is_running():
+            now = time.monotonic()
+            if now - last_status >= self.status_update_interval:
+                last_status = now
+                self.broadcast_status_request()
+            if now - last_switch_check >= SWITCH_TO_CONSENSUS_INTERVAL:
+                last_switch_check = now
+                if self.pool.is_caught_up() and self.blocks_synced >= 0:
+                    self.logger.info("caught up; switching to consensus")
+                    self.pool.stop()
+                    con_r = self.switch.reactor("CONSENSUS")
+                    if con_r is not None and hasattr(con_r, "switch_to_consensus"):
+                        con_r.switch_to_consensus(self.state)
+                    return
+            synced_any = self._try_sync()
+            if self.blocks_synced and self.blocks_synced % 100 == 0:
+                dt = max(time.monotonic() - last_hundred, 1e-9)
+                self.sync_rate = 0.9 * self.sync_rate + 0.1 * (100 / dt) if self.sync_rate else 100 / dt
+                last_hundred = time.monotonic()
+            if not synced_any:
+                time.sleep(TRY_SYNC_INTERVAL)
+
+    def _try_sync(self) -> bool:
+        """Verify+apply one block; True if a block was consumed."""
+        first, second = self.pool.peek_two_blocks()
+        if first is None or second is None:
+            return False
+        # rebuild the part set: the header's PartsHeader committed to it
+        # (reactor.go:229) — TPU-hashed via the gateway when available
+        first_parts = first.make_part_set(
+            self.state.params().block_gossip.block_part_size_bytes
+        )
+        first_id = BlockID(first.hash(), first_parts.header())
+        try:
+            self.state.validators.verify_commit(
+                self.state.chain_id,
+                first_id,
+                first.header.height,
+                second.last_commit,
+                batch_verifier=self.batch_verifier,
+            )
+        except Exception as exc:  # noqa: BLE001 — bad block/commit
+            self.logger.info("invalid block %d during fast sync: %s", first.header.height, exc)
+            bad = self.pool.redo_request(first.header.height)
+            # second's commit could also be forged; refetch it too
+            self.pool.redo_request(second.header.height)
+            if bad:
+                peer = self.switch.peers.get(bad)
+                if peer is not None:
+                    self.switch.stop_peer_for_error(peer, "sent invalid block")
+            return False
+        self.pool.pop_request()
+        self.store.save_block(first, first_parts, second.last_commit)
+        from tendermint_tpu.state.execution import apply_block
+
+        apply_block(
+            self.state,
+            self.event_cache,
+            self.proxy_app_conn,
+            first,
+            first_parts.header(),
+            _NullMempool(),
+            batch_verifier=self.batch_verifier,
+        )
+        self.blocks_synced += 1
+        return True
+
+    def broadcast_status_request(self) -> None:
+        self.switch.broadcast(
+            BLOCKCHAIN_CHANNEL, _enc({"type": "status_request", "height": self.store.height()})
+        )
+
+
+class _NullMempool:
+    """Fast sync runs before the mempool matters (types/services.go MockMempool)."""
+
+    def lock(self) -> None:
+        pass
+
+    def unlock(self) -> None:
+        pass
+
+    def update(self, height: int, txs) -> None:
+        pass

@@ -1,0 +1,718 @@
+"""Consensus gossip reactor (reference: consensus/reactor.go).
+
+Four p2p channels (reactor.go:21-24):
+  0x20 STATE       — NewRoundStep / CommitStep / HasVote / ProposalHeartbeat
+  0x21 DATA        — Proposal / ProposalPOL / BlockPart
+  0x22 VOTE        — Vote
+  0x23 VOTE_SET_BITS — VoteSetMaj23 / VoteSetBits
+
+Each peer gets a mirrored PeerRoundState and three gossip threads
+(reactor.go:133-135): gossip_data (block parts + catch-up), gossip_votes
+(needed-vote picker), query_maj23. Step transitions and new votes are
+broadcast event-driven via the event switch (reactor.go:321-337).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+
+from tendermint_tpu.consensus import messages as msgs
+from tendermint_tpu.consensus.round_state import RoundStep
+from tendermint_tpu.libs.bitarray import BitArray
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.p2p.conn import ChannelDescriptor
+from tendermint_tpu.p2p.switch import Reactor
+from tendermint_tpu.types import events as tev
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT, VOTE_TYPE_PREVOTE
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+VOTE_SET_BITS_CHANNEL = 0x23
+
+PEER_GOSSIP_SLEEP = 0.1  # reactor.go peerGossipSleepDuration
+PEER_QUERY_MAJ23_SLEEP = 2.0
+
+PEER_STATE_KEY = "ConsensusReactor.peerState"
+
+
+def _enc(msg) -> bytes:
+    return json.dumps(msgs.msg_to_json(msg), sort_keys=True).encode()
+
+
+def _dec(raw: bytes):
+    return msgs.msg_from_json(json.loads(raw.decode()))
+
+
+class PeerRoundState:
+    """What we believe the peer's consensus state is (reactor.go:757-773)."""
+
+    def __init__(self):
+        self.height = 0
+        self.round_ = -1
+        self.step = RoundStep.NEW_HEIGHT
+        self.start_time = 0.0
+        self.proposal = False
+        self.proposal_block_parts_header: PartSetHeader | None = None
+        self.proposal_block_parts: BitArray | None = None
+        self.proposal_pol_round = -1
+        self.proposal_pol: BitArray | None = None
+        self.prevotes: BitArray | None = None
+        self.precommits: BitArray | None = None
+        self.last_commit_round = -1
+        self.last_commit: BitArray | None = None
+        self.catchup_commit_round = -1
+        self.catchup_commit: BitArray | None = None
+
+
+class PeerState:
+    """Thread-safe mirror + vote bookkeeping for one peer
+    (reactor.go:778-1060)."""
+
+    def __init__(self, peer):
+        self.peer = peer
+        self.prs = PeerRoundState()
+        self._mtx = threading.RLock()
+
+    # -- reads -------------------------------------------------------------
+
+    def get_round_state(self) -> PeerRoundState:
+        with self._mtx:
+            import copy
+
+            return copy.copy(self.prs)
+
+    def get_height(self) -> int:
+        with self._mtx:
+            return self.prs.height
+
+    # -- proposal/parts ----------------------------------------------------
+
+    def set_has_proposal(self, proposal) -> None:
+        with self._mtx:
+            prs = self.prs
+            if prs.height != proposal.height or prs.round_ != proposal.round_:
+                return
+            if prs.proposal:
+                return
+            prs.proposal = True
+            prs.proposal_block_parts_header = proposal.block_parts_header
+            prs.proposal_block_parts = BitArray(proposal.block_parts_header.total)
+            prs.proposal_pol_round = proposal.pol_round
+            prs.proposal_pol = None  # until ProposalPOLMessage arrives
+
+    def set_has_proposal_block_part(self, height: int, round_: int, index: int) -> None:
+        with self._mtx:
+            prs = self.prs
+            if prs.height != height or prs.round_ != round_:
+                return
+            if prs.proposal_block_parts is None:
+                return
+            prs.proposal_block_parts.set_index(index, True)
+
+    def apply_proposal_pol(self, msg: msgs.ProposalPOLMessage) -> None:
+        with self._mtx:
+            prs = self.prs
+            if prs.height != msg.height or prs.proposal_pol_round != msg.proposal_pol_round:
+                return
+            prs.proposal_pol = msg.proposal_pol
+
+    # -- votes -------------------------------------------------------------
+
+    def set_has_vote(self, height: int, round_: int, type_: int, index: int) -> None:
+        with self._mtx:
+            ba = self._get_vote_bit_array(height, round_, type_)
+            if ba is not None:
+                ba.set_index(index, True)
+
+    def _get_vote_bit_array(self, height: int, round_: int, type_: int) -> BitArray | None:
+        """reactor.go:813-850."""
+        prs = self.prs
+        if prs.height == height:
+            if prs.round_ == round_:
+                return prs.prevotes if type_ == VOTE_TYPE_PREVOTE else prs.precommits
+            if prs.catchup_commit_round == round_ and type_ == VOTE_TYPE_PRECOMMIT:
+                return prs.catchup_commit
+            if prs.proposal_pol_round == round_ and type_ == VOTE_TYPE_PREVOTE:
+                return prs.proposal_pol
+            return None
+        if prs.height == height + 1 and prs.last_commit_round == round_ and \
+           type_ == VOTE_TYPE_PRECOMMIT:
+            return prs.last_commit
+        return None
+
+    def ensure_vote_bit_arrays(self, height: int, num_validators: int) -> None:
+        with self._mtx:
+            prs = self.prs
+            if prs.height == height:
+                if prs.prevotes is None:
+                    prs.prevotes = BitArray(num_validators)
+                if prs.precommits is None:
+                    prs.precommits = BitArray(num_validators)
+                if prs.proposal_pol is None and prs.proposal_pol_round >= 0:
+                    prs.proposal_pol = BitArray(num_validators)
+                if prs.catchup_commit is None and prs.catchup_commit_round >= 0:
+                    prs.catchup_commit = BitArray(num_validators)
+            elif prs.height == height + 1:
+                if prs.last_commit is None:
+                    prs.last_commit = BitArray(num_validators)
+
+    def ensure_catchup_commit_round(self, height: int, round_: int, num_validators: int) -> None:
+        """reactor.go:855-873."""
+        with self._mtx:
+            prs = self.prs
+            if prs.height != height or round_ < 0:
+                return
+            if prs.catchup_commit_round == round_:
+                return
+            prs.catchup_commit_round = round_
+            prs.catchup_commit = (
+                prs.precommits if prs.round_ == round_ else BitArray(num_validators)
+            )
+
+    def pick_vote_to_send(self, vote_set) -> object | None:
+        """A random vote the peer needs from `vote_set` (reactor.go:899-933)."""
+        if vote_set is None or vote_set.size() == 0:
+            return None
+        with self._mtx:
+            ps_bits = self._get_vote_bit_array(
+                vote_set.height, vote_set.round_, vote_set.type_
+            )
+            if ps_bits is None:
+                return None
+            needed = vote_set.bit_array().sub(ps_bits)
+            if needed.is_empty():
+                return None
+            index, ok = needed.pick_random()
+            if not ok:
+                return None
+            vote = vote_set.get_by_index(index)
+            if vote is not None:
+                ps_bits.set_index(index, True)
+            return vote
+
+    # -- step transitions --------------------------------------------------
+
+    def apply_new_round_step(self, msg: msgs.NewRoundStepMessage) -> None:
+        """reactor.go:1046-1090."""
+        with self._mtx:
+            prs = self.prs
+            psheight, psround, psstep = prs.height, prs.round_, prs.step
+            ps_catchup_round = prs.catchup_commit_round
+            ps_catchup = prs.catchup_commit
+
+            prs.height = msg.height
+            prs.round_ = msg.round_
+            prs.step = msg.step
+            prs.start_time = time.time() - msg.seconds_since_start_time
+            if psheight != msg.height or psround != msg.round_:
+                prs.proposal = False
+                prs.proposal_block_parts_header = None
+                prs.proposal_block_parts = None
+                prs.proposal_pol_round = -1
+                prs.proposal_pol = None
+                prs.prevotes = None
+                prs.precommits = None
+            if psheight == msg.height and psround != msg.round_ and \
+               msg.round_ == ps_catchup_round:
+                prs.precommits = ps_catchup
+            if psheight != msg.height:
+                # shift precommits to last_commit
+                if psheight + 1 == msg.height and psround == msg.last_commit_round:
+                    prs.last_commit_round = msg.last_commit_round
+                    prs.last_commit = prs.precommits
+                else:
+                    prs.last_commit_round = msg.last_commit_round
+                    prs.last_commit = None
+                prs.catchup_commit_round = -1
+                prs.catchup_commit = None
+
+    def apply_commit_step(self, msg: msgs.CommitStepMessage) -> None:
+        with self._mtx:
+            prs = self.prs
+            if prs.height != msg.height:
+                return
+            prs.proposal_block_parts_header = msg.block_parts_header
+            prs.proposal_block_parts = msg.block_parts
+
+    def apply_has_vote(self, msg: msgs.HasVoteMessage) -> None:
+        with self._mtx:
+            if self.prs.height != msg.height:
+                return
+        self.set_has_vote(msg.height, msg.round_, msg.type_, msg.index)
+
+    def apply_vote_set_bits(self, msg: msgs.VoteSetBitsMessage, our_votes: BitArray | None) -> None:
+        """reactor.go:1126-1149: if we know our votes for that BlockID,
+        mark union(msg.votes, ours); else replace wholesale."""
+        with self._mtx:
+            ba = self._get_vote_bit_array(msg.height, msg.round_, msg.type_)
+            if ba is None:
+                return
+            if our_votes is not None:
+                have = msg.votes.or_(our_votes)
+                new_bits = ba.or_(have)
+            else:
+                new_bits = ba.or_(msg.votes)
+            for i in new_bits.indices():
+                ba.set_index(i, True)
+
+
+class ConsensusReactor(Reactor, BaseService):
+    def __init__(self, consensus_state, fast_sync: bool = False):
+        BaseService.__init__(self, name="consensus.reactor")
+        self.con_s = consensus_state
+        self.fast_sync = fast_sync
+        self.evsw = None
+        self._peer_threads: dict[str, list] = {}
+        self._peer_stops: dict[str, threading.Event] = {}
+        self._mtx = threading.Lock()
+
+    # -- wiring ------------------------------------------------------------
+
+    def set_event_switch(self, evsw) -> None:
+        """Subscribe broadcast triggers (reactor.go:321-337)."""
+        self.evsw = evsw
+        evsw.add_listener_for_event(
+            "conR", tev.EVENT_NEW_ROUND_STEP, lambda _d: self._broadcast_step()
+        )
+        evsw.add_listener_for_event(
+            "conR", tev.EVENT_VOTE, lambda d: self._broadcast_has_vote(d.vote)
+        )
+        evsw.add_listener_for_event(
+            "conR",
+            tev.EVENT_PROPOSAL_HEARTBEAT,
+            lambda d: self._broadcast_heartbeat(d.heartbeat),
+        )
+
+    # -- Reactor interface -------------------------------------------------
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(id=STATE_CHANNEL, priority=5, send_queue_capacity=100),
+            ChannelDescriptor(
+                id=DATA_CHANNEL, priority=10, send_queue_capacity=100,
+                recv_buffer_capacity=50 * 4096,
+            ),
+            ChannelDescriptor(
+                id=VOTE_CHANNEL, priority=5, send_queue_capacity=100,
+                recv_buffer_capacity=100 * 100,
+            ),
+            ChannelDescriptor(
+                id=VOTE_SET_BITS_CHANNEL, priority=1, send_queue_capacity=2,
+                recv_buffer_capacity=1024,
+            ),
+        ]
+
+    def add_peer(self, peer) -> None:
+        ps = PeerState(peer)
+        peer.set(PEER_STATE_KEY, ps)
+        stop = threading.Event()
+        threads = []
+        for fn, nm in (
+            (self._gossip_data_routine, "gossipData"),
+            (self._gossip_votes_routine, "gossipVotes"),
+            (self._query_maj23_routine, "queryMaj23"),
+        ):
+            t = threading.Thread(
+                target=fn, args=(peer, ps, stop), daemon=True,
+                name=f"conR.{nm}:{peer.id()[:8]}",
+            )
+            threads.append(t)
+        with self._mtx:
+            self._peer_stops[peer.id()] = stop
+            self._peer_threads[peer.id()] = threads
+        for t in threads:
+            t.start()
+        # tell the new peer our current state
+        if not self.fast_sync:
+            for m in self._round_step_messages():
+                peer.send(STATE_CHANNEL, _enc(m))
+
+    def remove_peer(self, peer, reason) -> None:
+        with self._mtx:
+            stop = self._peer_stops.pop(peer.id(), None)
+            self._peer_threads.pop(peer.id(), None)
+        if stop:
+            stop.set()
+
+    def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        """reactor.go:159-302."""
+        if not self.is_running():
+            return
+        try:
+            msg = _dec(msg_bytes)
+        except (ValueError, KeyError, UnicodeDecodeError) as exc:
+            self.switch.stop_peer_for_error(peer, exc)
+            return
+        ps: PeerState | None = peer.get(PEER_STATE_KEY)
+        if ps is None:
+            return
+
+        if ch_id == STATE_CHANNEL:
+            if isinstance(msg, msgs.NewRoundStepMessage):
+                ps.apply_new_round_step(msg)
+            elif isinstance(msg, msgs.CommitStepMessage):
+                ps.apply_commit_step(msg)
+            elif isinstance(msg, msgs.HasVoteMessage):
+                ps.apply_has_vote(msg)
+            elif isinstance(msg, msgs.ProposalHeartbeatMessage):
+                self.con_s._fire(
+                    tev.EVENT_PROPOSAL_HEARTBEAT,
+                    tev.EventDataProposalHeartbeat(msg.heartbeat),
+                )
+            elif isinstance(msg, msgs.VoteSetMaj23Message):
+                self._handle_vote_set_maj23(peer, ps, msg)
+            else:
+                self.switch.stop_peer_for_error(peer, f"bad state msg {type(msg)}")
+        elif ch_id == DATA_CHANNEL:
+            if self.fast_sync:
+                return
+            if isinstance(msg, msgs.ProposalMessage):
+                ps.set_has_proposal(msg.proposal)
+                self.con_s.add_peer_message(msg, peer.id())
+            elif isinstance(msg, msgs.ProposalPOLMessage):
+                ps.apply_proposal_pol(msg)
+            elif isinstance(msg, msgs.BlockPartMessage):
+                ps.set_has_proposal_block_part(msg.height, msg.round_, msg.part.index)
+                self.con_s.add_peer_message(msg, peer.id())
+            else:
+                self.switch.stop_peer_for_error(peer, f"bad data msg {type(msg)}")
+        elif ch_id == VOTE_CHANNEL:
+            if self.fast_sync:
+                return
+            if isinstance(msg, msgs.VoteMessage):
+                rs = self.con_s.get_round_state()
+                height = rs.height
+                size = rs.validators.size() if rs.validators else 0
+                ps.ensure_vote_bit_arrays(height, size)
+                ps.ensure_vote_bit_arrays(height - 1, size)
+                ps.set_has_vote(
+                    msg.vote.height, msg.vote.round_, msg.vote.type_,
+                    msg.vote.validator_index,
+                )
+                self.con_s.add_peer_message(msg, peer.id())
+            else:
+                self.switch.stop_peer_for_error(peer, f"bad vote msg {type(msg)}")
+        elif ch_id == VOTE_SET_BITS_CHANNEL:
+            if self.fast_sync:
+                return
+            if isinstance(msg, msgs.VoteSetBitsMessage):
+                rs = self.con_s.get_round_state()
+                if rs.height == msg.height and rs.votes is not None:
+                    vs = (
+                        rs.votes.prevotes(msg.round_)
+                        if msg.type_ == VOTE_TYPE_PREVOTE
+                        else rs.votes.precommits(msg.round_)
+                    )
+                    ours = vs.bit_array_by_block_id(msg.block_id) if vs else None
+                else:
+                    ours = None
+                ps.apply_vote_set_bits(msg, ours)
+            else:
+                self.switch.stop_peer_for_error(peer, f"bad bits msg {type(msg)}")
+
+    def _handle_vote_set_maj23(self, peer, ps: PeerState, msg: msgs.VoteSetMaj23Message) -> None:
+        """reactor.go:230-263: record the claim, respond with our bits."""
+        rs = self.con_s.get_round_state()
+        if rs.height != msg.height or rs.votes is None:
+            return
+        rs.votes.set_peer_maj23(msg.round_, msg.type_, peer.id(), msg.block_id)
+        vs = (
+            rs.votes.prevotes(msg.round_)
+            if msg.type_ == VOTE_TYPE_PREVOTE
+            else rs.votes.precommits(msg.round_)
+        )
+        ours = vs.bit_array_by_block_id(msg.block_id) if vs else None
+        if ours is None:
+            return
+        peer.try_send(
+            VOTE_SET_BITS_CHANNEL,
+            _enc(
+                msgs.VoteSetBitsMessage(
+                    msg.height, msg.round_, msg.type_, msg.block_id, ours
+                )
+            ),
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def on_start(self) -> None:
+        if not self.fast_sync:
+            self.con_s.start()
+
+    def on_stop(self) -> None:
+        self.con_s.stop()
+        with self._mtx:
+            stops = list(self._peer_stops.values())
+        for s in stops:
+            s.set()
+
+    def switch_to_consensus(self, state) -> None:
+        """Fast sync complete (reactor.go:78-90)."""
+        self.logger.info("switching to consensus at height %d", state.last_block_height + 1)
+        self.con_s.reconstruct_last_commit(state)
+        self.con_s.update_to_state(state)
+        self.fast_sync = False
+        self.con_s.start()
+
+    # -- broadcasts --------------------------------------------------------
+
+    def _round_step_messages(self) -> list:
+        rs = self.con_s.get_round_state()
+        out = [
+            msgs.NewRoundStepMessage(
+                height=rs.height,
+                round_=rs.round_,
+                step=rs.step,
+                seconds_since_start_time=int(time.time() - rs.start_time),
+                last_commit_round=rs.last_commit.round_ if rs.last_commit else -1,
+            )
+        ]
+        if rs.step == RoundStep.COMMIT and rs.proposal_block_parts is not None:
+            out.append(
+                msgs.CommitStepMessage(
+                    height=rs.height,
+                    block_parts_header=rs.proposal_block_parts.header(),
+                    block_parts=rs.proposal_block_parts.bit_array(),
+                )
+            )
+        return out
+
+    def _broadcast_step(self) -> None:
+        if not hasattr(self, "switch") or self.switch is None:
+            return
+        for m in self._round_step_messages():
+            self.switch.broadcast(STATE_CHANNEL, _enc(m))
+
+    def _broadcast_has_vote(self, vote) -> None:
+        if not hasattr(self, "switch") or self.switch is None:
+            return
+        msg = msgs.HasVoteMessage(
+            height=vote.height, round_=vote.round_, type_=vote.type_,
+            index=vote.validator_index,
+        )
+        self.switch.broadcast(STATE_CHANNEL, _enc(msg))
+
+    def _broadcast_heartbeat(self, heartbeat) -> None:
+        if not hasattr(self, "switch") or self.switch is None:
+            return
+        self.switch.broadcast(
+            STATE_CHANNEL, _enc(msgs.ProposalHeartbeatMessage(heartbeat))
+        )
+
+    # -- gossip_data (reactor.go:413-535) ----------------------------------
+
+    def _gossip_data_routine(self, peer, ps: PeerState, stop: threading.Event) -> None:
+        while self.is_running() and not stop.is_set():
+            if self.fast_sync:
+                stop.wait(PEER_GOSSIP_SLEEP)
+                continue
+            rs = self.con_s.get_round_state()
+            prs = ps.get_round_state()
+            # 1. send a block part the peer lacks
+            if (
+                rs.proposal_block_parts is not None
+                and prs.proposal_block_parts is not None
+                and rs.height == prs.height
+                and rs.round_ == prs.round_
+            ):
+                have = rs.proposal_block_parts.bit_array()
+                needed = have.sub(prs.proposal_block_parts)
+                if not needed.is_empty():
+                    index, ok = needed.pick_random()
+                    if ok:
+                        part = rs.proposal_block_parts.get_part(index)
+                        msg = msgs.BlockPartMessage(rs.height, rs.round_, part)
+                        if peer.send(DATA_CHANNEL, _enc(msg)):
+                            ps.set_has_proposal_block_part(prs.height, prs.round_, index)
+                        continue
+            # 2. peer is on an older height: catch them up from the store
+            if prs.height != 0 and rs.height > prs.height:
+                if self._gossip_data_catchup(peer, ps, prs):
+                    continue
+                stop.wait(PEER_GOSSIP_SLEEP)
+                continue
+            # 3. send the proposal (+POL) if the peer doesn't have it
+            if (
+                rs.height == prs.height
+                and rs.round_ == prs.round_
+                and rs.proposal is not None
+                and not prs.proposal
+            ):
+                if peer.send(DATA_CHANNEL, _enc(msgs.ProposalMessage(rs.proposal))):
+                    ps.set_has_proposal(rs.proposal)
+                if 0 <= rs.proposal.pol_round < rs.round_ and rs.votes is not None:
+                    pol = rs.votes.prevotes(rs.proposal.pol_round)
+                    if pol is not None:
+                        peer.send(
+                            DATA_CHANNEL,
+                            _enc(
+                                msgs.ProposalPOLMessage(
+                                    rs.height, rs.proposal.pol_round, pol.bit_array()
+                                )
+                            ),
+                        )
+                continue
+            stop.wait(PEER_GOSSIP_SLEEP)
+
+    def _gossip_data_catchup(self, peer, ps: PeerState, prs: PeerRoundState) -> bool:
+        """Send a part of a committed block (reactor.go:494-535)."""
+        store = getattr(self.con_s, "block_store", None)
+        if store is None:
+            return False
+        meta = store.load_block_meta(prs.height)
+        if meta is None:
+            return False
+        if prs.proposal_block_parts is None:
+            # init from the committed block's part-set header
+            ps_header = meta.block_id.parts_header
+            ps.apply_commit_step(
+                msgs.CommitStepMessage(
+                    height=prs.height,
+                    block_parts_header=ps_header,
+                    block_parts=BitArray(ps_header.total),
+                )
+            )
+            return True
+        if meta.block_id.parts_header != prs.proposal_block_parts_header:
+            return False
+        needed = prs.proposal_block_parts.not_()
+        if needed.is_empty():
+            return False
+        index, ok = needed.pick_random()
+        if not ok:
+            return False
+        part = store.load_block_part(prs.height, index)
+        if part is None:
+            return False
+        msg = msgs.BlockPartMessage(prs.height, prs.round_, part)
+        if peer.send(DATA_CHANNEL, _enc(msg)):
+            ps.set_has_proposal_block_part(prs.height, prs.round_, index)
+        return True
+
+    # -- gossip_votes (reactor.go:537-645) ---------------------------------
+
+    def _gossip_votes_routine(self, peer, ps: PeerState, stop: threading.Event) -> None:
+        while self.is_running() and not stop.is_set():
+            if self.fast_sync:
+                stop.wait(PEER_GOSSIP_SLEEP)
+                continue
+            rs = self.con_s.get_round_state()
+            prs = ps.get_round_state()
+            if rs.validators is not None:
+                ps.ensure_vote_bit_arrays(rs.height, rs.validators.size())
+                # a peer lagging one height needs last-commit bit arrays
+                # before pick_vote_to_send can track what it has
+                if rs.last_validators is not None:
+                    ps.ensure_vote_bit_arrays(
+                        rs.height - 1, rs.last_validators.size()
+                    )
+            if self._pick_and_send_vote(peer, ps, rs, prs):
+                continue
+            stop.wait(PEER_GOSSIP_SLEEP)
+
+    def _send_vote(self, peer, vote) -> bool:
+        return peer.send(VOTE_CHANNEL, _enc(msgs.VoteMessage(vote)))
+
+    def _pick_and_send_vote(self, peer, ps: PeerState, rs, prs: PeerRoundState) -> bool:
+        """One needed vote, if any (reactor.go:609-645 gossipVotesForHeight
+        + same-height/lastCommit/catchup cases)."""
+        # same height
+        if rs.height == prs.height and rs.votes is not None:
+            # peer is lagging in rounds: their POL prevotes
+            if prs.step <= RoundStep.PROPOSE and prs.round_ != -1 and \
+               prs.round_ <= rs.round_ and prs.proposal_pol_round != -1:
+                pol = rs.votes.prevotes(prs.proposal_pol_round)
+                vote = ps.pick_vote_to_send(pol) if pol else None
+                if vote is not None:
+                    return self._send_vote(peer, vote)
+            if prs.step <= RoundStep.PREVOTE_WAIT and prs.round_ != -1 and \
+               prs.round_ <= rs.round_:
+                vote = ps.pick_vote_to_send(rs.votes.prevotes(prs.round_))
+                if vote is not None:
+                    return self._send_vote(peer, vote)
+            if prs.step <= RoundStep.PRECOMMIT_WAIT and prs.round_ != -1 and \
+               prs.round_ <= rs.round_:
+                vote = ps.pick_vote_to_send(rs.votes.precommits(prs.round_))
+                if vote is not None:
+                    return self._send_vote(peer, vote)
+            if prs.proposal_pol_round != -1:
+                pol = rs.votes.prevotes(prs.proposal_pol_round)
+                vote = ps.pick_vote_to_send(pol) if pol else None
+                if vote is not None:
+                    return self._send_vote(peer, vote)
+        # peer is at our last height: send from our last commit
+        if rs.height == prs.height + 1 and rs.last_commit is not None:
+            vote = ps.pick_vote_to_send(rs.last_commit)
+            if vote is not None:
+                return self._send_vote(peer, vote)
+        # peer is far behind: catch up with the stored seen-commit
+        if rs.height >= prs.height + 2 and prs.height > 0:
+            store = getattr(self.con_s, "block_store", None)
+            if store is not None:
+                commit = store.load_block_commit(prs.height)
+                if commit is not None:
+                    ps.ensure_catchup_commit_round(
+                        prs.height, commit.round_(), len(commit.precommits)
+                    )
+                    vote = self._pick_commit_vote_to_send(ps, prs, commit)
+                    if vote is not None:
+                        return self._send_vote(peer, vote)
+        return False
+
+    def _pick_commit_vote_to_send(self, ps: PeerState, prs: PeerRoundState, commit):
+        """Catch-up votes come from a Commit, not a VoteSet."""
+        with ps._mtx:
+            ba = ps._get_vote_bit_array(prs.height, commit.round_(), VOTE_TYPE_PRECOMMIT)
+            if ba is None:
+                return None
+            have = BitArray.from_indices(
+                len(commit.precommits),
+                [i for i, pc in enumerate(commit.precommits) if pc is not None],
+            )
+            needed = have.sub(ba)
+            if needed.is_empty():
+                return None
+            index, ok = needed.pick_random()
+            if not ok:
+                return None
+            ba.set_index(index, True)
+            return commit.precommits[index]
+
+    # -- query_maj23 (reactor.go:647-739) ----------------------------------
+
+    def _query_maj23_routine(self, peer, ps: PeerState, stop: threading.Event) -> None:
+        while self.is_running() and not stop.is_set():
+            stop.wait(PEER_QUERY_MAJ23_SLEEP)
+            if self.fast_sync or not self.is_running() or stop.is_set():
+                continue
+            rs = self.con_s.get_round_state()
+            prs = ps.get_round_state()
+            if rs.votes is None or rs.height != prs.height:
+                continue
+            sends = []
+            prevotes = rs.votes.prevotes(prs.round_)
+            if prevotes is not None:
+                maj = prevotes.two_thirds_majority()
+                if maj is not None:
+                    sends.append((prs.round_, VOTE_TYPE_PREVOTE, maj))
+            precommits = rs.votes.precommits(prs.round_)
+            if precommits is not None:
+                maj = precommits.two_thirds_majority()
+                if maj is not None:
+                    sends.append((prs.round_, VOTE_TYPE_PRECOMMIT, maj))
+            if prs.proposal_pol_round >= 0:
+                pol = rs.votes.prevotes(prs.proposal_pol_round)
+                if pol is not None:
+                    maj = pol.two_thirds_majority()
+                    if maj is not None:
+                        sends.append((prs.proposal_pol_round, VOTE_TYPE_PREVOTE, maj))
+            for round_, type_, block_id in sends:
+                peer.try_send(
+                    VOTE_SET_BITS_CHANNEL,
+                    _enc(msgs.VoteSetMaj23Message(prs.height, round_, type_, block_id)),
+                )

@@ -136,11 +136,19 @@ class ConsensusState(BaseService):
         self.timeout_ticker.start()
         self._stopping.clear()
 
-        # WAL catchup BEFORE accepting new inputs (consensus/state.go:337-344)
+        # WAL catchup BEFORE accepting new inputs (consensus/state.go:337-344).
+        # A replay error (e.g. fresh WAL after fast sync, with no ENDHEIGHT
+        # marker for our height) is logged and consensus starts anyway
+        # (consensus/state.go:340-344 does exactly this).
         if self.wal is not None and not self.replay_mode:
             from tendermint_tpu.consensus.replay import catchup_replay
 
-            catchup_replay(self, self.rs.height)
+            try:
+                catchup_replay(self, self.rs.height)
+            except Exception:
+                self.logger.exception(
+                    "error on catchup replay; proceeding to start anyway"
+                )
 
         self._start_forwarders()
         self._thread = threading.Thread(

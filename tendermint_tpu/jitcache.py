@@ -1,0 +1,26 @@
+"""Shared JAX persistent-compile-cache setup.
+
+This jax build ignores the JAX_COMPILATION_CACHE_DIR env vars (verified:
+env-var-only runs never write the cache; explicit config calls do), so
+every entry point — bench.py, __graft_entry__.py, tests/conftest.py —
+calls enable() instead. The ed25519 ladder takes ~45s to compile on the
+CPU backend; caching it is the difference between a 10-minute and a
+10-second test run.
+"""
+
+from __future__ import annotations
+
+import os
+
+# repo-root/.jax_cache (this file lives at repo-root/tendermint_tpu/)
+_DEFAULT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+)
+
+
+def enable(cache_dir: str | None = None) -> None:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir or _DEFAULT_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

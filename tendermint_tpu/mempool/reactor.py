@@ -1,0 +1,117 @@
+"""Mempool tx gossip on channel 0x30 (reference: mempool/reactor.go).
+
+Per-peer broadcast thread walks the mempool CList with blocking
+next_wait (reactor.go:114-152), waiting until the peer's height is at
+least tx height - 1 before sending, so peers that are far behind aren't
+flooded with txs they can't check yet.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.p2p.conn import ChannelDescriptor
+from tendermint_tpu.p2p.switch import Reactor
+
+MEMPOOL_CHANNEL = 0x30
+PEER_CATCHUP_SLEEP = 0.1
+
+
+def _encode_tx(tx: bytes) -> bytes:
+    return json.dumps({"type": "tx", "tx": tx.hex()}, sort_keys=True).encode()
+
+
+class MempoolReactor(Reactor, BaseService):
+    def __init__(self, config, mempool):
+        BaseService.__init__(self, name="mempool.reactor")
+        self.config = config
+        self.mempool = mempool
+        self._peer_threads: dict[str, threading.Thread] = {}
+        self._peer_stops: dict[str, threading.Event] = {}
+        self._mtx = threading.Lock()
+
+    # -- Reactor interface -------------------------------------------------
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(id=MEMPOOL_CHANNEL, priority=5, send_queue_capacity=64)
+        ]
+
+    def add_peer(self, peer) -> None:
+        if getattr(self.config, "broadcast", True) is False:
+            return
+        stop = threading.Event()
+        t = threading.Thread(
+            target=self._broadcast_tx_routine,
+            args=(peer, stop),
+            daemon=True,
+            name=f"mempool.bcast:{peer.id()[:8]}",
+        )
+        with self._mtx:
+            self._peer_stops[peer.id()] = stop
+            self._peer_threads[peer.id()] = t
+        t.start()
+
+    def remove_peer(self, peer, reason) -> None:
+        with self._mtx:
+            stop = self._peer_stops.pop(peer.id(), None)
+            self._peer_threads.pop(peer.id(), None)
+        if stop:
+            stop.set()
+
+    @staticmethod
+    def _peer_height(peer) -> int | None:
+        """The peer's consensus height, from the consensus reactor's
+        PeerState mirror when both reactors are wired (the reference reads
+        the same shared PeerState, mempool/reactor.go:133-135)."""
+        ps = peer.get("ConsensusReactor.peerState")
+        return ps.get_height() if ps is not None else None
+
+    def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        try:
+            msg = json.loads(msg_bytes.decode())
+            if msg.get("type") != "tx":
+                raise ValueError(f"unknown mempool msg {msg.get('type')!r}")
+            tx = bytes.fromhex(msg["tx"])
+        except (ValueError, KeyError, UnicodeDecodeError) as exc:
+            self.switch.stop_peer_for_error(peer, exc)
+            return
+        try:
+            self.mempool.check_tx(tx)
+        except Exception:  # noqa: BLE001 — dup-in-cache / app reject: fine
+            pass
+
+    # -- gossip ------------------------------------------------------------
+
+    def _broadcast_tx_routine(self, peer, stop: threading.Event) -> None:
+        element = None
+        while self.is_running() and not stop.is_set():
+            if element is None:
+                element = self.mempool.txs_front_wait(timeout=0.5)
+                if element is None:
+                    continue
+            mem_tx = element.value
+            # don't send txs the peer can't process yet (reactor.go:132-143)
+            peer_h = self._peer_height(peer)
+            if peer_h is not None and 0 < peer_h < mem_tx.height - 1:
+                stop.wait(PEER_CATCHUP_SLEEP)
+                continue
+            if not peer.send(MEMPOOL_CHANNEL, _encode_tx(mem_tx.tx)):
+                # full queue / slow peer: retry while it's still connected
+                # (the reference blocks in Send; exiting would silence
+                # mempool gossip to this peer forever)
+                if not self.switch.peers.has(peer.id()):
+                    return
+                stop.wait(PEER_CATCHUP_SLEEP)
+                continue
+            # advance strictly once per sent tx
+            while self.is_running() and not stop.is_set():
+                nxt = element.next_wait(timeout=0.5)
+                if nxt is not None:
+                    element = nxt
+                    break
+                if element.removed:
+                    element = None  # re-fetch front; cache dedups re-sends
+                    break

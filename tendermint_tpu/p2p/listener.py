@@ -38,11 +38,20 @@ class Listener:
         return NetAddress(ip, self._internal.port)
 
     def accept(self) -> socket.socket | None:
-        try:
-            sock, _ = self.sock.accept()
-            return sock
-        except OSError:
-            return None  # closed
+        """Blocks for the next inbound socket; None only once closed.
+        Transient accept errors (ECONNABORTED, fd exhaustion) are retried
+        — they must not permanently stop inbound peering."""
+        import time
+
+        while not self._closed:
+            try:
+                sock, _ = self.sock.accept()
+                return sock
+            except OSError:
+                if self._closed:
+                    return None
+                time.sleep(0.1)
+        return None
 
     def stop(self) -> None:
         if not self._closed:

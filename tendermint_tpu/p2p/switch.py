@@ -134,28 +134,58 @@ class Switch(BaseService):
         while self.is_running():
             sock = listener.accept()
             if sock is None:
-                return
+                return  # listener closed
+            # handshakes run off-thread: one stalled inbound connection
+            # must not block the accept loop
+            threading.Thread(
+                target=self._accept_peer, args=(sock,), daemon=True,
+                name="switch.accept_peer",
+            ).start()
+
+    def _accept_peer(self, sock: socket.socket) -> None:
+        try:
+            self.add_peer_from_stream(SocketStream(sock), outbound=False)
+        except Exception as exc:  # noqa: BLE001 — one bad peer can't kill accept
+            self.logger.info("inbound peer rejected: %s", exc)
             try:
-                self.add_peer_from_stream(SocketStream(sock), outbound=False)
-            except Exception as exc:  # noqa: BLE001 — one bad peer can't kill accept
-                self.logger.info("inbound peer rejected: %s", exc)
+                sock.close()
+            except OSError:
+                pass
 
     # -- peer admission -----------------------------------------------------
 
     def add_peer_from_stream(
-        self, stream, outbound: bool, persistent: bool = False
+        self,
+        stream,
+        outbound: bool,
+        persistent: bool = False,
+        dialed_addr: NetAddress | None = None,
     ) -> Peer:
-        peer = Peer(
-            stream,
-            outbound=outbound,
-            channel_descs=self.ch_descs,
-            on_receive=self._on_peer_receive,
-            on_error=self._on_peer_error,
-            config=self.peer_config,
-            node_priv_key=self.node_priv_key,
-            persistent=persistent,
-        )
-        return self.add_peer(peer)
+        # bound the secret-connection + node-info handshakes: a stalled
+        # remote must not hold this thread (or the dialing slot) forever
+        sock = getattr(stream, "sock", None)
+        if sock is not None:
+            sock.settimeout(self.peer_config.handshake_timeout)
+        try:
+            peer = Peer(
+                stream,
+                outbound=outbound,
+                channel_descs=self.ch_descs,
+                on_receive=self._on_peer_receive,
+                on_error=self._on_peer_error,
+                config=self.peer_config,
+                node_priv_key=self.node_priv_key,
+                persistent=persistent,
+            )
+            peer.dialed_addr = dialed_addr
+            peer = self.add_peer(peer)
+        finally:
+            if sock is not None:
+                try:
+                    sock.settimeout(None)
+                except OSError:
+                    pass
+        return peer
 
     def add_peer(self, peer: Peer) -> Peer:
         """Handshake + filter + register + start (switch.go:216-260)."""
@@ -207,9 +237,11 @@ class Switch(BaseService):
             sock = socket.create_connection(
                 addr.dial_string(), timeout=self.peer_config.dial_timeout
             )
-            sock.settimeout(None)
             return self.add_peer_from_stream(
-                SocketStream(sock), outbound=True, persistent=persistent
+                SocketStream(sock),
+                outbound=True,
+                persistent=persistent,
+                dialed_addr=addr,
             )
         finally:
             with self._mtx:
@@ -250,11 +282,13 @@ class Switch(BaseService):
         self.logger.info("stopping peer %s for error: %s", peer, reason)
         self._stop_and_remove(peer, reason)
         if peer.persistent and self.is_running():
-            info = peer.node_info
-            if info and info.remote_addr:
+            # reconnect to the address WE dialed, not anything the peer
+            # claimed about itself
+            addr = getattr(peer, "dialed_addr", None)
+            if addr is not None:
                 threading.Thread(
                     target=self._reconnect_routine,
-                    args=(info.remote_addr,),
+                    args=(str(addr),),
                     daemon=True,
                     name="switch.reconnect",
                 ).start()
@@ -287,11 +321,11 @@ class Switch(BaseService):
     # -- messaging ----------------------------------------------------------
 
     def broadcast(self, ch_id: int, msg_bytes: bytes) -> None:
-        """Fire-and-forget TrySend to every peer (switch.go:375-392)."""
+        """Fire-and-forget TrySend to every peer (switch.go:375-392).
+        try_send is non-blocking (queue append or drop), so this runs
+        inline — no thread per peer per message."""
         for peer in self.peers.list():
-            threading.Thread(
-                target=peer.try_send, args=(ch_id, msg_bytes), daemon=True
-            ).start()
+            peer.try_send(ch_id, msg_bytes)
 
     def num_peers(self) -> tuple[int, int, int]:
         outbound = sum(1 for p in self.peers.list() if p.outbound)

@@ -7,17 +7,11 @@ anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-
-# The ed25519 ladder programs take minutes to compile on the CPU backend;
-# persist compiled artifacts across test runs.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 
 # The environment's TPU-tunnel plugin re-forces jax_platforms="axon,cpu" at
 # interpreter startup, overriding the JAX_PLATFORMS env var — which makes
@@ -27,8 +21,9 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-try:
-    # CPU-backend persistent caching needs the XLA-level caches enabled too
-    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
-except Exception:
-    pass
+
+# The ed25519 ladder takes ~45s/bucket to compile on the CPU backend;
+# persist compiled artifacts across test runs.
+from tendermint_tpu.jitcache import enable as _enable_jit_cache  # noqa: E402
+
+_enable_jit_cache()

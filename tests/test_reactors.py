@@ -1,0 +1,263 @@
+"""Reactor integration tests: in-process multi-node nets over pipe switches
+(reference: consensus/reactor_test.go, mempool/reactor tests,
+blockchain/reactor fast-sync behavior)."""
+
+from __future__ import annotations
+
+import tempfile
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.abci.apps.counter import CounterApp
+from tendermint_tpu.abci.apps.kvstore import KVStoreApp
+from tendermint_tpu.abci.client import LocalClient
+from tendermint_tpu.blockchain.reactor import BlockchainReactor
+from tendermint_tpu.blockchain.store import BlockStore
+from tendermint_tpu.config import test_config
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.crypto.keys import gen_priv_key_ed25519
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.libs.events import EventSwitch
+from tendermint_tpu.mempool import Mempool
+from tendermint_tpu.mempool.reactor import MempoolReactor
+from tendermint_tpu.p2p import make_connected_switches
+from tendermint_tpu.proxy.app_conn import AppConnConsensus, AppConnMempool
+from tendermint_tpu.state.state import State
+from tendermint_tpu.types import GenesisDoc, GenesisValidator, PrivValidatorFS
+from tendermint_tpu.types import events as tev
+
+TEST_CHAIN_ID = "reactor_test_chain"
+
+
+def wait_until(cond, timeout=30.0, tick=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(tick)
+    return cond()
+
+
+class Node:
+    def __init__(self, cs: ConsensusState, evsw: EventSwitch, mempool: Mempool,
+                 store: BlockStore, state: State):
+        self.cs = cs
+        self.evsw = evsw
+        self.mempool = mempool
+        self.store = store
+        self.state = state
+        self.blocks: list = []
+
+    def subscribe_blocks(self) -> None:
+        self.evsw.add_listener_for_event(
+            "test", tev.EVENT_NEW_BLOCK, lambda d: self.blocks.append(d.block)
+        )
+
+
+def make_genesis(n: int):
+    pvs = [PrivValidatorFS(gen_priv_key_ed25519(), None) for _ in range(n)]
+    pvs.sort(key=lambda pv: pv.get_address())
+    doc = GenesisDoc(
+        genesis_time_ns=time.time_ns(),
+        chain_id=TEST_CHAIN_ID,
+        validators=[GenesisValidator(pv.get_pub_key(), 1, f"v{i}") for i, pv in enumerate(pvs)],
+    )
+    return doc, pvs
+
+
+def make_node(doc: GenesisDoc, pv, app=None) -> Node:
+    config = test_config().consensus
+    config.root_dir = tempfile.mkdtemp(prefix="reactor-test-")
+    app = app if app is not None else CounterApp()
+    mtx = threading.RLock()
+    mempool = Mempool(test_config().mempool, AppConnMempool(LocalClient(app, mtx)))
+    store = BlockStore(MemDB())
+    state = State.get_state(MemDB(), doc)
+    evsw = EventSwitch()
+    evsw.start()
+    cs = ConsensusState(
+        config, state, AppConnConsensus(LocalClient(app, mtx)), store, mempool
+    )
+    cs.set_event_switch(evsw)
+    if pv is not None:
+        cs.set_priv_validator(pv)
+    return Node(cs, evsw, mempool, store, state)
+
+
+def start_consensus_net(n: int, app_factory=None):
+    doc, pvs = make_genesis(n)
+    nodes = [make_node(doc, pvs[i], app_factory() if app_factory else None)
+             for i in range(n)]
+    for node in nodes:
+        node.subscribe_blocks()
+
+    def init(i, sw):
+        node = nodes[i]
+        con_r = ConsensusReactor(node.cs, fast_sync=False)
+        con_r.set_event_switch(node.evsw)
+        sw.add_reactor("CONSENSUS", con_r)
+        mem_r = MempoolReactor(test_config().mempool, node.mempool)
+        sw.add_reactor("MEMPOOL", mem_r)
+        from tendermint_tpu.p2p.node_info import NodeInfo, default_version
+
+        sw.set_node_info(
+            NodeInfo(
+                pub_key=sw.node_priv_key.pub_key(),
+                moniker=f"node{i}",
+                network=TEST_CHAIN_ID,
+                version=default_version("test"),
+            )
+        )
+        return sw
+
+    switches = make_connected_switches(n, init)
+    return nodes, switches
+
+
+def stop_net(nodes, switches):
+    for sw in switches:
+        sw.stop()
+    for node in nodes:
+        node.evsw.stop()
+
+
+# -- consensus reactor --------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_reactor_net_makes_blocks():
+    """4 validators over real reactors: every node commits blocks
+    (consensus/reactor_test.go:24-79)."""
+    nodes, switches = start_consensus_net(4)
+    try:
+        assert wait_until(
+            lambda: all(len(n.blocks) >= 2 for n in nodes), timeout=60
+        ), [len(n.blocks) for n in nodes]
+        # all nodes agree on block 1's hash
+        h1 = [n.store.load_block(1).hash() for n in nodes]
+        assert len(set(h1)) == 1
+    finally:
+        stop_net(nodes, switches)
+
+
+@pytest.mark.slow
+def test_reactor_net_commits_txs():
+    """A tx checked into one node's mempool gossips to the proposer and
+    lands in a block everywhere (atomic-broadcast shape)."""
+    nodes, switches = start_consensus_net(4, app_factory=KVStoreApp)
+    try:
+        tx = b"reactor-test-key=reactor-test-value"
+        nodes[3].mempool.check_tx(tx)
+        assert wait_until(
+            lambda: all(
+                any(tx in b.data.txs for b in n.blocks) for n in nodes
+            ),
+            timeout=60,
+        ), [sum(len(b.data.txs) for b in n.blocks) for n in nodes]
+    finally:
+        stop_net(nodes, switches)
+
+
+# -- fast sync ----------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fast_sync_catches_up_and_switches():
+    """Node B starts empty with fast_sync=True against node A's chain;
+    it downloads+verifies+applies blocks, then switches to consensus
+    (blockchain/reactor.go:174-262, 204-217)."""
+    doc, pvs = make_genesis(1)
+    # -- node A: sole validator, builds a chain by itself
+    node_a = make_node(doc, pvs[0])
+    # -- node B: non-validator, fast syncs
+    node_b = make_node(doc, None)
+
+    def init(i, sw):
+        node = (node_a, node_b)[i]
+        fast_sync = i == 1
+        con_r = ConsensusReactor(node.cs, fast_sync=fast_sync)
+        con_r.set_event_switch(node.evsw)
+        sw.add_reactor("CONSENSUS", con_r)
+        # the reactor owns its own state copy, like the reference's
+        # node wiring (node.go:206-227 passes state.Copy() to each)
+        bc_r = BlockchainReactor(
+            node.state.copy(),
+            node.cs.proxy_app_conn,
+            node.store,
+            fast_sync=fast_sync,
+            event_cache=None,
+            status_update_interval=0.5,  # test chains move fast
+        )
+        sw.add_reactor("BLOCKCHAIN", bc_r)
+        from tendermint_tpu.p2p.node_info import NodeInfo, default_version
+
+        sw.set_node_info(
+            NodeInfo(
+                pub_key=sw.node_priv_key.pub_key(),
+                moniker=f"node{i}",
+                network=TEST_CHAIN_ID,
+                version=default_version("test"),
+            )
+        )
+        return sw
+
+    node_a.subscribe_blocks()
+    node_b.subscribe_blocks()
+    from tendermint_tpu.p2p import Switch, connect2_switches
+
+    switches = [init(i, Switch()) for i in range(2)]
+    for sw in switches:
+        sw.start()
+    try:
+        # A builds its chain alone, then freezes — a fixed catch-up target
+        assert wait_until(lambda: node_a.store.height() >= 8, timeout=60)
+        node_a.cs.stop()
+        target = node_a.store.height()
+        connect2_switches(switches, 0, 1)
+        assert wait_until(
+            lambda: node_b.store.height() >= target, timeout=60
+        ), f"B at {node_b.store.height()}, A at {target}"
+        got = node_b.store.load_block(2)
+        want = node_a.store.load_block(2)
+        assert got is not None and got.hash() == want.hash()
+        # and B switched over to consensus mode
+        con_r_b = switches[1].reactor("CONSENSUS")
+        assert wait_until(lambda: not con_r_b.fast_sync, timeout=30)
+    finally:
+        stop_net([node_a, node_b], switches)
+
+
+# -- mempool reactor ----------------------------------------------------------
+
+
+def test_mempool_reactor_gossips_txs():
+    """Tx checked on one node appears in the other's mempool."""
+    doc, _pvs = make_genesis(1)
+    n1, n2 = make_node(doc, None, CounterApp()), make_node(doc, None, CounterApp())
+
+    def init(i, sw):
+        node = (n1, n2)[i]
+        sw.add_reactor("MEMPOOL", MempoolReactor(test_config().mempool, node.mempool))
+        from tendermint_tpu.p2p.node_info import NodeInfo, default_version
+
+        sw.set_node_info(
+            NodeInfo(
+                pub_key=sw.node_priv_key.pub_key(),
+                moniker=f"m{i}",
+                network=TEST_CHAIN_ID,
+                version=default_version("test"),
+            )
+        )
+        return sw
+
+    switches = make_connected_switches(2, init)
+    try:
+        tx = (0).to_bytes(8, "big")  # counter app wants ordered u64 txs
+        n1.mempool.check_tx(tx)
+        assert wait_until(lambda: n2.mempool.size() == 1, timeout=10)
+        assert n2.mempool.reap(10) == [tx]
+    finally:
+        stop_net([n1, n2], switches)
