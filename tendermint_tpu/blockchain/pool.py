@@ -68,7 +68,6 @@ class BlockPool(BaseService):
         self.max_peer_height = 0
         self.request_fn = request_fn
         self.timeout_fn = timeout_fn
-        self.num_pending = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -169,7 +168,6 @@ class BlockPool(BaseService):
             if req is None or req.peer_id != peer_id or req.block is not None:
                 return  # unsolicited or duplicate
             req.block = block
-            self.num_pending += 0  # bookkeeping parity
             peer = self.peers.get(peer_id)
             if peer:
                 peer.num_pending = max(0, peer.num_pending - 1)

@@ -174,7 +174,7 @@ class BlockchainReactor(Reactor, BaseService):
                 self.broadcast_status_request()
             if now - last_switch_check >= SWITCH_TO_CONSENSUS_INTERVAL:
                 last_switch_check = now
-                if self.pool.is_caught_up() and self.blocks_synced >= 0:
+                if self.pool.is_caught_up():
                     self.logger.info("caught up; switching to consensus")
                     self.pool.stop()
                     con_r = self.switch.reactor("CONSENSUS")
@@ -182,9 +182,13 @@ class BlockchainReactor(Reactor, BaseService):
                         con_r.switch_to_consensus(self.state)
                     return
             synced_any = self._try_sync()
-            if self.blocks_synced and self.blocks_synced % 100 == 0:
+            # rate sample on each actual crossing of a 100-block boundary
+            if synced_any and self.blocks_synced % 100 == 0:
                 dt = max(time.monotonic() - last_hundred, 1e-9)
-                self.sync_rate = 0.9 * self.sync_rate + 0.1 * (100 / dt) if self.sync_rate else 100 / dt
+                inst = 100 / dt
+                self.sync_rate = (
+                    0.9 * self.sync_rate + 0.1 * inst if self.sync_rate else inst
+                )
                 last_hundred = time.monotonic()
             if not synced_any:
                 time.sleep(TRY_SYNC_INTERVAL)

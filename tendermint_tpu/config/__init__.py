@@ -8,7 +8,7 @@ from tendermint_tpu.config.config import (
     default_config,
     test_config,
 )
-from tendermint_tpu.config.toml import ensure_root, reset_test_root
+from tendermint_tpu.config.toml import ensure_root, load_config, reset_test_root
 
 __all__ = [
     "Config",
@@ -20,5 +20,6 @@ __all__ = [
     "default_config",
     "test_config",
     "ensure_root",
+    "load_config",
     "reset_test_root",
 ]

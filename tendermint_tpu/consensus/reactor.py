@@ -205,6 +205,8 @@ class PeerState:
             ps_catchup_round = prs.catchup_commit_round
             ps_catchup = prs.catchup_commit
 
+            ps_precommits = prs.precommits  # before the reset below
+
             prs.height = msg.height
             prs.round_ = msg.round_
             prs.step = msg.step
@@ -221,10 +223,10 @@ class PeerState:
                msg.round_ == ps_catchup_round:
                 prs.precommits = ps_catchup
             if psheight != msg.height:
-                # shift precommits to last_commit
+                # shift the H-precommits the peer had to last_commit
                 if psheight + 1 == msg.height and psround == msg.last_commit_round:
                     prs.last_commit_round = msg.last_commit_round
-                    prs.last_commit = prs.precommits
+                    prs.last_commit = ps_precommits
                 else:
                     prs.last_commit_round = msg.last_commit_round
                     prs.last_commit = None
@@ -246,19 +248,18 @@ class PeerState:
         self.set_has_vote(msg.height, msg.round_, msg.type_, msg.index)
 
     def apply_vote_set_bits(self, msg: msgs.VoteSetBitsMessage, our_votes: BitArray | None) -> None:
-        """reactor.go:1126-1149: if we know our votes for that BlockID,
-        mark union(msg.votes, ours); else replace wholesale."""
+        """reactor.go:1126-1149. ourVotes is a MASK of what we know we
+        hold for that BlockID: keep the peer-bits that aren't ours, OR in
+        the peer's report, and REPLACE — never mark the peer as having
+        votes only we hold."""
         with self._mtx:
             ba = self._get_vote_bit_array(msg.height, msg.round_, msg.type_)
             if ba is None:
                 return
             if our_votes is not None:
-                have = msg.votes.or_(our_votes)
-                new_bits = ba.or_(have)
+                ba.update(ba.sub(our_votes).or_(msg.votes))
             else:
-                new_bits = ba.or_(msg.votes)
-            for i in new_bits.indices():
-                ba.set_index(i, True)
+                ba.update(msg.votes)
 
 
 class ConsensusReactor(Reactor, BaseService):
@@ -452,10 +453,15 @@ class ConsensusReactor(Reactor, BaseService):
             s.set()
 
     def switch_to_consensus(self, state) -> None:
-        """Fast sync complete (reactor.go:78-90)."""
+        """Fast sync complete (reactor.go:78-90). Note: update BEFORE
+        reconstruct (the NewConsensusState ordering, state.go:327-330) —
+        the reactor's reconstruct-first ordering in the reference lets
+        updateToState clobber the freshly rebuilt LastCommit to nil,
+        which breaks proposing at the switch height."""
         self.logger.info("switching to consensus at height %d", state.last_block_height + 1)
-        self.con_s.reconstruct_last_commit(state)
-        self.con_s.update_to_state(state)
+        self.con_s.update_to_state(state.copy())
+        if state.last_block_height > 0:
+            self.con_s.reconstruct_last_commit(state)
         self.fast_sync = False
         self.con_s.start()
 
@@ -712,7 +718,10 @@ class ConsensusReactor(Reactor, BaseService):
                     if maj is not None:
                         sends.append((prs.proposal_pol_round, VOTE_TYPE_PREVOTE, maj))
             for round_, type_, block_id in sends:
+                # maj23 claims ride the STATE channel, where receive()
+                # handles them (reference reactor.go:662 sends these on
+                # StateChannel too)
                 peer.try_send(
-                    VOTE_SET_BITS_CHANNEL,
+                    STATE_CHANNEL,
                     _enc(msgs.VoteSetMaj23Message(prs.height, round_, type_, block_id)),
                 )

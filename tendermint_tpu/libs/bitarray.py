@@ -61,6 +61,13 @@ class BitArray:
         with self._mtx:
             return BitArray.from_int(self._bits, self._elems)
 
+    def update(self, other: "BitArray") -> None:
+        """Replace this array's bits with other's (tmlibs BitArray.Update,
+        used by ApplyVoteSetBitsMessage's replace semantics)."""
+        mask = other.as_int()
+        with self._mtx:
+            self._elems = mask & ((1 << self._bits) - 1)
+
     def as_int(self) -> int:
         with self._mtx:
             return self._elems

@@ -1,0 +1,3 @@
+from tendermint_tpu.node.node import Node, default_new_node
+
+__all__ = ["Node", "default_new_node"]

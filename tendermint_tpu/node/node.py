@@ -1,0 +1,246 @@
+"""Node assembly (reference: node/node.go).
+
+Wires the whole stack in the reference's order (node.go:113-307):
+DBs -> block store -> state -> proxy app (started here, with ABCI
+handshake, node.go:152-158) -> tx indexer -> event switch -> reactors
+(blockchain, mempool, consensus) -> p2p switch (+ optional PEX) ->
+on start: listener, dial seeds, RPC.
+
+The TPU crypto gateway (ops.gateway) is constructed once here and shared
+by every verification site — consensus vote verify, commit verify in
+block execution, and fast-sync — so all hot-path signatures flow through
+one batching point.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.blockchain.reactor import BlockchainReactor
+from tendermint_tpu.blockchain.store import BlockStore
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.consensus.replay import Handshaker
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.libs.db import db_provider
+from tendermint_tpu.libs.events import EventSwitch
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.mempool import Mempool
+from tendermint_tpu.mempool.reactor import MempoolReactor
+from tendermint_tpu.ops import gateway
+from tendermint_tpu.p2p import NodeInfo, PeerConfig, Switch
+from tendermint_tpu.p2p.addrbook import AddrBook
+from tendermint_tpu.p2p.conn import MConnConfig
+from tendermint_tpu.p2p.listener import Listener
+from tendermint_tpu.p2p.node_info import default_version
+from tendermint_tpu.p2p.pex import PEXReactor
+from tendermint_tpu.proxy.client_creator import default_client_creator
+from tendermint_tpu.proxy.multi_app_conn import AppConns
+from tendermint_tpu.state.state import State
+from tendermint_tpu.state.txindex import KVTxIndexer, NullTxIndexer
+from tendermint_tpu.types import GenesisDoc, PrivValidatorFS
+from tendermint_tpu.version import VERSION
+
+
+def _parse_laddr(laddr: str) -> str:
+    """'tcp://host:port' -> 'host:port'."""
+    return laddr.split("://", 1)[-1]
+
+
+def default_new_node(config) -> "Node":
+    """node/node.go:74-110: load/generate privval, default app client."""
+    priv_validator = PrivValidatorFS.load_or_generate(
+        config.base.priv_validator_file()
+    )
+    return Node(
+        config,
+        priv_validator,
+        default_client_creator(config.base.proxy_app, config.base.db_dir()),
+    )
+
+
+class Node(BaseService):
+    def __init__(self, config, priv_validator, client_creator, genesis_doc=None):
+        super().__init__(name="node")
+        self.config = config
+
+        # -- DBs + genesis (node.go:121-146) ------------------------------
+        backend = config.base.db_backend
+        db_dir = config.base.db_dir()
+        block_store_db = db_provider("blockstore", backend, db_dir)
+        state_db = db_provider("state", backend, db_dir)
+        self.block_store = BlockStore(block_store_db)
+        if genesis_doc is None:
+            genesis_doc = GenesisDoc.from_file(config.base.genesis_file())
+        self.genesis_doc = genesis_doc
+        self.priv_validator = priv_validator
+
+        # -- TPU crypto gateway: one batching point for every verify site
+        self.verifier = gateway.default_verifier()
+
+        # -- tx index (node.go:164-176) -----------------------------------
+        if config.base.tx_index == "kv":
+            tx_indexer = KVTxIndexer(db_provider("tx_index", backend, db_dir))
+        else:
+            tx_indexer = NullTxIndexer()
+        self.tx_indexer = tx_indexer
+
+        # -- state --------------------------------------------------------
+        state = State.get_state(state_db, genesis_doc)
+        state.tx_indexer = tx_indexer
+
+        # -- proxy app, started now with handshake so state/store/app are
+        # in sync before anything else wires up (node.go:152-158) ---------
+        self.proxy_app = AppConns(client_creator, Handshaker(state, self.block_store))
+        self.proxy_app.start()
+
+        # -- event switch (node.go:182-185) -------------------------------
+        self.evsw = EventSwitch()
+
+        # -- decide fast sync (node.go:188-196: skip if we're the sole
+        # validator — we'd wait forever for peers) ------------------------
+        fast_sync = config.base.fast_sync
+        if state.validators.size() == 1 and priv_validator is not None:
+            _addr, val = state.validators.get_by_index(0)
+            if val.address == priv_validator.get_address():
+                fast_sync = False
+        self.fast_sync = fast_sync
+
+        # -- mempool (node.go:206-212) ------------------------------------
+        self.mempool = Mempool(config.mempool, self.proxy_app.mempool())
+        self.mempool.init_wal()
+        self.mempool_reactor = MempoolReactor(config.mempool, self.mempool)
+
+        # -- consensus ----------------------------------------------------
+        self.consensus_state = ConsensusState(
+            config.consensus,
+            state.copy(),
+            self.proxy_app.consensus(),
+            self.block_store,
+            self.mempool,
+            verifier=self.verifier,
+        )
+        if priv_validator is not None:
+            self.consensus_state.set_priv_validator(priv_validator)
+        self.consensus_state.set_event_switch(self.evsw)
+        self.consensus_reactor = ConsensusReactor(self.consensus_state, fast_sync)
+        self.consensus_reactor.set_event_switch(self.evsw)
+
+        # -- blockchain (fast sync) reactor -------------------------------
+        self.blockchain_reactor = BlockchainReactor(
+            state.copy(),
+            self.proxy_app.consensus(),
+            self.block_store,
+            fast_sync,
+            event_cache=None,
+            batch_verifier=self.verifier.commit_batch_verifier(),
+        )
+
+        # -- p2p switch (node.go:231-245) ---------------------------------
+        peer_config = PeerConfig(
+            mconfig=MConnConfig(
+                send_rate=float(config.p2p.send_rate),
+                recv_rate=float(config.p2p.recv_rate),
+                flush_throttle=config.p2p.flush_throttle_timeout,
+            )
+        )
+        self.sw = Switch(config.p2p, peer_config)
+        self.sw.add_reactor("MEMPOOL", self.mempool_reactor)
+        self.sw.add_reactor("BLOCKCHAIN", self.blockchain_reactor)
+        self.sw.add_reactor("CONSENSUS", self.consensus_reactor)
+
+        self.addr_book = AddrBook(
+            config.p2p.addr_book(), config.p2p.addr_book_strict
+        )
+        if config.p2p.pex_reactor:
+            self.pex_reactor = PEXReactor(self.addr_book)
+            self.sw.add_reactor("PEX", self.pex_reactor)
+        else:
+            self.pex_reactor = None
+
+        # -- ABCI-query-backed peer filters (node.go:250-272) -------------
+        if config.base.filter_peers:
+            def filter_addr(addr):
+                res = self.proxy_app.query().query_sync(
+                    data=b"", path=f"/p2p/filter/addr/{addr}"
+                )
+                if not res.is_ok:
+                    raise ConnectionError(f"filtered addr {addr}: {res.log}")
+
+            def filter_pubkey(pubkey):
+                res = self.proxy_app.query().query_sync(
+                    data=b"", path=f"/p2p/filter/pubkey/{pubkey.raw.hex()}"
+                )
+                if not res.is_ok:
+                    raise ConnectionError(f"filtered pubkey: {res.log}")
+
+            self.sw.filter_conn_by_addr = filter_addr
+            self.sw.filter_conn_by_pubkey = filter_pubkey
+
+        self.state = state
+        self.listener: Listener | None = None
+        self.rpc_server = None
+
+    # -- lifecycle (node.go:310-352) --------------------------------------
+
+    def on_start(self) -> None:
+        self.evsw.start()
+
+        # p2p listener
+        if self.config.p2p.laddr:
+            self.listener = Listener(_parse_laddr(self.config.p2p.laddr))
+            self.sw.add_listener(self.listener)
+
+        info = NodeInfo(
+            pub_key=self.sw.node_priv_key.pub_key(),
+            moniker=self.config.base.moniker,
+            network=self.genesis_doc.chain_id,
+            version=default_version(VERSION),
+            listen_addr=(
+                str(self.listener.external_address()) if self.listener else ""
+            ),
+            other=["consensus_version=v1", f"rpc_addr={self.config.rpc.laddr}"],
+        )
+        self.sw.set_node_info(info)
+        if self.listener:
+            self.addr_book.add_our_address(self.listener.external_address())
+        self.sw.start()
+
+        if self.config.p2p.seeds:
+            seeds = [s.strip() for s in self.config.p2p.seeds.split(",") if s.strip()]
+            self.sw.dial_seeds(seeds, self.addr_book if self.pex_reactor else None)
+
+        if self.config.rpc.laddr:
+            self._start_rpc()
+
+    def on_stop(self) -> None:
+        if self.rpc_server is not None:
+            self.rpc_server.stop()
+        self.sw.stop()
+        self.mempool.close_wal()
+        self.proxy_app.stop()
+        self.evsw.stop()
+
+    def _start_rpc(self) -> None:
+        from tendermint_tpu.rpc.core.pipe import RPCContext
+        from tendermint_tpu.rpc.server import RPCServer
+
+        ctx = RPCContext(
+            event_switch=self.evsw,
+            block_store=self.block_store,
+            consensus_state=self.consensus_state,
+            mempool=self.mempool,
+            switch=self.sw,
+            proxy_app_query=self.proxy_app.query(),
+            genesis_doc=self.genesis_doc,
+            priv_validator=self.priv_validator,
+            tx_indexer=self.tx_indexer,
+            node=self,
+        )
+        self.rpc_server = RPCServer(
+            _parse_laddr(self.config.rpc.laddr), ctx, unsafe=self.config.rpc.unsafe
+        )
+        self.rpc_server.start()
+
+    # -- introspection ------------------------------------------------------
+
+    def rpc_port(self) -> int:
+        assert self.rpc_server is not None
+        return self.rpc_server.port

@@ -1,0 +1,205 @@
+"""RPC clients (reference: rpc/client/httpclient.go, localclient.go,
+rpc/lib/client/ws_client.go).
+
+HTTPClient speaks JSON-RPC over HTTP; WSClient adds event subscriptions;
+LocalClient calls handlers in-process against an RPCContext (no sockets),
+which is what tests and in-node tooling use.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import queue
+import socket
+import struct
+import threading
+import urllib.request
+
+from tendermint_tpu.rpc.core.routes import build_routes
+
+
+class RPCClientError(Exception):
+    pass
+
+
+class HTTPClient:
+    def __init__(self, addr: str, timeout: float = 30.0):
+        # addr: "host:port" or "http://host:port"
+        if not addr.startswith("http"):
+            addr = "http://" + addr
+        self.addr = addr.rstrip("/")
+        self.timeout = timeout
+        self._id = 0
+
+    def call(self, method: str, **params):
+        self._id += 1
+        req = {
+            "jsonrpc": "2.0",
+            "id": self._id,
+            "method": method,
+            "params": params,
+        }
+        data = json.dumps(req).encode()
+        r = urllib.request.Request(
+            self.addr + "/",
+            data=data,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(r, timeout=self.timeout) as resp:
+                body = json.loads(resp.read().decode())
+        except urllib.error.HTTPError as exc:
+            # JSON-RPC errors ride non-200 statuses with a JSON body
+            try:
+                body = json.loads(exc.read().decode())
+            except ValueError:
+                raise RPCClientError(f"HTTP {exc.code}") from exc
+        if body.get("error"):
+            raise RPCClientError(body["error"])
+        return body["result"]
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return lambda **params: self.call(name, **params)
+
+
+class LocalClient:
+    """In-process client: handler table against a live RPCContext
+    (reference rpc/client/localclient.go)."""
+
+    def __init__(self, ctx, unsafe: bool = False):
+        self.ctx = ctx
+        self.routes = build_routes(unsafe)
+
+    def call(self, method: str, **params):
+        route = self.routes.get(method)
+        if route is None:
+            raise RPCClientError(f"unknown method {method!r}")
+        fn, _known = route
+        return fn(self.ctx, **params)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return lambda **params: self.call(name, **params)
+
+
+class WSClient:
+    """Minimal RFC6455 client for the /websocket endpoint: JSON-RPC calls
+    and an event queue for subscriptions."""
+
+    def __init__(self, addr: str, timeout: float = 30.0):
+        host, _, port = addr.replace("http://", "").replace("ws://", "").rpartition(":")
+        self.sock = socket.create_connection((host, int(port)), timeout=timeout)
+        key = base64.b64encode(os.urandom(16)).decode()
+        self.sock.sendall(
+            (
+                f"GET /websocket HTTP/1.1\r\nHost: {host}:{port}\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+            ).encode()
+        )
+        # consume the 101 response headers
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                raise RPCClientError("ws handshake failed")
+            buf += chunk
+        if b"101" not in buf.split(b"\r\n", 1)[0]:
+            raise RPCClientError(f"ws handshake rejected: {buf[:200]!r}")
+        self.events: queue.Queue = queue.Queue()
+        self.responses: queue.Queue = queue.Queue()
+        self._id = 0
+        self._recv_thread = threading.Thread(
+            target=self._recv_loop, daemon=True, name="wsclient.recv"
+        )
+        self._recv_thread.start()
+
+    # -- frames ------------------------------------------------------------
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("ws closed")
+            buf += chunk
+        return bytes(buf)
+
+    def _send_frame(self, opcode: int, payload: bytes) -> None:
+        mask = os.urandom(4)
+        head = bytearray([0x80 | opcode])
+        n = len(payload)
+        if n < 126:
+            head.append(0x80 | n)
+        elif n < 1 << 16:
+            head.append(0x80 | 126)
+            head += struct.pack(">H", n)
+        else:
+            head.append(0x80 | 127)
+            head += struct.pack(">Q", n)
+        head += mask
+        masked = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
+        self.sock.sendall(bytes(head) + masked)
+
+    def _recv_loop(self) -> None:
+        try:
+            while True:
+                b1, b2 = self._read_exact(2)
+                opcode = b1 & 0x0F
+                length = b2 & 0x7F
+                if length == 126:
+                    (length,) = struct.unpack(">H", self._read_exact(2))
+                elif length == 127:
+                    (length,) = struct.unpack(">Q", self._read_exact(8))
+                payload = self._read_exact(length)
+                if opcode == 0x9:
+                    self._send_frame(0xA, payload)
+                    continue
+                if opcode == 0x8:
+                    return
+                if opcode not in (0x1, 0x2):
+                    continue
+                msg = json.loads(payload.decode())
+                result = msg.get("result") or {}
+                if isinstance(result, dict) and "event" in result:
+                    self.events.put(result)
+                else:
+                    self.responses.put(msg)
+        except (ConnectionError, OSError):
+            pass
+
+    # -- API ---------------------------------------------------------------
+
+    def call(self, method: str, timeout: float = 10.0, **params):
+        self._id += 1
+        self._send_frame(
+            0x1,
+            json.dumps(
+                {"jsonrpc": "2.0", "id": self._id, "method": method, "params": params}
+            ).encode(),
+        )
+        msg = self.responses.get(timeout=timeout)
+        if msg.get("error"):
+            raise RPCClientError(msg["error"])
+        return msg["result"]
+
+    def subscribe(self, event: str) -> None:
+        self.call("subscribe", event=event)
+
+    def unsubscribe(self, event: str) -> None:
+        self.call("unsubscribe", event=event)
+
+    def next_event(self, timeout: float = 10.0) -> dict:
+        return self.events.get(timeout=timeout)
+
+    def close(self) -> None:
+        try:
+            self._send_frame(0x8, b"")
+            self.sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,4 @@
+from tendermint_tpu.rpc.core.pipe import RPCContext
+from tendermint_tpu.rpc.core.routes import build_routes
+
+__all__ = ["RPCContext", "build_routes"]

@@ -1,0 +1,343 @@
+"""RPC method implementations (reference: rpc/core/*.go).
+
+Every handler takes (ctx: RPCContext, **params) and returns a JSON-ready
+dict. Byte params arrive hex-encoded; byte results leave hex-encoded
+(uppercase, matching the codebase's canonical JSON style).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tendermint_tpu.types import events as tev
+from tendermint_tpu.types.tx import tx_hash
+
+
+class RPCError(Exception):
+    pass
+
+
+def _hex(b: bytes) -> str:
+    return b.hex().upper()
+
+
+def _unhex(s) -> bytes:
+    if isinstance(s, bytes):
+        return s
+    return bytes.fromhex(s)
+
+
+# -- status / net_info (rpc/core/status.go, net_info.go) ----------------------
+
+
+def status(ctx) -> dict:
+    latest_height = ctx.block_store.height()
+    latest_meta = ctx.block_store.load_block_meta(latest_height)
+    latest_hash, latest_app_hash, latest_time = b"", b"", 0
+    if latest_meta is not None:
+        latest_hash = latest_meta.block_id.hash
+        latest_app_hash = latest_meta.header.app_hash
+        latest_time = latest_meta.header.time_ns
+    info = ctx.switch.node_info if ctx.switch else None
+    return {
+        "node_info": info.to_json() if info else None,
+        "pub_key": ctx.priv_validator.get_pub_key().to_json()
+        if ctx.priv_validator
+        else None,
+        "latest_block_hash": _hex(latest_hash),
+        "latest_app_hash": _hex(latest_app_hash),
+        "latest_block_height": latest_height,
+        "latest_block_time": latest_time,
+    }
+
+
+def net_info(ctx) -> dict:
+    peers = []
+    for peer in ctx.switch.peers.list():
+        peers.append(
+            {
+                "node_info": peer.node_info.to_json() if peer.node_info else None,
+                "is_outbound": peer.outbound,
+                "connection_status": peer.status(),
+            }
+        )
+    return {
+        "listening": bool(ctx.switch.listeners),
+        "listeners": [str(l.internal_address()) for l in ctx.switch.listeners],
+        "peers": peers,
+    }
+
+
+def genesis(ctx) -> dict:
+    return {"genesis": ctx.genesis_doc.to_json()}
+
+
+# -- blockchain (rpc/core/blocks.go) ------------------------------------------
+
+
+def blockchain_info(ctx, min_height: int = 0, max_height: int = 0) -> dict:
+    store_height = ctx.block_store.height()
+    max_height = min(store_height, max_height) if max_height else store_height
+    min_height = max(1, min_height) if min_height else max(1, max_height - 20 + 1)
+    if min_height > max_height:
+        raise RPCError(f"min height {min_height} > max height {max_height}")
+    metas = []
+    for h in range(max_height, min_height - 1, -1):
+        meta = ctx.block_store.load_block_meta(h)
+        if meta is not None:
+            metas.append(meta.to_json())
+    return {"last_height": store_height, "block_metas": metas}
+
+
+def block(ctx, height: int) -> dict:
+    height = int(height)
+    if height <= 0:
+        raise RPCError("height must be greater than 0")
+    if height > ctx.block_store.height():
+        raise RPCError("height must be less than or equal to the head")
+    meta = ctx.block_store.load_block_meta(height)
+    blk = ctx.block_store.load_block(height)
+    return {
+        "block_meta": meta.to_json() if meta else None,
+        "block": blk.to_json() if blk else None,
+    }
+
+
+def commit(ctx, height: int) -> dict:
+    height = int(height)
+    store_height = ctx.block_store.height()
+    if height <= 0:
+        raise RPCError("height must be greater than 0")
+    if height > store_height:
+        raise RPCError("height must be less than or equal to the head")
+    header = ctx.block_store.load_block_meta(height).header
+    if height == store_height:
+        cmt = ctx.block_store.load_seen_commit(height)
+        canonical = False
+    else:
+        cmt = ctx.block_store.load_block_commit(height)
+        canonical = True
+    return {
+        "header": header.to_json(),
+        "commit": cmt.to_json() if cmt else None,
+        "canonical_commit": canonical,
+    }
+
+
+def validators(ctx) -> dict:
+    rs = ctx.consensus_state.get_round_state()
+    return {
+        "block_height": rs.height - 1,
+        "validators": rs.validators.to_json() if rs.validators else None,
+    }
+
+
+def dump_consensus_state(ctx) -> dict:
+    rs = ctx.consensus_state.get_round_state()
+    peer_states = {}
+    for peer in ctx.switch.peers.list():
+        ps = peer.get("ConsensusReactor.peerState")
+        if ps is not None:
+            prs = ps.get_round_state()
+            peer_states[peer.id()] = {
+                "height": prs.height,
+                "round": prs.round_,
+                "step": prs.step,
+                "proposal": prs.proposal,
+            }
+    return {"round_state": rs.to_json(), "peer_round_states": peer_states}
+
+
+# -- mempool (rpc/core/mempool.go) --------------------------------------------
+
+
+def broadcast_tx_async(ctx, tx) -> dict:
+    tx = _unhex(tx)
+    ctx.mempool.check_tx(tx)
+    return {"hash": _hex(tx_hash(tx)), "code": 0, "data": "", "log": ""}
+
+
+def broadcast_tx_sync(ctx, tx) -> dict:
+    """Waits for the CheckTx response (rpc/core/mempool.go:47-77)."""
+    tx = _unhex(tx)
+    done = threading.Event()
+    box = {}
+
+    def cb(res):
+        box["res"] = res
+        done.set()
+
+    ctx.mempool.check_tx(tx, cb)
+    if not done.wait(10.0):
+        raise RPCError("timed out waiting for CheckTx")
+    res = box["res"]
+    return {
+        "code": res.code,
+        "data": _hex(res.data or b""),
+        "log": res.log,
+        "hash": _hex(tx_hash(tx)),
+    }
+
+
+def broadcast_tx_commit(ctx, tx, timeout: float = 60.0) -> dict:
+    """CheckTx, then wait for the tx to be committed in a block
+    (rpc/core/mempool.go:149-230; 60s cap)."""
+    tx = _unhex(tx)
+    committed = threading.Event()
+    box = {}
+
+    listener_id = f"rpc-tx-{_hex(tx_hash(tx))[:16]}-{time.monotonic_ns()}"
+    event = tev.event_string_tx(tx_hash(tx))
+
+    def on_tx(data):
+        box["deliver"] = data
+        committed.set()
+
+    ctx.event_switch.add_listener_for_event(listener_id, event, on_tx)
+    try:
+        check_done = threading.Event()
+
+        def cb(res):
+            box["check"] = res
+            check_done.set()
+
+        ctx.mempool.check_tx(tx, cb)
+        if not check_done.wait(10.0):
+            raise RPCError("timed out waiting for CheckTx")
+        check = box["check"]
+        check_json = {
+            "code": check.code,
+            "data": _hex(check.data or b""),
+            "log": check.log,
+        }
+        if check.code != 0:
+            return {
+                "check_tx": check_json,
+                "deliver_tx": None,
+                "hash": _hex(tx_hash(tx)),
+                "height": 0,
+            }
+        if not committed.wait(timeout):
+            raise RPCError("timed out waiting for tx to be committed")
+        d = box["deliver"]
+        return {
+            "check_tx": check_json,
+            "deliver_tx": {"code": d.code, "data": _hex(d.data or b""), "log": d.log},
+            "hash": _hex(tx_hash(tx)),
+            "height": d.height,
+        }
+    finally:
+        ctx.event_switch.remove_listener(listener_id)
+
+
+def unconfirmed_txs(ctx) -> dict:
+    txs = ctx.mempool.reap(-1) if hasattr(ctx.mempool, "reap") else []
+    return {"n_txs": len(txs), "txs": [_hex(t) for t in txs]}
+
+
+def num_unconfirmed_txs(ctx) -> dict:
+    return {"n_txs": ctx.mempool.size(), "txs": None}
+
+
+# -- tx lookup with proof (rpc/core/tx.go) ------------------------------------
+
+
+def tx(ctx, hash, prove: bool = False) -> dict:
+    h = _unhex(hash)
+    res = ctx.tx_indexer.get(h)
+    if res is None:
+        raise RPCError(f"tx ({_hex(h)}) not found")
+    out = {
+        "height": res.height,
+        "index": res.index,
+        "tx_result": {
+            "code": res.result.code,
+            "data": _hex(res.result.data or b""),
+            "log": res.result.log,
+        },
+        "tx": _hex(bytes(res.tx)),
+    }
+    if prove:
+        from tendermint_tpu.types.tx import txs_proof
+
+        blk = ctx.block_store.load_block(res.height)
+        proof = txs_proof(blk.data.txs, res.index)
+        out["proof"] = proof.to_json()
+    return out
+
+
+# -- abci passthrough (rpc/core/abci.go) --------------------------------------
+
+
+def abci_query(ctx, data=b"", path: str = "", height: int = 0, prove: bool = False) -> dict:
+    res = ctx.proxy_app_query.query_sync(
+        data=_unhex(data) if data else b"", path=path, height=int(height), prove=prove
+    )
+    return {
+        "response": {
+            "code": res.code,
+            "index": getattr(res, "index", 0),
+            "key": _hex(getattr(res, "key", b"") or b""),
+            "value": _hex(res.value or b""),
+            "log": res.log,
+            "height": getattr(res, "height", 0),
+        }
+    }
+
+
+def abci_info(ctx) -> dict:
+    res = ctx.proxy_app_query.info_sync()
+    return {
+        "response": {
+            "data": res.data,
+            "version": getattr(res, "version", ""),
+            "last_block_height": res.last_block_height,
+            "last_block_app_hash": _hex(res.last_block_app_hash or b""),
+        }
+    }
+
+
+# -- unsafe (rpc/core/net.go, dev.go, mempool.go) -----------------------------
+
+
+def unsafe_dial_seeds(ctx, seeds) -> dict:
+    if isinstance(seeds, str):
+        seeds = [s for s in seeds.split(",") if s]
+    if not seeds:
+        raise RPCError("no seeds provided")
+    ctx.switch.dial_seeds(list(seeds))
+    return {"log": "dialing seeds in rounds"}
+
+
+def unsafe_flush_mempool(ctx) -> dict:
+    ctx.mempool.flush()
+    return {}
+
+
+ROUTES_TABLE = {
+    # info API
+    "status": (status, []),
+    "net_info": (net_info, []),
+    "genesis": (genesis, []),
+    "blockchain": (blockchain_info, ["min_height", "max_height"]),
+    "block": (block, ["height"]),
+    "commit": (commit, ["height"]),
+    "validators": (validators, []),
+    "dump_consensus_state": (dump_consensus_state, []),
+    "tx": (tx, ["hash", "prove"]),
+    "unconfirmed_txs": (unconfirmed_txs, []),
+    "num_unconfirmed_txs": (num_unconfirmed_txs, []),
+    # tx broadcast
+    "broadcast_tx_async": (broadcast_tx_async, ["tx"]),
+    "broadcast_tx_sync": (broadcast_tx_sync, ["tx"]),
+    "broadcast_tx_commit": (broadcast_tx_commit, ["tx"]),
+    # abci
+    "abci_query": (abci_query, ["data", "path", "height", "prove"]),
+    "abci_info": (abci_info, []),
+}
+
+UNSAFE_ROUTES_TABLE = {
+    "unsafe_dial_seeds": (unsafe_dial_seeds, ["seeds"]),
+    "unsafe_flush_mempool": (unsafe_flush_mempool, []),
+}

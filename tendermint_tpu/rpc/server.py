@@ -1,0 +1,306 @@
+"""JSON-RPC server: HTTP POST + GET URI + WebSocket subscriptions
+(reference: rpc/lib/server/handlers.go, http_server.go).
+
+One ThreadingHTTPServer serves all three transports:
+- POST /            JSON-RPC 2.0 envelope
+- GET  /<method>    params from the query string
+- GET  /websocket   RFC6455 upgrade; JSON-RPC frames + subscribe/
+                    unsubscribe methods that stream node events
+                    (handlers.go:351-630)
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import socket
+import struct
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, urlparse
+
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.rpc.core.handlers import RPCError
+from tendermint_tpu.rpc.core.routes import build_routes
+
+_WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+def _json_default(obj):
+    to_json = getattr(obj, "to_json", None)
+    if to_json is not None:
+        return to_json()
+    if isinstance(obj, bytes):
+        return obj.hex().upper()
+    return repr(obj)
+
+
+def _dumps(obj) -> bytes:
+    return json.dumps(obj, default=_json_default).encode()
+
+
+def _coerce_params(params: dict, known: list[str]) -> dict:
+    out = {}
+    for k, v in params.items():
+        if k not in known:
+            raise RPCError(f"unknown parameter {k!r} (expected {known})")
+        out[k] = v
+    return out
+
+
+class RPCServer(BaseService):
+    def __init__(self, laddr: str, ctx, unsafe: bool = False):
+        super().__init__(name="rpc.server")
+        host, _, port = laddr.rpartition(":")
+        self.ctx = ctx
+        self.routes = build_routes(unsafe)
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route through our logger
+                server.logger.debug(fmt, *args)
+
+            def _respond(self, payload: dict, status: int = 200) -> None:
+                body = _dumps(payload)
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _rpc_result(self, id_, result) -> None:
+                self._respond({"jsonrpc": "2.0", "id": id_, "result": result, "error": ""})
+
+            def _rpc_error(self, id_, message: str, status: int = 500) -> None:
+                self._respond(
+                    {"jsonrpc": "2.0", "id": id_, "result": None, "error": message},
+                    status=status,
+                )
+
+            def _call(self, method: str, params: dict):
+                route = server.routes.get(method)
+                if route is None:
+                    raise RPCError(f"unknown RPC method {method!r}")
+                fn, known = route
+                return fn(server.ctx, **_coerce_params(params, known))
+
+            # -- POST JSON-RPC (handlers.go:100-160) -----------------------
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length)
+                id_ = None
+                try:
+                    req = json.loads(raw.decode())
+                    id_ = req.get("id")
+                    params = req.get("params") or {}
+                    if isinstance(params, list):
+                        route = server.routes.get(req.get("method", ""))
+                        names = route[1] if route else []
+                        params = dict(zip(names, params))
+                    result = self._call(req["method"], params)
+                    self._rpc_result(id_, result)
+                except RPCError as exc:
+                    self._rpc_error(id_, str(exc), status=400)
+                except Exception as exc:  # noqa: BLE001 — surface, don't die
+                    server.logger.exception("rpc error")
+                    self._rpc_error(id_, f"{type(exc).__name__}: {exc}")
+
+            # -- GET URI + websocket (handlers.go:229-300, 351+) -----------
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                if parsed.path == "/websocket":
+                    self._serve_websocket()
+                    return
+                method = parsed.path.strip("/")
+                if not method:
+                    self._respond({"routes": sorted(server.routes)})
+                    return
+                params = {}
+                for k, v in parse_qsl(parsed.query):
+                    try:
+                        params[k] = json.loads(v)
+                    except ValueError:
+                        params[k] = v
+                try:
+                    self._rpc_result("", self._call(method, params))
+                except RPCError as exc:
+                    self._rpc_error("", str(exc), status=400)
+                except Exception as exc:  # noqa: BLE001
+                    server.logger.exception("rpc error")
+                    self._rpc_error("", f"{type(exc).__name__}: {exc}")
+
+            # -- websocket -------------------------------------------------
+
+            def _serve_websocket(self):
+                key = self.headers.get("Sec-WebSocket-Key")
+                if not key:
+                    self.send_error(400, "not a websocket upgrade")
+                    return
+                accept = base64.b64encode(
+                    hashlib.sha1((key + _WS_MAGIC).encode()).digest()
+                ).decode()
+                self.send_response(101, "Switching Protocols")
+                self.send_header("Upgrade", "websocket")
+                self.send_header("Connection", "Upgrade")
+                self.send_header("Sec-WebSocket-Accept", accept)
+                self.end_headers()
+                WSConnection(server, self.connection).run()
+                self.close_connection = True
+
+        self._httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def on_start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="rpc.httpd"
+        )
+        self._thread.start()
+        self.logger.info("RPC server listening on port %d", self.port)
+
+    def on_stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class WSConnection:
+    """One WebSocket session: JSON-RPC calls + event subscriptions
+    (handlers.go:351-630)."""
+
+    def __init__(self, server: RPCServer, sock: socket.socket):
+        self.server = server
+        self.sock = sock
+        self._wmtx = threading.Lock()
+        self._listener_id = f"ws-{id(self):x}"
+        self._subscribed: set[str] = set()
+        self._closed = False
+
+    # -- frame IO (RFC 6455, server side: no masking on send) --------------
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("ws closed")
+            buf += chunk
+        return bytes(buf)
+
+    def _read_frame(self) -> tuple[int, bytes]:
+        b1, b2 = self._read_exact(2)
+        opcode = b1 & 0x0F
+        masked = b2 & 0x80
+        length = b2 & 0x7F
+        if length == 126:
+            (length,) = struct.unpack(">H", self._read_exact(2))
+        elif length == 127:
+            (length,) = struct.unpack(">Q", self._read_exact(8))
+        mask = self._read_exact(4) if masked else b""
+        payload = self._read_exact(length)
+        if mask:
+            payload = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
+        return opcode, payload
+
+    def _send_frame(self, opcode: int, payload: bytes) -> None:
+        head = bytearray([0x80 | opcode])
+        n = len(payload)
+        if n < 126:
+            head.append(n)
+        elif n < 1 << 16:
+            head.append(126)
+            head += struct.pack(">H", n)
+        else:
+            head.append(127)
+            head += struct.pack(">Q", n)
+        with self._wmtx:
+            self.sock.sendall(bytes(head) + payload)
+
+    def send_json(self, obj) -> None:
+        if not self._closed:
+            try:
+                self._send_frame(0x1, _dumps(obj))
+            except OSError:
+                self._closed = True
+
+    # -- session loop ------------------------------------------------------
+
+    def run(self) -> None:
+        evsw = self.server.ctx.event_switch
+        try:
+            while not self._closed:
+                opcode, payload = self._read_frame()
+                if opcode == 0x8:  # close
+                    self._send_frame(0x8, b"")
+                    return
+                if opcode == 0x9:  # ping
+                    self._send_frame(0xA, payload)
+                    continue
+                if opcode not in (0x1, 0x2):
+                    continue
+                self._handle(payload)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self._closed = True
+            if evsw is not None:
+                evsw.remove_listener(self._listener_id)
+
+    def _handle(self, payload: bytes) -> None:
+        id_ = None
+        try:
+            req = json.loads(payload.decode())
+            id_ = req.get("id")
+            method = req.get("method", "")
+            params = req.get("params") or {}
+            if method == "subscribe":
+                self._subscribe(params["event"])
+                result = {}
+            elif method == "unsubscribe":
+                self._unsubscribe(params["event"])
+                result = {}
+            else:
+                route = self.server.routes.get(method)
+                if route is None:
+                    raise RPCError(f"unknown RPC method {method!r}")
+                fn, known = route
+                if isinstance(params, list):
+                    params = dict(zip(known, params))
+                result = fn(self.server.ctx, **_coerce_params(params, known))
+            self.send_json({"jsonrpc": "2.0", "id": id_, "result": result, "error": ""})
+        except Exception as exc:  # noqa: BLE001
+            self.send_json(
+                {"jsonrpc": "2.0", "id": id_, "result": None, "error": f"{exc}"}
+            )
+
+    def _subscribe(self, event: str) -> None:
+        evsw = self.server.ctx.event_switch
+        if evsw is None:
+            raise RPCError("no event switch")
+        if event in self._subscribed:
+            return
+        self._subscribed.add(event)
+
+        def on_event(data, event=event):
+            self.send_json(
+                {
+                    "jsonrpc": "2.0",
+                    "id": "",
+                    "result": {"event": event, "data": data},
+                    "error": "",
+                }
+            )
+
+        evsw.add_listener_for_event(self._listener_id, event, on_event)
+
+    def _unsubscribe(self, event: str) -> None:
+        evsw = self.server.ctx.event_switch
+        if evsw is None:
+            return
+        self._subscribed.discard(event)
+        evsw.remove_listener_for_event(event, self._listener_id)

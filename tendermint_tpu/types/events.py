@@ -41,10 +41,16 @@ def event_string_tx(tx_hash: bytes) -> str:
 class EventDataNewBlock:
     block: Any
 
+    def to_json(self):
+        return {"block": self.block.to_json()}
+
 
 @dataclass
 class EventDataNewBlockHeader:
     header: Any
+
+    def to_json(self):
+        return {"header": self.header.to_json()}
 
 
 @dataclass
@@ -56,6 +62,16 @@ class EventDataTx:
     code: int
     error: str = ""
 
+    def to_json(self):
+        return {
+            "height": self.height,
+            "tx": self.tx.hex().upper(),
+            "data": (self.data or b"").hex().upper(),
+            "log": self.log,
+            "code": self.code,
+            "error": self.error,
+        }
+
 
 @dataclass
 class EventDataRoundState:
@@ -64,15 +80,24 @@ class EventDataRoundState:
     step: str
     round_state: Any = None  # full RoundState for internal subscribers
 
+    def to_json(self):
+        return {"height": self.height, "round": self.round_, "step": self.step}
+
 
 @dataclass
 class EventDataVote:
     vote: Any
 
+    def to_json(self):
+        return {"vote": self.vote.to_json()}
+
 
 @dataclass
 class EventDataProposalHeartbeat:
     heartbeat: Any
+
+    def to_json(self):
+        return {"heartbeat": self.heartbeat.to_json()}
 
 
 # -- fire helpers (types/events.go:190-251) ----------------------------------

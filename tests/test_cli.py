@@ -1,0 +1,156 @@
+"""CLI tests (reference: cmd/tendermint/commands/*)."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.cli import main
+
+
+def test_init_and_show_validator(tmp_path, capsys):
+    home = str(tmp_path / "node0")
+    assert main(["--home", home, "init", "--chain-id", "cli-chain"]) == 0
+    out = capsys.readouterr().out
+    assert "Generated private validator" in out
+    assert "Generated genesis file" in out
+    assert os.path.exists(os.path.join(home, "genesis.json"))
+    assert os.path.exists(os.path.join(home, "config.toml"))
+    # idempotent
+    assert main(["--home", home, "init"]) == 0
+    assert "Found private validator" in capsys.readouterr().out
+
+    assert main(["--home", home, "show_validator"]) == 0
+    pub = json.loads(capsys.readouterr().out)
+    assert pub[0] == 1 and len(pub[1]) == 64  # [type, hex32]
+
+
+def test_gen_validator_and_version(capsys):
+    assert main(["gen_validator"]) == 0
+    pv = json.loads(capsys.readouterr().out)
+    assert pv["pub_key"][0] == 1
+    assert main(["version"]) == 0
+    assert capsys.readouterr().out.strip().count(".") == 2
+
+
+def test_testnet(tmp_path, capsys):
+    d = str(tmp_path / "net")
+    assert main(["testnet", "--n", "3", "--dir", d, "--chain-id", "net-chain"]) == 0
+    docs = []
+    for i in range(3):
+        with open(os.path.join(d, f"mach{i}", "genesis.json")) as f:
+            docs.append(json.load(f))
+    assert all(doc["chain_id"] == "net-chain" for doc in docs)
+    assert all(len(doc["validators"]) == 3 for doc in docs)
+    assert docs[0]["validators"] == docs[1]["validators"] == docs[2]["validators"]
+
+
+def test_reset_all(tmp_path, capsys):
+    home = str(tmp_path / "node1")
+    main(["--home", home, "init"])
+    data = os.path.join(home, "data")
+    os.makedirs(data, exist_ok=True)
+    with open(os.path.join(data, "junk"), "w") as f:
+        f.write("x")
+    assert main(["--home", home, "reset_all"]) == 0
+    assert not os.path.exists(os.path.join(data, "junk"))
+
+
+@pytest.mark.slow
+def test_cli_node_subprocess(tmp_path):
+    """Boot a real node via the CLI, hit its RPC, shut it down cleanly
+    (the reference's test/app/dummy_test.sh shape)."""
+    home = str(tmp_path / "noderun")
+    subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cli", "--home", home, "init"],
+        check=True, capture_output=True,
+    )
+    # pin an ephemeral-ish rpc port by picking a free one
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TENDERMINT_TPU_DISABLE="1")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "tendermint_tpu.cli", "--home", home, "node",
+            "--proxy_app", "kvstore",
+            "--rpc.laddr", f"tcp://127.0.0.1:{port}",
+            "--p2p.laddr", "tcp://127.0.0.1:0",
+        ],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = time.time() + 60
+        status = None
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status", timeout=2
+                ) as resp:
+                    status = json.loads(resp.read().decode())
+                if status["result"]["latest_block_height"] >= 1:
+                    break
+            except Exception:
+                time.sleep(0.3)
+        assert status is not None and status["result"]["latest_block_height"] >= 1
+        # commit a tx through the running node
+        tx = b"cli-key=cli-val".hex()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/",
+            data=json.dumps(
+                {"jsonrpc": "2.0", "id": 1, "method": "broadcast_tx_commit",
+                 "params": {"tx": tx}}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            res = json.loads(resp.read().decode())
+        assert res["result"]["deliver_tx"]["code"] == 0
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_replay_after_run(tmp_path, capsys):
+    """Run a node in-process briefly, then `replay` its WAL."""
+    from tendermint_tpu.config import load_config, ensure_root
+    from tendermint_tpu.node import default_new_node
+
+    home = str(tmp_path / "replaynode")
+    main(["--home", home, "init"])
+    capsys.readouterr()
+    cfg = load_config(home)
+    cfg.base.proxy_app = "kvstore"
+    cfg.rpc.laddr = ""
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    # test-speed consensus
+    cfg.consensus.timeout_commit = 0.05
+    cfg.consensus.skip_timeout_commit = True
+    cfg.consensus.timeout_propose = 0.2
+    node = default_new_node(cfg)
+    node.start()
+    deadline = time.time() + 30
+    while time.time() < deadline and node.block_store.height() < 3:
+        time.sleep(0.05)
+    assert node.block_store.height() >= 3
+    node.stop()
+
+    from tendermint_tpu.consensus.replay_file import run_replay_file
+
+    replayed = run_replay_file(cfg, console=False)
+    assert replayed > 0
+    out = capsys.readouterr().out
+    assert "replayed" in out

@@ -1,0 +1,141 @@
+"""Full-node + JSON-RPC tests (reference test models: rpc/client/rpc_test.go,
+rpc/test/helpers.go — start a real node in-process, drive it over RPC)."""
+
+from __future__ import annotations
+
+import json
+import tempfile
+import time
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.config import reset_test_root
+from tendermint_tpu.node import default_new_node
+from tendermint_tpu.rpc.client import HTTPClient, RPCClientError, WSClient
+
+
+def wait_until(cond, timeout=30.0, tick=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(tick)
+    return cond()
+
+
+@pytest.fixture(scope="module")
+def node():
+    tmp = tempfile.mkdtemp(prefix="node-test-")
+    cfg = reset_test_root(tmp)
+    cfg.base.proxy_app = "kvstore"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    n = default_new_node(cfg)
+    n.start()
+    assert wait_until(lambda: n.block_store.height() >= 1, timeout=30)
+    yield n
+    n.stop()
+
+
+@pytest.fixture(scope="module")
+def client(node):
+    return HTTPClient(f"127.0.0.1:{node.rpc_port()}")
+
+
+def test_status(node, client):
+    res = client.status()
+    assert res["latest_block_height"] >= 1
+    assert res["node_info"]["moniker"] == node.config.base.moniker
+    assert len(res["latest_app_hash"]) >= 0
+
+
+def test_abci_info_and_query(node, client):
+    res = client.abci_info()
+    assert res["response"]["last_block_height"] >= 0
+
+
+def test_broadcast_tx_commit_and_lookup(node, client):
+    tx = b"rpc-key=rpc-value"
+    res = client.broadcast_tx_commit(tx=tx.hex())
+    assert res["check_tx"]["code"] == 0
+    assert res["deliver_tx"]["code"] == 0
+    assert res["height"] >= 1
+    # abci_query sees the committed value
+    q = client.abci_query(data=b"rpc-key".hex())
+    assert bytes.fromhex(q["response"]["value"]) == b"rpc-value"
+    # tx indexer lookup with merkle proof
+    got = client.tx(hash=res["hash"], prove=True)
+    assert bytes.fromhex(got["tx"]) == tx
+    assert got["height"] == res["height"]
+    assert got["proof"] is not None
+
+
+def test_broadcast_tx_sync_and_unconfirmed(node, client):
+    res = client.broadcast_tx_sync(tx=b"sync-key=sync-val".hex())
+    assert res["code"] == 0
+    res2 = client.num_unconfirmed_txs()
+    assert res2["n_txs"] >= 0  # may already be reaped
+
+
+def test_block_and_blockchain_and_commit(node, client):
+    assert wait_until(lambda: node.block_store.height() >= 2)
+    res = client.block(height=1)
+    assert res["block"]["header"]["height"] == 1
+    info = client.blockchain(min_height=1, max_height=2)
+    assert info["last_height"] >= 2
+    assert len(info["block_metas"]) == 2
+    cmt = client.commit(height=1)
+    assert cmt["canonical_commit"] is True
+    assert cmt["commit"] is not None
+
+
+def test_validators_and_genesis_and_net_info(node, client):
+    vals = client.validators()
+    assert len(vals["validators"]["validators"]) == 1
+    gen = client.genesis()
+    assert gen["genesis"]["chain_id"] == node.genesis_doc.chain_id
+    ni = client.net_info()
+    assert ni["listening"] is True
+
+
+def test_dump_consensus_state(node, client):
+    res = client.dump_consensus_state()
+    assert res["round_state"]["height"] >= 1
+
+
+def test_uri_transport(node, client):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{node.rpc_port()}/status", timeout=10
+    ) as resp:
+        body = json.loads(resp.read().decode())
+    assert body["result"]["latest_block_height"] >= 1
+
+
+def test_unknown_method_and_bad_params(node, client):
+    with pytest.raises(RPCClientError, match="unknown RPC method"):
+        client.call("no_such_method")
+    with pytest.raises(RPCClientError, match="unknown parameter"):
+        client.call("block", bogus=1)
+    with pytest.raises(RPCClientError):
+        client.block(height=10**9)
+
+
+def test_websocket_subscription(node, client):
+    ws = WSClient(f"127.0.0.1:{node.rpc_port()}")
+    try:
+        ws.subscribe("NewBlock")
+        ev = ws.next_event(timeout=30)
+        assert ev["event"] == "NewBlock"
+        assert ev["data"]["block"]["header"]["height"] >= 1
+        # RPC over the same websocket
+        res = ws.call("status")
+        assert res["latest_block_height"] >= 1
+        ws.unsubscribe("NewBlock")
+    finally:
+        ws.close()
+
+
+def test_unsafe_routes_gated(node, client):
+    with pytest.raises(RPCClientError, match="unknown RPC method"):
+        client.unsafe_flush_mempool()
