@@ -5,6 +5,11 @@ path (types/validator_set.go:220-264: N sequential verifies per block) —
 on the available accelerator, against our own CPU reference loop (the
 Go-equivalent baseline; upstream publishes no numbers, BASELINE.md).
 
+The accelerator measurement is SUSTAINED pipelined throughput: host
+marshaling of batch i+1 overlaps device execution of batch i (jax async
+dispatch), exactly how a fast-syncing node streams commits through the
+verifier.
+
 Prints ONE JSON line:
   {"metric": "verify_commit_sigs_per_sec", "value": N, "unit": "sigs/s",
    "vs_baseline": N / cpu_sigs_per_sec}
@@ -21,9 +26,9 @@ from tendermint_tpu.jitcache import enable as _enable_jit_cache
 
 _enable_jit_cache()
 
-BATCH = int(os.environ.get("BENCH_BATCH", "1024"))
-CPU_SAMPLE = int(os.environ.get("BENCH_CPU_SAMPLE", "256"))
-REPS = int(os.environ.get("BENCH_REPS", "5"))
+BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
+N_BATCHES = int(os.environ.get("BENCH_N_BATCHES", "6"))
+CPU_SAMPLE = int(os.environ.get("BENCH_CPU_SAMPLE", "512"))
 
 
 def _make_items(n: int):
@@ -46,30 +51,58 @@ def _make_items(n: int):
 
 def main() -> None:
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
     from tendermint_tpu.crypto import ed25519 as ed_cpu
     from tendermint_tpu.ops import ed25519 as ops_ed
 
-    items = _make_items(BATCH)
+    chunks = [_make_items(BATCH) for _ in range(N_BATCHES)]
 
     # --- CPU baseline: the reference-faithful sequential loop ------------
     t0 = time.perf_counter()
-    for pub, msg, sig in items[:CPU_SAMPLE]:
+    for pub, msg, sig in chunks[0][:CPU_SAMPLE]:
         assert ed_cpu.verify(pub, msg, sig)
     cpu_rate = CPU_SAMPLE / (time.perf_counter() - t0)
 
-    # --- accelerator: one warmup (compile) then timed reps ---------------
-    ok = ops_ed.verify_batch(items)
-    assert bool(np.all(ok)), "warmup verify failed"
-    best = float("inf")
-    for _ in range(REPS):
-        t0 = time.perf_counter()
-        ok = ops_ed.verify_batch(items)
-        dt = time.perf_counter() - t0
-        assert bool(np.all(ok))
-        best = min(best, dt)
-    rate = BATCH / best
+    def dispatch(prep):
+        args = tuple(jnp.asarray(a) for a in prep[:6])
+        return ops_ed._verify_jit(*args), prep[6]
+
+    # warmup (compile)
+    ok, valid = dispatch(ops_ed.prepare_batch_limbs(chunks[0], BATCH))
+    assert bool(np.asarray(ok).all()), "warmup verify failed"
+
+    # --- sustained pipelined throughput: a prep thread feeds marshaled
+    # batches while the device runs the previous kernel ------------------
+    import queue as _q
+    import threading as _t
+
+    fed: _q.Queue = _q.Queue(maxsize=2)
+
+    def prep_worker():
+        # host marshaling only: device transfers stay on the dispatch
+        # thread (off-thread device_put serializes with kernel execution
+        # on this backend and measured slower)
+        for chunk in chunks:
+            fed.put(ops_ed.prepare_batch_limbs(chunk, BATCH))
+        fed.put(None)
+
+    t0 = time.perf_counter()
+    _t.Thread(target=prep_worker, daemon=True).start()
+    in_flight, valids = [], []
+    while True:
+        prep = fed.get()
+        if prep is None:
+            break
+        ok, valid = dispatch(prep)
+        in_flight.append(ok)
+        valids.append(valid)
+    results = [np.asarray(ok) for ok in in_flight]
+    elapsed = time.perf_counter() - t0
+    assert all(r.all() and v.all() for r, v in zip(results, valids))
+    total = BATCH * N_BATCHES
+    rate = total / elapsed
 
     print(
         json.dumps(
@@ -80,7 +113,8 @@ def main() -> None:
                 "vs_baseline": round(rate / cpu_rate, 2),
                 "detail": {
                     "batch": BATCH,
-                    "best_batch_ms": round(best * 1e3, 2),
+                    "n_batches": N_BATCHES,
+                    "elapsed_s": round(elapsed, 3),
                     "cpu_sigs_per_sec": round(cpu_rate, 1),
                     "platform": jax.devices()[0].platform,
                 },

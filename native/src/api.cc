@@ -1,0 +1,179 @@
+// C ABI for the Python runtime (ctypes). Batched entry points: one call
+// marshals/verifies/hashes an entire batch — no per-item FFI overhead.
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include "ed25519.h"
+#include "hashes.h"
+
+using namespace tm;
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// hashes: msgs are concatenated in `data` with element i spanning
+// [offsets[i], offsets[i+1]) — offsets has n+1 entries.
+// ---------------------------------------------------------------------------
+
+void tm_sha256_batch(const uint8_t* data, const uint64_t* offsets, int64_t n,
+                     uint8_t* out /* n*32 */) {
+  for (int64_t i = 0; i < n; i++)
+    sha256(data + offsets[i], offsets[i + 1] - offsets[i], out + 32 * i);
+}
+
+void tm_ripemd160_batch(const uint8_t* data, const uint64_t* offsets,
+                        int64_t n, uint8_t* out /* n*20 */) {
+  for (int64_t i = 0; i < n; i++)
+    ripemd160(data + offsets[i], offsets[i + 1] - offsets[i], out + 20 * i);
+}
+
+// ---------------------------------------------------------------------------
+// merkle: reference tree shape — odd splits give the LEFT side the extra
+// leaf, split point (n+1)/2 (types/tx.go:33-46); hashes are RIPEMD-160
+// over go-wire length-prefixed operands (merkle/simple.py parity).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// encode_varint(len) for short non-negative lengths: [nbytes, big-endian...]
+size_t put_len_prefix(uint8_t* out, uint64_t len) {
+  if (len == 0) {
+    out[0] = 0;
+    return 1;
+  }
+  uint8_t tmp[8];
+  int nb = 0;
+  while (len) {
+    tmp[nb++] = uint8_t(len & 0xff);
+    len >>= 8;
+  }
+  out[0] = uint8_t(nb);
+  for (int i = 0; i < nb; i++) out[1 + i] = tmp[nb - 1 - i];
+  return 1 + nb;
+}
+
+void inner_hash(const uint8_t left[20], const uint8_t right[20],
+                uint8_t out[20]) {
+  uint8_t buf[44];
+  size_t off = put_len_prefix(buf, 20);
+  std::memcpy(buf + off, left, 20);
+  off += 20;
+  off += put_len_prefix(buf + off, 20);
+  std::memcpy(buf + off, right, 20);
+  off += 20;
+  ripemd160(buf, off, out);
+}
+
+void tree_hash(const uint8_t* leaves, int64_t lo, int64_t hi,
+               uint8_t out[20]) {
+  if (hi - lo == 1) {
+    std::memcpy(out, leaves + 20 * lo, 20);
+    return;
+  }
+  int64_t mid = lo + (hi - lo + 1) / 2;
+  uint8_t l[20], r[20];
+  tree_hash(leaves, lo, mid, l);
+  tree_hash(leaves, mid, hi, r);
+  inner_hash(l, r, out);
+}
+
+}  // namespace
+
+// leaf hashes: ripemd160(len-prefix || item) per item
+void tm_merkle_leaf_hashes(const uint8_t* data, const uint64_t* offsets,
+                           int64_t n, uint8_t* out /* n*20 */) {
+  std::vector<uint8_t> buf;
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t len = offsets[i + 1] - offsets[i];
+    buf.resize(len + 9);
+    size_t off = put_len_prefix(buf.data(), len);
+    std::memcpy(buf.data() + off, data + offsets[i], len);
+    ripemd160(buf.data(), off + len, out + 20 * i);
+  }
+}
+
+// root from n 20-byte leaf digests (n >= 1)
+void tm_merkle_root(const uint8_t* leaf_digests, int64_t n,
+                    uint8_t out[20]) {
+  tree_hash(leaf_digests, 0, n, out);
+}
+
+// ---------------------------------------------------------------------------
+// ed25519
+// ---------------------------------------------------------------------------
+
+// batch verify: pubs n*32, sigs n*64, msgs concatenated + offsets.
+// out[i] = 1 if valid.
+void tm_ed25519_verify_batch(const uint8_t* pubs, const uint8_t* sigs,
+                             const uint8_t* msgs, const uint64_t* offsets,
+                             int64_t n, uint8_t* out) {
+  for (int64_t i = 0; i < n; i++)
+    out[i] = (uint8_t)ed25519_verify(
+        pubs + 32 * i, msgs + offsets[i], offsets[i + 1] - offsets[i],
+        sigs + 64 * i);
+}
+
+// TPU-prep marshal: for each item emit canonical little-endian 32-byte
+// field elements (ax, ay, ry) + r_sign + (s, h) scalars mod L + valid.
+// The Python side converts the 32-byte LE values to kernel limb layout
+// with its vectorized converter. Invalid rows get neutral values.
+void tm_ed25519_prepare(const uint8_t* pubs, const uint8_t* sigs,
+                        const uint8_t* msgs, const uint64_t* offsets,
+                        int64_t n, uint8_t* ax /* n*32 */,
+                        uint8_t* ay /* n*32 */, uint8_t* ry /* n*32 */,
+                        int32_t* r_sign, uint8_t* s_out /* n*32 */,
+                        uint8_t* h_out /* n*32 */, uint8_t* valid) {
+  static const uint8_t PB[32] = {
+      0xed, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+      0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+      0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x7f};
+  static const uint8_t LB[32] = {0xed, 0xd3, 0xf5, 0x5c, 0x1a, 0x63, 0x12,
+                                 0x58, 0xd6, 0x9c, 0xf7, 0xa2, 0xde, 0xf9,
+                                 0xde, 0x14, 0,    0,    0,    0,    0,
+                                 0,    0,    0,    0,    0,    0,    0,
+                                 0,    0,    0,    0x10};
+  for (int64_t i = 0; i < n; i++) {
+    valid[i] = 0;
+    r_sign[i] = 0;
+    std::memset(ax + 32 * i, 0, 32);
+    std::memset(ay + 32 * i, 0, 32);
+    ay[32 * i] = 1;
+    std::memset(ry + 32 * i, 0, 32);
+    ry[32 * i] = 1;
+    std::memset(s_out + 32 * i, 0, 32);
+    std::memset(h_out + 32 * i, 0, 32);
+
+    const uint8_t* pub = pubs + 32 * i;
+    const uint8_t* sig = sigs + 64 * i;
+    // s < L
+    int s_ge = 1;
+    for (int k = 31; k >= 0; k--) {
+      if (sig[32 + k] < LB[k]) { s_ge = 0; break; }
+      if (sig[32 + k] > LB[k]) { s_ge = 1; break; }
+    }
+    if (s_ge) continue;
+    // R.y canonical
+    uint8_t rm[32];
+    std::memcpy(rm, sig, 32);
+    int rs = rm[31] >> 7;
+    rm[31] &= 0x7f;
+    int r_ge = 1;
+    for (int k = 31; k >= 0; k--) {
+      if (rm[k] < PB[k]) { r_ge = 0; break; }
+      if (rm[k] > PB[k]) { r_ge = 1; break; }
+    }
+    if (r_ge) continue;
+    // decompress A
+    if (!ed25519_decompress(pub, ax + 32 * i, ay + 32 * i)) continue;
+    // h = SHA512(R || A || M) mod L
+    ed25519_hram(sig, pub, msgs + offsets[i], offsets[i + 1] - offsets[i],
+                 h_out + 32 * i);
+    std::memcpy(ry + 32 * i, rm, 32);
+    std::memcpy(s_out + 32 * i, sig + 32, 32);
+    r_sign[i] = rs;
+    valid[i] = 1;
+  }
+}
+
+}  // extern "C"

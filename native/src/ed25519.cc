@@ -1,0 +1,504 @@
+#include "ed25519.h"
+
+#include <cstring>
+
+#include "hashes.h"
+
+namespace tm {
+namespace {
+
+// ---------------------------------------------------------------------------
+// GF(2^255-19), radix 2^51, 5 limbs of uint64 (loose bound < 2^52)
+// ---------------------------------------------------------------------------
+
+typedef uint64_t fe[5];
+typedef unsigned __int128 u128;
+
+const uint64_t MASK51 = (1ULL << 51) - 1;
+
+inline void fe_copy(fe o, const fe a) { std::memcpy(o, a, sizeof(fe)); }
+
+inline void fe_zero(fe o) { std::memset(o, 0, sizeof(fe)); }
+
+inline void fe_one(fe o) {
+  fe_zero(o);
+  o[0] = 1;
+}
+
+inline void fe_add(fe o, const fe a, const fe b) {
+  for (int i = 0; i < 5; i++) o[i] = a[i] + b[i];
+}
+
+// o = a - b, with 2p bias to stay non-negative (limbs < 2^52 each side)
+inline void fe_sub(fe o, const fe a, const fe b) {
+  // 2p in radix 2^51
+  o[0] = a[0] + 0xFFFFFFFFFFFDAULL - b[0];
+  o[1] = a[1] + 0xFFFFFFFFFFFFEULL - b[1];
+  o[2] = a[2] + 0xFFFFFFFFFFFFEULL - b[2];
+  o[3] = a[3] + 0xFFFFFFFFFFFFEULL - b[3];
+  o[4] = a[4] + 0xFFFFFFFFFFFFEULL - b[4];
+}
+
+void fe_carry(fe o) {
+  uint64_t c;
+  c = o[0] >> 51; o[0] &= MASK51; o[1] += c;
+  c = o[1] >> 51; o[1] &= MASK51; o[2] += c;
+  c = o[2] >> 51; o[2] &= MASK51; o[3] += c;
+  c = o[3] >> 51; o[3] &= MASK51; o[4] += c;
+  c = o[4] >> 51; o[4] &= MASK51; o[0] += 19 * c;
+  c = o[0] >> 51; o[0] &= MASK51; o[1] += c;
+}
+
+void fe_mul(fe o, const fe a, const fe b) {
+  u128 t0 = (u128)a[0] * b[0] + (u128)(19 * a[1]) * b[4] +
+            (u128)(19 * a[2]) * b[3] + (u128)(19 * a[3]) * b[2] +
+            (u128)(19 * a[4]) * b[1];
+  u128 t1 = (u128)a[0] * b[1] + (u128)a[1] * b[0] + (u128)(19 * a[2]) * b[4] +
+            (u128)(19 * a[3]) * b[3] + (u128)(19 * a[4]) * b[2];
+  u128 t2 = (u128)a[0] * b[2] + (u128)a[1] * b[1] + (u128)a[2] * b[0] +
+            (u128)(19 * a[3]) * b[4] + (u128)(19 * a[4]) * b[3];
+  u128 t3 = (u128)a[0] * b[3] + (u128)a[1] * b[2] + (u128)a[2] * b[1] +
+            (u128)a[3] * b[0] + (u128)(19 * a[4]) * b[4];
+  u128 t4 = (u128)a[0] * b[4] + (u128)a[1] * b[3] + (u128)a[2] * b[2] +
+            (u128)a[3] * b[1] + (u128)a[4] * b[0];
+  uint64_t c;
+  uint64_t r0 = (uint64_t)t0 & MASK51; c = (uint64_t)(t0 >> 51);
+  t1 += c;
+  uint64_t r1 = (uint64_t)t1 & MASK51; c = (uint64_t)(t1 >> 51);
+  t2 += c;
+  uint64_t r2 = (uint64_t)t2 & MASK51; c = (uint64_t)(t2 >> 51);
+  t3 += c;
+  uint64_t r3 = (uint64_t)t3 & MASK51; c = (uint64_t)(t3 >> 51);
+  t4 += c;
+  uint64_t r4 = (uint64_t)t4 & MASK51; c = (uint64_t)(t4 >> 51);
+  r0 += 19 * c;
+  c = r0 >> 51; r0 &= MASK51; r1 += c;
+  o[0] = r0; o[1] = r1; o[2] = r2; o[3] = r3; o[4] = r4;
+}
+
+inline void fe_sq(fe o, const fe a) { fe_mul(o, a, a); }
+
+void fe_from_bytes(fe o, const uint8_t s[32]) {
+  uint64_t w[4];
+  for (int i = 0; i < 4; i++) {
+    uint64_t v = 0;
+    for (int j = 7; j >= 0; j--) v = (v << 8) | s[8 * i + j];
+    w[i] = v;
+  }
+  o[0] = w[0] & MASK51;
+  o[1] = ((w[0] >> 51) | (w[1] << 13)) & MASK51;
+  o[2] = ((w[1] >> 38) | (w[2] << 26)) & MASK51;
+  o[3] = ((w[2] >> 25) | (w[3] << 39)) & MASK51;
+  o[4] = (w[3] >> 12) & MASK51;  // drops bit 255
+}
+
+// canonical little-endian serialization
+void fe_to_bytes(uint8_t s[32], const fe a) {
+  fe t;
+  fe_copy(t, a);
+  fe_carry(t);
+  fe_carry(t);
+  // reduce mod p: subtract p if t >= p (twice covers the loose bound)
+  for (int rep = 0; rep < 2; rep++) {
+    uint64_t borrow = 0;
+    fe sub;
+    const uint64_t P0 = MASK51 - 18;  // 2^51 - 19
+    sub[0] = t[0] - P0 - borrow; borrow = (sub[0] >> 63) & 1; sub[0] &= MASK51;
+    for (int i = 1; i < 5; i++) {
+      sub[i] = t[i] - MASK51 - borrow;
+      borrow = (sub[i] >> 63) & 1;
+      sub[i] &= MASK51;
+    }
+    if (!borrow) fe_copy(t, sub);
+  }
+  fe_carry(t);  // flatten a possible 2^51 limb before packing
+  uint64_t w0 = t[0] | (t[1] << 51);
+  uint64_t w1 = (t[1] >> 13) | (t[2] << 38);
+  uint64_t w2 = (t[2] >> 26) | (t[3] << 25);
+  uint64_t w3 = (t[3] >> 39) | (t[4] << 12);
+  uint64_t w[4] = {w0, w1, w2, w3};
+  for (int i = 0; i < 4; i++)
+    for (int j = 0; j < 8; j++) s[8 * i + j] = uint8_t(w[i] >> (8 * j));
+}
+
+void fe_invert(fe o, const fe z) {
+  fe t0, t1, t2, t3;
+  fe_sq(t0, z);                       // 2
+  fe_sq(t1, t0); fe_sq(t1, t1);      // 8
+  fe_mul(t1, z, t1);                  // 9
+  fe_mul(t0, t0, t1);                 // 11
+  fe_sq(t2, t0);                      // 22
+  fe_mul(t1, t1, t2);                 // 2^5 - 1
+  fe_sq(t2, t1);
+  for (int i = 1; i < 5; i++) fe_sq(t2, t2);
+  fe_mul(t1, t2, t1);                 // 2^10 - 1
+  fe_sq(t2, t1);
+  for (int i = 1; i < 10; i++) fe_sq(t2, t2);
+  fe_mul(t2, t2, t1);                 // 2^20 - 1
+  fe_sq(t3, t2);
+  for (int i = 1; i < 20; i++) fe_sq(t3, t3);
+  fe_mul(t2, t3, t2);                 // 2^40 - 1
+  fe_sq(t2, t2);
+  for (int i = 1; i < 10; i++) fe_sq(t2, t2);
+  fe_mul(t1, t2, t1);                 // 2^50 - 1
+  fe_sq(t2, t1);
+  for (int i = 1; i < 50; i++) fe_sq(t2, t2);
+  fe_mul(t2, t2, t1);                 // 2^100 - 1
+  fe_sq(t3, t2);
+  for (int i = 1; i < 100; i++) fe_sq(t3, t3);
+  fe_mul(t2, t3, t2);                 // 2^200 - 1
+  fe_sq(t2, t2);
+  for (int i = 1; i < 50; i++) fe_sq(t2, t2);
+  fe_mul(t1, t2, t1);                 // 2^250 - 1
+  fe_sq(t1, t1);
+  for (int i = 1; i < 5; i++) fe_sq(t1, t1);
+  fe_mul(o, t1, t0);                  // 2^255 - 21
+}
+
+// z^((p-5)/8) = z^(2^252 - 3)
+void fe_pow2523(fe o, const fe z) {
+  fe t0, t1, t2;
+  fe_sq(t0, z);                       // 2
+  fe_sq(t1, t0); fe_sq(t1, t1);      // 8
+  fe_mul(t1, z, t1);                  // 9
+  fe_mul(t0, t0, t1);                 // 11
+  fe_sq(t0, t0);                      // 22
+  fe_mul(t0, t1, t0);                 // 2^5 - 1
+  fe_sq(t1, t0);
+  for (int i = 1; i < 5; i++) fe_sq(t1, t1);
+  fe_mul(t0, t1, t0);                 // 2^10 - 1
+  fe_sq(t1, t0);
+  for (int i = 1; i < 10; i++) fe_sq(t1, t1);
+  fe_mul(t1, t1, t0);                 // 2^20 - 1
+  fe_sq(t2, t1);
+  for (int i = 1; i < 20; i++) fe_sq(t2, t2);
+  fe_mul(t1, t2, t1);                 // 2^40 - 1
+  fe_sq(t1, t1);
+  for (int i = 1; i < 10; i++) fe_sq(t1, t1);
+  fe_mul(t0, t1, t0);                 // 2^50 - 1
+  fe_sq(t1, t0);
+  for (int i = 1; i < 50; i++) fe_sq(t1, t1);
+  fe_mul(t1, t1, t0);                 // 2^100 - 1
+  fe_sq(t2, t1);
+  for (int i = 1; i < 100; i++) fe_sq(t2, t2);
+  fe_mul(t1, t2, t1);                 // 2^200 - 1
+  fe_sq(t1, t1);
+  for (int i = 1; i < 50; i++) fe_sq(t1, t1);
+  fe_mul(t0, t1, t0);                 // 2^250 - 1
+  fe_sq(t0, t0); fe_sq(t0, t0);      // 2^252 - 4
+  fe_mul(o, t0, z);                   // 2^252 - 3
+}
+
+int fe_is_zero(const fe a) {
+  uint8_t s[32];
+  fe_to_bytes(s, a);
+  uint8_t acc = 0;
+  for (int i = 0; i < 32; i++) acc |= s[i];
+  return acc == 0;
+}
+
+int fe_eq(const fe a, const fe b) {
+  uint8_t sa[32], sb[32];
+  fe_to_bytes(sa, a);
+  fe_to_bytes(sb, b);
+  return std::memcmp(sa, sb, 32) == 0;
+}
+
+int fe_parity(const fe a) {
+  uint8_t s[32];
+  fe_to_bytes(s, a);
+  return s[0] & 1;
+}
+
+// d = -121665/121666 and sqrt(-1), from the curve definition
+const fe FE_D = {929955233495203ULL, 466365720129213ULL, 1662059464998953ULL,
+                 2033849074728123ULL, 1442794654840575ULL};
+const fe FE_D2 = {1859910466990425ULL, 932731440258426ULL, 1072319116312658ULL,
+                  1815898335770999ULL, 633789495995903ULL};
+const fe FE_SQRTM1 = {1718705420411056ULL, 234908883556509ULL,
+                      2233514472574048ULL, 2117202627021982ULL,
+                      765476049583133ULL};
+
+// ---------------------------------------------------------------------------
+// group: extended coordinates (X, Y, Z, T), complete formulas
+// ---------------------------------------------------------------------------
+
+struct ge {
+  fe X, Y, Z, T;
+};
+
+void ge_identity(ge* p) {
+  fe_zero(p->X);
+  fe_one(p->Y);
+  fe_one(p->Z);
+  fe_zero(p->T);
+}
+
+void ge_add(ge* o, const ge* p, const ge* q) {
+  fe a, b, c, d, e, f, g, h, t;
+  fe_sub(a, p->Y, p->X); fe_sub(t, q->Y, q->X); fe_mul(a, a, t);
+  fe_add(b, p->Y, p->X); fe_carry(b);
+  fe_add(t, q->Y, q->X); fe_carry(t);
+  fe_mul(b, b, t);
+  fe_mul(c, p->T, q->T); fe_mul(c, c, FE_D2);
+  fe_mul(d, p->Z, q->Z); fe_add(d, d, d); fe_carry(d);
+  fe_sub(e, b, a);
+  fe_sub(f, d, c);
+  fe_add(g, d, c); fe_carry(g);
+  fe_add(h, b, a); fe_carry(h);
+  fe_mul(o->X, e, f);
+  fe_mul(o->Y, g, h);
+  fe_mul(o->Z, f, g);
+  fe_mul(o->T, e, h);
+}
+
+void ge_double(ge* o, const ge* p) {
+  fe a, b, c, e, f, g, h, t;
+  fe_sq(a, p->X);
+  fe_sq(b, p->Y);
+  fe_sq(c, p->Z); fe_add(c, c, c); fe_carry(c);
+  fe_add(h, a, b); fe_carry(h);
+  fe_add(t, p->X, p->Y); fe_carry(t); fe_sq(t, t);
+  fe_sub(e, h, t);
+  fe_sub(g, a, b);
+  fe_add(f, c, g); fe_carry(f);
+  fe_mul(o->X, e, f);
+  fe_mul(o->Y, g, h);
+  fe_mul(o->Z, f, g);
+  fe_mul(o->T, e, h);
+}
+
+// decompress: returns 1 if s is a valid canonical point encoding
+int ge_from_bytes(ge* p, const uint8_t s[32]) {
+  // reject non-canonical y >= p
+  static const uint8_t PBYTES[32] = {
+      0xed, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+      0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+      0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x7f};
+  uint8_t ymasked[32];
+  std::memcpy(ymasked, s, 32);
+  int sign = ymasked[31] >> 7;
+  ymasked[31] &= 0x7f;
+  // y >= p?
+  int ge_p = 1;
+  for (int i = 31; i >= 0; i--) {
+    if (ymasked[i] < PBYTES[i]) { ge_p = 0; break; }
+    if (ymasked[i] > PBYTES[i]) { ge_p = 1; break; }
+  }
+  if (ge_p) return 0;
+
+  fe y, y2, u, v, v3, x, vx2, chk;
+  fe_from_bytes(y, ymasked);
+  fe_sq(y2, y);
+  fe one;
+  fe_one(one);
+  fe_sub(u, y2, one);         // y^2 - 1
+  fe_mul(v, y2, FE_D);
+  fe_add(v, v, one); fe_carry(v);  // d y^2 + 1
+  fe_sq(v3, v); fe_mul(v3, v3, v); // v^3
+  fe t;
+  fe_sq(t, v3); fe_mul(t, t, v);   // v^7
+  fe_mul(t, t, u);                 // u v^7
+  fe_pow2523(t, t);                // (u v^7)^((p-5)/8)
+  fe_mul(x, u, v3); fe_mul(x, x, t);  // u v^3 (u v^7)^((p-5)/8)
+  fe_sq(vx2, x); fe_mul(vx2, vx2, v); // v x^2
+  fe_sub(chk, vx2, u);
+  if (!fe_is_zero(chk)) {
+    fe_add(chk, vx2, u); fe_carry(chk);
+    if (!fe_is_zero(chk)) return 0;
+    fe_mul(x, x, FE_SQRTM1);
+  }
+  if (fe_is_zero(x) && sign) return 0;  // -0 is invalid
+  if (fe_parity(x) != sign) {
+    fe zero;
+    fe_zero(zero);
+    fe_sub(x, zero, x);
+  }
+  fe_copy(p->X, x);
+  fe_copy(p->Y, y);
+  fe_one(p->Z);
+  fe_mul(p->T, x, y);
+  return 1;
+}
+
+void ge_neg(ge* o, const ge* p) {
+  fe zero;
+  fe_zero(zero);
+  fe_sub(o->X, zero, p->X);
+  fe_copy(o->Y, p->Y);
+  fe_copy(o->Z, p->Z);
+  fe_sub(o->T, zero, p->T);
+}
+
+void ge_to_bytes(uint8_t s[32], const ge* p) {
+  fe zi, x, y;
+  fe_invert(zi, p->Z);
+  fe_mul(x, p->X, zi);
+  fe_mul(y, p->Y, zi);
+  fe_to_bytes(s, y);
+  s[31] ^= uint8_t(fe_parity(x) << 7);
+}
+
+// base point B
+const fe GE_BX = {1738742601995546ULL, 1146398526822698ULL,
+                  2070867633025821ULL, 562264141797630ULL,
+                  587772402128613ULL};
+const fe GE_BY = {1801439850948184ULL, 1351079888211148ULL,
+                  450359962737049ULL, 900719925474099ULL,
+                  1801439850948198ULL};
+
+// ---------------------------------------------------------------------------
+// scalars mod L
+// ---------------------------------------------------------------------------
+
+const uint8_t LBYTES[32] = {0xed, 0xd3, 0xf5, 0x5c, 0x1a, 0x63, 0x12, 0x58,
+                            0xd6, 0x9c, 0xf7, 0xa2, 0xde, 0xf9, 0xde, 0x14,
+                            0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00,
+                            0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x10};
+
+// little-endian compare: a >= b
+int bytes_ge(const uint8_t* a, const uint8_t* b, int n) {
+  for (int i = n - 1; i >= 0; i--) {
+    if (a[i] > b[i]) return 1;
+    if (a[i] < b[i]) return 0;
+  }
+  return 1;  // equal
+}
+
+// r = x mod L for a 64-byte little-endian x; bitwise binary reduction.
+void sc_reduce64(uint8_t r[32], const uint8_t x[64]) {
+  // acc as 5x64-bit little-endian (L < 2^253 so 4 words + carry room)
+  uint64_t acc[5] = {0, 0, 0, 0, 0};
+  uint64_t l[4];
+  for (int i = 0; i < 4; i++) {
+    uint64_t v = 0;
+    for (int j = 7; j >= 0; j--) v = (v << 8) | LBYTES[8 * i + j];
+    l[i] = v;
+  }
+  for (int bit = 511; bit >= 0; bit--) {
+    // acc = acc * 2 + bit
+    uint64_t carry = 0;
+    for (int i = 0; i < 5; i++) {
+      uint64_t nv = (acc[i] << 1) | carry;
+      carry = acc[i] >> 63;
+      acc[i] = nv;
+    }
+    acc[0] |= (x[bit / 8] >> (bit % 8)) & 1;
+    // if acc >= L: acc -= L  (acc < 2L here, top word acc[4] is 0/1)
+    int ge_l = acc[4] != 0;
+    if (!ge_l) {
+      ge_l = 1;
+      for (int i = 3; i >= 0; i--) {
+        if (acc[i] > l[i]) { ge_l = 1; break; }
+        if (acc[i] < l[i]) { ge_l = 0; break; }
+      }
+    }
+    if (ge_l) {
+      unsigned __int128 borrow = 0;
+      for (int i = 0; i < 4; i++) {
+        unsigned __int128 d = (unsigned __int128)acc[i] - l[i] - (uint64_t)borrow;
+        acc[i] = (uint64_t)d;
+        borrow = (d >> 64) & 1;
+      }
+      acc[4] -= (uint64_t)borrow;
+    }
+  }
+  for (int i = 0; i < 4; i++)
+    for (int j = 0; j < 8; j++) r[8 * i + j] = uint8_t(acc[i] >> (8 * j));
+}
+
+// ---------------------------------------------------------------------------
+// double-scalar mult: [s]B + [h]A via interleaved 2-bit-window Straus
+// ---------------------------------------------------------------------------
+
+void ge_double_scalarmult(ge* out, const uint8_t s[32], const ge* a,
+                          const uint8_t h[32]) {
+  ge bpt;
+  fe_copy(bpt.X, GE_BX);
+  fe_copy(bpt.Y, GE_BY);
+  fe_one(bpt.Z);
+  fe_mul(bpt.T, GE_BX, GE_BY);
+
+  // table[i + 4j] = [i]B + [j]A, i,j in 0..3
+  ge table[16];
+  ge_identity(&table[0]);
+  table[1] = bpt;
+  ge_double(&table[2], &bpt);
+  ge_add(&table[3], &table[2], &bpt);
+  table[4] = *a;
+  ge_double(&table[8], a);
+  ge_add(&table[12], &table[8], a);
+  for (int j = 1; j < 4; j++)
+    for (int i = 1; i < 4; i++) ge_add(&table[i + 4 * j], &table[i], &table[4 * j]);
+
+  ge acc;
+  ge_identity(&acc);
+  for (int k = 127; k >= 0; k--) {
+    ge_double(&acc, &acc);
+    ge_double(&acc, &acc);
+    int sb = (s[(2 * k) / 8] >> ((2 * k) % 8)) & 1;
+    int sb1 = (2 * k + 1 < 256) ? (s[(2 * k + 1) / 8] >> ((2 * k + 1) % 8)) & 1 : 0;
+    int hb = (h[(2 * k) / 8] >> ((2 * k) % 8)) & 1;
+    int hb1 = (2 * k + 1 < 256) ? (h[(2 * k + 1) / 8] >> ((2 * k + 1) % 8)) & 1 : 0;
+    int idx = (sb | (sb1 << 1)) + 4 * (hb | (hb1 << 1));
+    if (idx) ge_add(&acc, &acc, &table[idx]);
+  }
+  *out = acc;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// public API
+// ---------------------------------------------------------------------------
+
+void ed25519_hram(const uint8_t r[32], const uint8_t pub[32],
+                  const uint8_t* msg, uint64_t msg_len, uint8_t h_out[32]) {
+  Sha512Ctx c;
+  sha512_init(&c);
+  sha512_update(&c, r, 32);
+  sha512_update(&c, pub, 32);
+  sha512_update(&c, msg, msg_len);
+  uint8_t digest[64];
+  sha512_final(&c, digest);
+  sc_reduce64(h_out, digest);
+}
+
+int ed25519_decompress(const uint8_t pub[32], uint8_t x_out[32],
+                       uint8_t y_out[32]) {
+  ge a;
+  if (!ge_from_bytes(&a, pub)) return 0;
+  fe_to_bytes(x_out, a.X);
+  fe_to_bytes(y_out, a.Y);
+  return 1;
+}
+
+int ed25519_verify(const uint8_t pub[32], const uint8_t* msg, uint64_t msg_len,
+                   const uint8_t sig[64]) {
+  // reject s >= L (strict RFC 8032)
+  if (bytes_ge(sig + 32, LBYTES, 32)) return 0;
+  // reject non-canonical R.y (matches crypto/ed25519.verify semantics)
+  {
+    uint8_t rm[32];
+    std::memcpy(rm, sig, 32);
+    rm[31] &= 0x7f;
+    static const uint8_t PB[32] = {
+        0xed, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+        0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+        0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x7f};
+    if (bytes_ge(rm, PB, 32)) return 0;
+  }
+  ge a;
+  if (!ge_from_bytes(&a, pub)) return 0;
+  ge neg_a;
+  ge_neg(&neg_a, &a);
+  uint8_t h[32];
+  ed25519_hram(sig, pub, msg, msg_len, h);
+  ge p;
+  ge_double_scalarmult(&p, sig + 32, &neg_a, h);  // [s]B + [h](-A)
+  uint8_t out[32];
+  ge_to_bytes(out, &p);
+  return std::memcmp(out, sig, 32) == 0;
+}
+
+}  // namespace tm

@@ -1,0 +1,29 @@
+// Ed25519 strict (cofactorless) batch verification for the host data
+// plane — the CPU fallback behind ops/gateway.Verifier and the native
+// half of the hybrid batch-size policy (SURVEY.md §7 step 2).
+//
+// Field arithmetic is radix-2^51 over unsigned __int128 (the standard
+// 5-limb representation for 64-bit targets); the group law uses extended
+// Edwards coordinates with the complete formulas from RFC 8032 §5.1.4.
+// Semantics mirror tendermint_tpu/crypto/ed25519.verify exactly:
+// reject s >= L, reject non-canonical R.y >= p, reject invalid A,
+// check [s]B == R + [h]A without multiplying by the cofactor.
+#pragma once
+#include <cstdint>
+
+namespace tm {
+
+// 1 if the signature verifies, else 0.
+int ed25519_verify(const uint8_t pub[32], const uint8_t* msg, uint64_t msg_len,
+                   const uint8_t sig[64]);
+
+// Decompress a public key to affine (x, y) field elements serialized as
+// 32-byte little-endian canonical values. Returns 1 on success.
+int ed25519_decompress(const uint8_t pub[32], uint8_t x_out[32],
+                       uint8_t y_out[32]);
+
+// h = SHA512(r || pub || msg) mod L, little-endian 32 bytes.
+void ed25519_hram(const uint8_t r[32], const uint8_t pub[32],
+                  const uint8_t* msg, uint64_t msg_len, uint8_t h_out[32]);
+
+}  // namespace tm

@@ -1,0 +1,26 @@
+// Spec implementations of SHA-256 (FIPS 180-4), SHA-512 (FIPS 180-4) and
+// RIPEMD-160 (Dobbertin/Bosselaers/Preneel) for the host data plane.
+// These back the CPU fallbacks of the hashing gateway and the ed25519
+// batch verifier's inner H(R||A||M).
+#pragma once
+#include <cstddef>
+#include <cstdint>
+
+namespace tm {
+
+void sha256(const uint8_t* data, size_t len, uint8_t out[32]);
+void sha512(const uint8_t* data, size_t len, uint8_t out[64]);
+void ripemd160(const uint8_t* data, size_t len, uint8_t out[20]);
+
+// streaming sha512 for H(R || A || M) without concatenation copies
+struct Sha512Ctx {
+  uint64_t h[8];
+  uint8_t buf[128];
+  uint64_t total;
+  size_t buflen;
+};
+void sha512_init(Sha512Ctx* c);
+void sha512_update(Sha512Ctx* c, const uint8_t* data, size_t len);
+void sha512_final(Sha512Ctx* c, uint8_t out[64]);
+
+}  // namespace tm

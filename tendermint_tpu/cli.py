@@ -68,6 +68,8 @@ def cmd_node(args) -> int:
         v = getattr(args, attr, None)
         if v is not None:
             setattr(cfg.base, attr, v)
+    if args.db_backend:
+        cfg.base.db_backend = args.db_backend
     if args.p2p_laddr:
         cfg.p2p.laddr = args.p2p_laddr
     if args.rpc_laddr:
@@ -211,6 +213,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--seeds", default=None, help="comma-separated host:port")
     sp.add_argument("--pex", action="store_true")
     sp.add_argument("--log_level", default="info")
+    sp.add_argument("--db_backend", default=None, help="memdb | filedb")
     sp.set_defaults(fn=cmd_node)
 
     sp = sub.add_parser("testnet", help="initialize files for an N-node testnet")

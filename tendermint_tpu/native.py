@@ -1,0 +1,223 @@
+"""ctypes bridge to the C++ host data-plane library (native/).
+
+The native library provides the CPU hot paths the reference implements in
+compiled Go (SURVEY.md §2.2: go-crypto verify loops, tmlibs/merkle): batch
+Ed25519 verification, batch SHA-256/RIPEMD-160, merkle leaf/tree hashing,
+and the TPU-kernel input marshal. Loading is lazy; if the shared library
+is missing it is built with `make -C native` (g++ is a baked-in tool);
+on any failure callers fall back to the pure-Python implementations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+logger = logging.getLogger("native")
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libtendermint_native.so")
+
+_lib = None
+_lib_mtx = threading.Lock()
+_load_failed = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=300,
+        )
+        return True
+    except Exception as exc:  # noqa: BLE001
+        logger.warning("native build failed: %s", exc)
+        return False
+
+
+def _sources_newer_than_lib() -> bool:
+    try:
+        lib_mtime = os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return True
+    src_dir = os.path.join(_NATIVE_DIR, "src")
+    for f in os.listdir(src_dir):
+        if os.path.getmtime(os.path.join(src_dir, f)) > lib_mtime:
+            return True
+    return False
+
+
+def get_lib():
+    """The loaded library, building it if needed; None if unavailable."""
+    global _lib, _load_failed
+    with _lib_mtx:
+        if _lib is not None:
+            return _lib
+        if _load_failed:
+            return None
+        if _sources_newer_than_lib() and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as exc:
+            logger.warning("native load failed: %s", exc)
+            _load_failed = True
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64 = ctypes.c_int64
+        lib.tm_sha256_batch.argtypes = [u8p, u64p, i64, u8p]
+        lib.tm_ripemd160_batch.argtypes = [u8p, u64p, i64, u8p]
+        lib.tm_merkle_leaf_hashes.argtypes = [u8p, u64p, i64, u8p]
+        lib.tm_merkle_root.argtypes = [u8p, i64, u8p]
+        lib.tm_ed25519_verify_batch.argtypes = [u8p, u8p, u8p, u64p, i64, u8p]
+        lib.tm_ed25519_prepare.argtypes = [
+            u8p, u8p, u8p, u64p, i64, u8p, u8p, u8p, i32p, u8p, u8p, u8p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _as_u8p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _concat(msgs: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
+    offsets = np.zeros(len(msgs) + 1, dtype=np.uint64)
+    total = 0
+    for i, m in enumerate(msgs):
+        total += len(m)
+        offsets[i + 1] = total
+    data = np.frombuffer(b"".join(msgs), dtype=np.uint8) if total else np.zeros(1, np.uint8)
+    return np.ascontiguousarray(data), offsets
+
+
+def sha256_batch(msgs: list[bytes]) -> list[bytes]:
+    lib = get_lib()
+    data, offsets = _concat(msgs)
+    out = np.zeros(len(msgs) * 32, dtype=np.uint8)
+    lib.tm_sha256_batch(
+        _as_u8p(data), offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        len(msgs), _as_u8p(out),
+    )
+    raw = out.tobytes()
+    return [raw[32 * i : 32 * i + 32] for i in range(len(msgs))]
+
+
+def ripemd160_batch(msgs: list[bytes]) -> list[bytes]:
+    lib = get_lib()
+    data, offsets = _concat(msgs)
+    out = np.zeros(len(msgs) * 20, dtype=np.uint8)
+    lib.tm_ripemd160_batch(
+        _as_u8p(data), offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        len(msgs), _as_u8p(out),
+    )
+    raw = out.tobytes()
+    return [raw[20 * i : 20 * i + 20] for i in range(len(msgs))]
+
+
+def merkle_leaf_hashes(items: list[bytes]) -> list[bytes]:
+    lib = get_lib()
+    data, offsets = _concat(items)
+    out = np.zeros(len(items) * 20, dtype=np.uint8)
+    lib.tm_merkle_leaf_hashes(
+        _as_u8p(data), offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        len(items), _as_u8p(out),
+    )
+    raw = out.tobytes()
+    return [raw[20 * i : 20 * i + 20] for i in range(len(items))]
+
+
+def merkle_root_from_leaf_digests(digests: list[bytes]) -> bytes:
+    if not digests:
+        return b""
+    lib = get_lib()
+    leaves = np.frombuffer(b"".join(digests), dtype=np.uint8)
+    out = np.zeros(20, dtype=np.uint8)
+    lib.tm_merkle_root(_as_u8p(np.ascontiguousarray(leaves)), len(digests), _as_u8p(out))
+    return out.tobytes()
+
+
+def merkle_root(items: list[bytes]) -> bytes:
+    return merkle_root_from_leaf_digests(merkle_leaf_hashes(items))
+
+
+def ed25519_verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> list[bool]:
+    """(pubkey32, msg, sig64) triples -> per-item validity."""
+    lib = get_lib()
+    n = len(items)
+    pubs = np.zeros(n * 32, dtype=np.uint8)
+    sigs = np.zeros(n * 64, dtype=np.uint8)
+    msgs = []
+    ok_shape = np.ones(n, dtype=bool)
+    for i, (pub, msg, sig) in enumerate(items):
+        if len(pub) != 32 or len(sig) != 64:
+            ok_shape[i] = False
+            msgs.append(b"")
+            continue
+        pubs[32 * i : 32 * i + 32] = np.frombuffer(pub, dtype=np.uint8)
+        sigs[64 * i : 64 * i + 64] = np.frombuffer(sig, dtype=np.uint8)
+        msgs.append(bytes(msg))
+    data, offsets = _concat(msgs)
+    out = np.zeros(n, dtype=np.uint8)
+    lib.tm_ed25519_verify_batch(
+        _as_u8p(pubs), _as_u8p(sigs), _as_u8p(data),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n, _as_u8p(out),
+    )
+    return [bool(o and s) for o, s in zip(out, ok_shape)]
+
+
+def ed25519_prepare(items: list[tuple[bytes, bytes, bytes]], bucket: int):
+    """Native TPU-kernel marshal: returns (ax, ay, ry, r_sign, s, h, valid)
+    where field/scalar columns are (bucket, 32) uint8 little-endian."""
+    lib = get_lib()
+    n = len(items)
+    pubs = np.zeros(bucket * 32, dtype=np.uint8)
+    sigs = np.zeros(bucket * 64, dtype=np.uint8)
+    msgs = []
+    shape_ok = np.ones(bucket, dtype=np.uint8)
+    for i in range(bucket):
+        if i >= n:
+            msgs.append(b"")
+            shape_ok[i] = 0
+            continue
+        pub, msg, sig = items[i]
+        if len(pub) != 32 or len(sig) != 64:
+            msgs.append(b"")
+            shape_ok[i] = 0
+            continue
+        pubs[32 * i : 32 * i + 32] = np.frombuffer(pub, dtype=np.uint8)
+        sigs[64 * i : 64 * i + 64] = np.frombuffer(sig, dtype=np.uint8)
+        msgs.append(bytes(msg))
+    data, offsets = _concat(msgs)
+    ax = np.zeros((bucket, 32), dtype=np.uint8)
+    ay = np.zeros((bucket, 32), dtype=np.uint8)
+    ry = np.zeros((bucket, 32), dtype=np.uint8)
+    s = np.zeros((bucket, 32), dtype=np.uint8)
+    h = np.zeros((bucket, 32), dtype=np.uint8)
+    rs = np.zeros(bucket, dtype=np.int32)
+    valid = np.zeros(bucket, dtype=np.uint8)
+    lib.tm_ed25519_prepare(
+        _as_u8p(pubs), _as_u8p(sigs), _as_u8p(data),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), bucket,
+        _as_u8p(ax), _as_u8p(ay), _as_u8p(ry),
+        rs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        _as_u8p(s), _as_u8p(h), _as_u8p(valid),
+    )
+    valid = (valid & shape_ok).astype(bool)
+    return ax, ay, ry, rs, s, h, valid

@@ -92,28 +92,45 @@ _BT = _const_limbs((ed_ref.B[0] * ed_ref.B[1]) % P)
 _SQRT_M1 = _const_limbs(ed_ref.I_SQRT)
 _D_LIMBS = _const_limbs(ed_ref.D)
 
+
+def _affine(pt) -> tuple[int, int]:
+    zinv = pow(pt[2], P - 2, P)
+    return (pt[0] * zinv % P, pt[1] * zinv % P)
+
+
+# 2B and 3B affine constants for the 2-bit windowed ladder
+_B2_AFF = _affine(ed_ref.point_add(ed_ref.B, ed_ref.B))
+_B3_AFF = _affine(
+    ed_ref.point_add(ed_ref.point_add(ed_ref.B, ed_ref.B), ed_ref.B)
+)
+_B2X, _B2Y = _const_limbs(_B2_AFF[0]), _const_limbs(_B2_AFF[1])
+_B3X, _B3Y = _const_limbs(_B3_AFF[0]), _const_limbs(_B3_AFF[1])
+
 # ---------------------------------------------------------------------------
 # field arithmetic on (17, B) int32 arrays
 # ---------------------------------------------------------------------------
 
 
+def _roll19(hi: jax.Array) -> jax.Array:
+    """Shift carries up one limb; the top limb's carry wraps to limb 0
+    with weight 19 (2^255 = 19 mod p)."""
+    return jnp.concatenate([19 * hi[NLIMB - 1 :], hi[: NLIMB - 1]], axis=0)
+
+
 def _carry(x: jax.Array) -> jax.Array:
-    """Reduce limbs to the LOOSE range [0, 2^15]; inputs non-negative
-    < 2^26 per limb. One full pass, a times-19 top fold, then a single
-    fixup step: limb 0 ends < 2^15 and limb 1 may reach exactly 2^15,
-    which the multiply bound tolerates ((2^15)^2 = 2^30 still fits int32
-    and hi <= 2^15 keeps accumulator sums < 2^21). Half the sequential
-    critical path of a strict two-pass reduction."""
-    out = []
-    c = None
-    for k in range(NLIMB):
-        v = x[k] if c is None else x[k] + c
-        out.append(v & M15)
-        c = v >> 15
-    v0 = out[0] + 19 * c
-    out[0] = v0 & M15
-    out[1] = out[1] + (v0 >> 15)
-    return jnp.stack(out, axis=0)
+    """Reduce limbs to the LOOSE range [0, ~2^15]; inputs non-negative
+    < 2^26 per limb. TWO fully-parallel passes instead of a 17-step
+    sequential chain — the chain was the kernel's critical path (every
+    fmul ends in a carry; the ladder runs ~4000 of them).
+
+    Bounds: pass 1 carries < 2^11 (19x top-fold < 19*2^11), so y < 2^15 +
+    19*2^11 < 2^17; pass 2 carries <= 3, leaving limbs <= 2^15 - 1 + 57.
+    The multiply tolerates that loose bound: products stay < 2^31 and the
+    17-row accumulator sums < 2^21 per window, refolding < 2^26 — inside
+    this function's own input bound, so the loose form is closed under
+    fmul/fadd/fsub."""
+    y = (x & M15) + _roll19(x >> 15)
+    return (y & M15) + _roll19(y >> 15)
 
 
 def fadd(a: jax.Array, b: jax.Array) -> jax.Array:
@@ -251,35 +268,70 @@ def _select4(sel: jax.Array, options):
 # ---------------------------------------------------------------------------
 
 
-def _verify_impl(ax, ay, r_y, r_sign, s_bits, h_bits):
+def _digits2_from_limbs(limbs: jax.Array) -> jax.Array:
+    """(17,B) 15-bit limbs -> (127,B) 2-bit digits, MSB-first. Scalars are
+    < L < 2^253, so bits 253/254 are zero. Unpacking on-device keeps the
+    host->device transfer at 17 words/scalar instead of 253 bit-ints —
+    transfer volume was the sustained-throughput bottleneck."""
+    shifts = jnp.arange(15, dtype=jnp.int32)
+    bits = (limbs[:, None, :] >> shifts[None, :, None]) & 1  # (17,15,B)
+    bits = bits.reshape(NLIMB * 15, limbs.shape[-1])[:254]  # little-endian
+    d = bits[0::2] + 2 * bits[1::2]  # (127,B)
+    return d[::-1]
+
+
+def _verify_impl(ax, ay, r_y, r_sign, s_limbs, h_limbs):
     """ax/ay: affine pubkey limbs (17,B); r_y: R's y limbs (canonical,
-    host-validated < p); r_sign: (B,) x-parity of R; s_bits/h_bits:
-    (253,B). Returns bool[B]."""
+    host-validated < p); r_sign: (B,) x-parity of R; s_limbs/h_limbs:
+    (17,B) 15-bit limb encodings of the scalars. Returns bool[B].
+
+    Interleaved Straus with 2-bit joint windows: 127 iterations of
+    (2 doublings + 1 table add) instead of 253 x (1 doubling + 1 add) —
+    same 253 doublings, half the point additions. The 16-entry table
+    [i]B + [j](-A), i,j in 0..3, costs ~11 one-time point ops (B-side
+    multiples are host constants)."""
     batch = ax.shape[-1]
     zeros = jnp.zeros((NLIMB, batch), dtype=jnp.int32)
     one = zeros.at[0].set(1)
 
-    # -A = (p - x, y)
+    def const_pt(xc, yc):
+        x = jnp.broadcast_to(jnp.asarray(xc)[:, None], (NLIMB, batch))
+        y = jnp.broadcast_to(jnp.asarray(yc)[:, None], (NLIMB, batch))
+        return (x, y, one, fmul(x, y))
+
+    # -A = (p - x, y) and its small multiples
     nax = fsub(zeros, ax)
     neg_a = (nax, ay, one, fmul(nax, ay))
-
-    b_pt = (
-        jnp.broadcast_to(jnp.asarray(_BX)[:, None], (NLIMB, batch)),
-        jnp.broadcast_to(jnp.asarray(_BY)[:, None], (NLIMB, batch)),
-        one,
-        jnp.broadcast_to(jnp.asarray(_BT)[:, None], (NLIMB, batch)),
-    )
-    b_neg_a = point_add(b_pt, neg_a)
+    na2 = point_double(neg_a)
+    na3 = point_add(na2, neg_a)
     ident = _identity(batch)
-    options = [ident, b_pt, neg_a, b_neg_a]
+    b_row = [ident, const_pt(_BX, _BY), const_pt(_B2X, _B2Y), const_pt(_B3X, _B3Y)]
+    a_row = [ident, neg_a, na2, na3]
+    table = []
+    for j in range(4):  # h digit (multiples of -A)
+        for i in range(4):  # s digit (multiples of B)
+            if i == 0:
+                table.append(a_row[j])
+            elif j == 0:
+                table.append(b_row[i])
+            else:
+                table.append(point_add(b_row[i], a_row[j]))
+    tcoords = [
+        jnp.stack([t[c] for t in table], axis=0) for c in range(4)
+    ]  # 4 x (16,17,B)
 
-    # Straus, MSB (bit 252) first
-    xs = jnp.stack([s_bits[::-1], h_bits[::-1]], axis=1)  # (253, 2, B)
+    xs = jnp.stack(
+        [_digits2_from_limbs(s_limbs), _digits2_from_limbs(h_limbs)], axis=1
+    )  # (127,2,B)
+    idx16 = jnp.arange(16, dtype=jnp.int32)
 
-    def step(acc, bit_pair):
-        acc = point_double(acc)
-        sel = bit_pair[0] + 2 * bit_pair[1]
-        addend = _select4(sel, options)
+    def step(acc, dig):
+        acc = point_double(point_double(acc))
+        sel = dig[0] + 4 * dig[1]  # (B,)
+        onehot = (sel[None, :] == idx16[:, None]).astype(jnp.int32)  # (16,B)
+        addend = tuple(
+            jnp.sum(onehot[:, None, :] * tc, axis=0) for tc in tcoords
+        )
         return point_add(acc, addend), None
 
     acc, _ = jax.lax.scan(step, ident, xs)
@@ -322,9 +374,13 @@ def _decompress_impl(y_limbs, x_sign):
     batch = y_limbs.shape[-1]
     zeros = jnp.zeros((NLIMB, batch), dtype=jnp.int32)
     one = zeros.at[0].set(1)
+    # constants must be batch-width: fmul sizes its accumulator from its
+    # FIRST argument's batch axis
+    d_l = jnp.broadcast_to(jnp.asarray(_D_LIMBS)[:, None], (NLIMB, batch))
+    sqrt_m1 = jnp.broadcast_to(jnp.asarray(_SQRT_M1)[:, None], (NLIMB, batch))
     y2 = fsq(y_limbs)
     u = fsub(y2, one)
-    v = fadd(fmul(jnp.asarray(_D_LIMBS)[:, None], y2), one)
+    v = fadd(fmul(d_l, y2), one)
     v3 = fmul(fsq(v), v)
     v7 = fmul(fsq(v3), v)
     x = fmul(fmul(u, v3), _pow_2_252_m3(fmul(u, v7)))
@@ -332,7 +388,7 @@ def _decompress_impl(y_limbs, x_sign):
     ok_direct = feq(vx2, u)
     neg_u = fsub(zeros, u)
     ok_flip = feq(vx2, neg_u)
-    x = jnp.where(ok_flip[None, :], fmul(x, jnp.asarray(_SQRT_M1)[:, None]), x)
+    x = jnp.where(ok_flip[None, :], fmul(x, sqrt_m1), x)
     x = fcanon(x)
     valid = ok_direct | ok_flip
     x_is_zero = jnp.all(x == 0, axis=0)
@@ -348,6 +404,8 @@ _decompress_jit = jax.jit(_decompress_impl)
 def decompress_batch(compressed: list[bytes]) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """32-byte encodings -> (x_limbs int32[17,B], y_limbs int32[17,B],
     valid bool[B]). Rejects non-canonical y >= p on host."""
+    n = len(compressed)
+    bucket = _next_pow2(max(n, 1))  # pad: one compiled program per bucket
     ys, signs, valid_host = [], [], []
     for c in compressed:
         yi = int.from_bytes(c, "little")
@@ -359,12 +417,15 @@ def decompress_batch(compressed: list[bytes]) -> tuple[np.ndarray, np.ndarray, n
         else:
             valid_host.append(True)
             ys.append(yi)
+    ys += [1] * (bucket - n)
+    signs += [0] * (bucket - n)
+    valid_host += [False] * (bucket - n)
     y_limbs = int_to_limbs_np(ys)
     x_limbs, valid_dev = _decompress_jit(
         jnp.asarray(y_limbs), jnp.asarray(np.array(signs, dtype=np.int32))
     )
     valid = np.asarray(valid_dev) & np.array(valid_host)
-    return np.asarray(x_limbs), y_limbs, valid
+    return np.asarray(x_limbs)[:, :n], y_limbs[:, :n], valid[:n]
 
 
 # ---------------------------------------------------------------------------
@@ -394,9 +455,9 @@ def _next_pow2(n: int) -> int:
     return b
 
 
-def prepare_batch(items: list[tuple[bytes, bytes, bytes]], bucket: int):
-    """Host-side marshaling of (pubkey, msg, sig) triples into kernel
-    inputs. Returns (ax, ay, ry, r_sign, s_bits, h_bits, valid)."""
+def _prepare_ints(items: list[tuple[bytes, bytes, bytes]], bucket: int):
+    """Shared host validation/marshaling: returns python-int columns
+    (ax, ay, ry, r_sign, s, h, valid)."""
     ax_i, ay_i, ry_i = [0] * bucket, [1] * bucket, [1] * bucket
     rs = np.zeros(bucket, dtype=np.int32)
     s_i, h_i = [0] * bucket, [0] * bucket
@@ -428,7 +489,13 @@ def prepare_batch(items: list[tuple[bytes, bytes, bytes]], bucket: int):
         rs[i] = r_sign
         s_i[i], h_i[i] = s, h
         valid[i] = True
+    return ax_i, ay_i, ry_i, rs, s_i, h_i, valid
 
+
+def prepare_batch(items: list[tuple[bytes, bytes, bytes]], bucket: int):
+    """Bit-array form (used by the pallas variant): returns
+    (ax, ay, ry, r_sign, s_bits(253,B), h_bits(253,B), valid)."""
+    ax_i, ay_i, ry_i, rs, s_i, h_i, valid = _prepare_ints(items, bucket)
     return (
         int_to_limbs_np(ax_i),
         int_to_limbs_np(ay_i),
@@ -436,6 +503,21 @@ def prepare_batch(items: list[tuple[bytes, bytes, bytes]], bucket: int):
         rs,
         scalar_bits_np(s_i),
         scalar_bits_np(h_i),
+        valid,
+    )
+
+
+def prepare_batch_limbs(items: list[tuple[bytes, bytes, bytes]], bucket: int):
+    """Limb form (the jnp verify kernel): scalars travel as (17,B) 15-bit
+    limbs; the kernel unpacks digits on-device."""
+    ax_i, ay_i, ry_i, rs, s_i, h_i, valid = _prepare_ints(items, bucket)
+    return (
+        int_to_limbs_np(ax_i),
+        int_to_limbs_np(ay_i),
+        int_to_limbs_np(ry_i),
+        rs,
+        int_to_limbs_np(s_i),
+        int_to_limbs_np(h_i),
         valid,
     )
 
@@ -449,13 +531,13 @@ def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
     if n == 0:
         return np.zeros(0, dtype=bool)
     bucket = _next_pow2(n)
-    ax, ay, ry, rs, s_bits, h_bits, valid = prepare_batch(items, bucket)
+    ax, ay, ry, rs, s_l, h_l, valid = prepare_batch_limbs(items, bucket)
     ok = _verify_jit(
         jnp.asarray(ax),
         jnp.asarray(ay),
         jnp.asarray(ry),
         jnp.asarray(rs),
-        jnp.asarray(s_bits),
-        jnp.asarray(h_bits),
+        jnp.asarray(s_l),
+        jnp.asarray(h_l),
     )
     return np.asarray(ok)[:n] & valid[:n]

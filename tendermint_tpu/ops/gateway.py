@@ -138,10 +138,10 @@ class ShardedVerifier(Verifier):
             bucket = ops_ed._next_pow2(max(n, m))
             if bucket % m:
                 bucket = ((bucket + m - 1) // m) * m
-            ax, ay, ry, rs, s_bits, h_bits, valid = ops_ed.prepare_batch(items, bucket)
+            ax, ay, ry, rs, s_l, h_l, valid = ops_ed.prepare_batch_limbs(items, bucket)
             ok = self._verify(
                 jnp.asarray(ax), jnp.asarray(ay), jnp.asarray(ry),
-                jnp.asarray(rs), jnp.asarray(s_bits), jnp.asarray(h_bits),
+                jnp.asarray(rs), jnp.asarray(s_l), jnp.asarray(h_l),
             )
             with self._mtx:
                 self._stats["tpu_batches"] += 1
