@@ -2,13 +2,16 @@
 
 Measures batched Ed25519 commit verification — the reference's hottest
 path (types/validator_set.go:220-264: N sequential verifies per block) —
-on the available accelerator, against our own CPU reference loop (the
-Go-equivalent baseline; upstream publishes no numbers, BASELINE.md).
+through the PRODUCTION gateway path (ops/gateway.py Verifier, which
+selects the fp32 radix-2^8 conv kernel in ops/ed25519_f32.py), against
+our own CPU reference loop (the Go-equivalent baseline; upstream
+publishes no numbers, BASELINE.md).
 
-The accelerator measurement is SUSTAINED pipelined throughput: host
-marshaling of batch i+1 overlaps device execution of batch i (jax async
-dispatch), exactly how a fast-syncing node streams commits through the
-verifier.
+The accelerator measurement is SUSTAINED pipelined throughput: prep
+threads marshal upcoming batches while the device runs the current
+kernel (jax async dispatch), exactly how a fast-syncing node streams
+commits through the verifier. Results are resolved (and parity-checked
+against the CPU verifier on a sample) at the end.
 
 Prints ONE JSON line:
   {"metric": "verify_commit_sigs_per_sec", "value": N, "unit": "sigs/s",
@@ -27,11 +30,12 @@ from tendermint_tpu.jitcache import enable as _enable_jit_cache
 _enable_jit_cache()
 
 BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
-N_BATCHES = int(os.environ.get("BENCH_N_BATCHES", "6"))
+N_BATCHES = int(os.environ.get("BENCH_N_BATCHES", "8"))
 CPU_SAMPLE = int(os.environ.get("BENCH_CPU_SAMPLE", "512"))
+PREP_THREADS = int(os.environ.get("BENCH_PREP_THREADS", "2"))
 
 
-def _make_items(n: int):
+def _make_items(n: int, salt: int = 0):
     from tendermint_tpu.crypto import ed25519 as ed
 
     # 64 distinct validators signing vote-like canonical messages, cycled
@@ -43,21 +47,24 @@ def _make_items(n: int):
         k = i % 64
         msg = (
             b'{"chain_id":"bench","vote":{"block_id":{},"height":%d,'
-            b'"round":0,"type":2,"validator_index":%d}}' % (1 + i // 64, k)
+            b'"round":%d,"type":2,"validator_index":%d}}'
+            % (1 + i // 64, salt, k)
         )
         items.append((pubs[k], msg, ed.sign(seeds[k], msg)))
     return items
 
 
 def main() -> None:
+    import queue as _q
+    import threading as _t
+
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
     from tendermint_tpu.crypto import ed25519 as ed_cpu
-    from tendermint_tpu.ops import ed25519 as ops_ed
+    from tendermint_tpu.ops.gateway import Verifier
 
-    chunks = [_make_items(BATCH) for _ in range(N_BATCHES)]
+    chunks = [_make_items(BATCH, salt) for salt in range(N_BATCHES)]
+    verifier = Verifier(min_tpu_batch=1)
 
     # --- CPU baseline: the reference-faithful sequential loop ------------
     t0 = time.perf_counter()
@@ -65,45 +72,52 @@ def main() -> None:
         assert ed_cpu.verify(pub, msg, sig)
     cpu_rate = CPU_SAMPLE / (time.perf_counter() - t0)
 
-    def dispatch(prep):
-        args = tuple(jnp.asarray(a) for a in prep[:6])
-        return ops_ed._verify_jit(*args), prep[6]
+    # warmup (compile) through the production path
+    ok = verifier.verify_batch(chunks[0])
+    assert all(ok), "warmup verify failed"
 
-    # warmup (compile)
-    ok, valid = dispatch(ops_ed.prepare_batch_limbs(chunks[0], BATCH))
-    assert bool(np.asarray(ok).all()), "warmup verify failed"
-
-    # --- sustained pipelined throughput: a prep thread feeds marshaled
-    # batches while the device runs the previous kernel ------------------
-    import queue as _q
-    import threading as _t
-
-    fed: _q.Queue = _q.Queue(maxsize=2)
+    # --- sustained pipelined throughput ---------------------------------
+    # prep threads run verify_batch_async (host marshal + async device
+    # dispatch); the main thread collects resolvers in order and blocks
+    # only at the end. In-flight window is bounded by the queue.
+    fed: _q.Queue = _q.Queue(maxsize=PREP_THREADS + 1)
+    idx = {"next": 0}
+    idx_mtx = _t.Lock()
 
     def prep_worker():
-        # host marshaling only: device transfers stay on the dispatch
-        # thread (off-thread device_put serializes with kernel execution
-        # on this backend and measured slower)
-        for chunk in chunks:
-            fed.put(ops_ed.prepare_batch_limbs(chunk, BATCH))
-        fed.put(None)
+        while True:
+            with idx_mtx:
+                i = idx["next"]
+                if i >= len(chunks):
+                    return
+                idx["next"] = i + 1
+            fed.put((i, verifier.verify_batch_async(chunks[i])))
 
     t0 = time.perf_counter()
-    _t.Thread(target=prep_worker, daemon=True).start()
-    in_flight, valids = [], []
-    while True:
-        prep = fed.get()
-        if prep is None:
-            break
-        ok, valid = dispatch(prep)
-        in_flight.append(ok)
-        valids.append(valid)
-    results = [np.asarray(ok) for ok in in_flight]
+    threads = [
+        _t.Thread(target=prep_worker, daemon=True) for _ in range(PREP_THREADS)
+    ]
+    for th in threads:
+        th.start()
+    resolvers = [None] * len(chunks)
+    for _ in range(len(chunks)):
+        i, resolve = fed.get()
+        resolvers[i] = resolve
+    results = [r() for r in resolvers]
     elapsed = time.perf_counter() - t0
-    assert all(r.all() and v.all() for r, v in zip(results, valids))
+    assert all(all(r) for r in results), "verify failed in sustained run"
     total = BATCH * N_BATCHES
     rate = total / elapsed
 
+    # --- parity check: TPU verdicts == CPU verdicts on a mixed sample ----
+    sample = chunks[0][:64]
+    tampered = [(p, m, sig[:10] + bytes([sig[10] ^ 1]) + sig[11:]) for p, m, sig in chunks[1][:64]]
+    mixed = sample + tampered
+    tpu_verdicts = verifier.verify_batch(mixed)
+    cpu_verdicts = [ed_cpu.verify(p, m, s) for p, m, s in mixed]
+    assert tpu_verdicts == cpu_verdicts, "TPU/CPU parity failure"
+
+    stats = verifier.stats()
     print(
         json.dumps(
             {
@@ -117,6 +131,8 @@ def main() -> None:
                     "elapsed_s": round(elapsed, 3),
                     "cpu_sigs_per_sec": round(cpu_rate, 1),
                     "platform": jax.devices()[0].platform,
+                    "gateway_stats": stats,
+                    "parity": "ok",
                 },
             }
         )

@@ -1,0 +1,78 @@
+"""Dev tool: measure each Ed25519 verify kernel variant on the local device.
+
+Used to pick the production kernel for ops/gateway.py and bench.py.
+Prints one JSON line per variant.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from tendermint_tpu.jitcache import enable as _enable_jit_cache
+
+_enable_jit_cache()
+
+BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
+N_BATCHES = int(os.environ.get("BENCH_N_BATCHES", "4"))
+
+
+def make_items(n: int):
+    from tendermint_tpu.crypto import ed25519 as ed
+
+    seeds = [bytes([i]) * 32 for i in range(64)]
+    pubs = [ed.public_key(s) for s in seeds]
+    items = []
+    for i in range(n):
+        k = i % 64
+        msg = b'{"chain_id":"bench","height":%d,"vi":%d}' % (1 + i // 64, k)
+        items.append((pubs[k], msg, ed.sign(seeds[k], msg)))
+    return items
+
+
+def timed(name, fn, items, n_batches):
+    import numpy as np
+
+    # warmup / compile
+    t0 = time.perf_counter()
+    ok = fn(items)
+    compile_s = time.perf_counter() - t0
+    assert np.asarray(ok).all(), f"{name}: verify failed"
+    t0 = time.perf_counter()
+    outs = []
+    for _ in range(n_batches):
+        outs.append(fn(items))
+    res = [np.asarray(o) for o in outs]
+    el = time.perf_counter() - t0
+    assert all(r.all() for r in res)
+    rate = len(items) * n_batches / el
+    print(json.dumps({
+        "variant": name, "sigs_per_sec": round(rate, 1),
+        "batch": len(items), "compile_s": round(compile_s, 1),
+        "ms_per_batch": round(1000 * el / n_batches, 1),
+    }), flush=True)
+    return rate
+
+
+def main():
+    import jax
+
+    print(f"platform: {jax.devices()[0]}", file=sys.stderr)
+    items = make_items(BATCH)
+
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+
+    if which in ("all", "xla"):
+        from tendermint_tpu.ops import ed25519 as ops_ed
+        timed("xla_jnp", ops_ed.verify_batch, items, N_BATCHES)
+    if which in ("all", "pallas"):
+        from tendermint_tpu.ops import ed25519_pallas as ops_pl
+        timed("pallas", ops_pl.verify_batch, items, N_BATCHES)
+
+
+if __name__ == "__main__":
+    main()

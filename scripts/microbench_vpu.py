@@ -1,0 +1,87 @@
+"""Dev tool: raw elementwise throughput of int32 mul vs fp32 mul vs bf16
+matmul on the local device — picks the arithmetic substrate for the
+Ed25519 limb kernels."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 17 * 8192  # same element count as one limb-major field element batch
+REPS = 200
+
+
+def bench(name, fn, *args):
+    fn(*args).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        o = fn(*args)
+    o.block_until_ready()
+    el = time.perf_counter() - t0
+    print(f"{name}: {el/REPS*1e6:.1f} us/op")
+
+
+def main():
+    print(jax.devices()[0], file=sys.stderr)
+    key = jax.random.PRNGKey(0)
+    a_i = jax.random.randint(key, (N,), 0, 32768, dtype=jnp.int32)
+    b_i = jax.random.randint(key, (N,), 0, 32768, dtype=jnp.int32)
+    a_f = a_i.astype(jnp.float32)
+    b_f = b_i.astype(jnp.float32)
+
+    # chains of K dependent multiplies to avoid measuring dispatch
+    K = 64
+
+    @jax.jit
+    def chain_i32(a, b):
+        x = a
+        for _ in range(K):
+            x = (x * b) & 0x7FFF
+        return x
+
+    @jax.jit
+    def chain_f32(a, b):
+        x = a
+        for _ in range(K):
+            x = x * b + a
+        return x
+
+    @jax.jit
+    def chain_i32_addshift(a, b):
+        x = a
+        for _ in range(K):
+            x = (x + b) >> 1
+        return x
+
+    @jax.jit
+    def chain_i16_mul(a, b):
+        x = a.astype(jnp.int16)
+        bb = b.astype(jnp.int16)
+        for _ in range(K):
+            x = x * bb
+        return x.astype(jnp.int32)
+
+    bench(f"int32 mul+mask x{K} over {N}", chain_i32, a_i, b_i)
+    bench(f"fp32 fma x{K} over {N}", chain_f32, a_f, b_f)
+    bench(f"int32 add+shift x{K} over {N}", chain_i32_addshift, a_i, b_i)
+    bench(f"int16 mul x{K} over {N}", chain_i16_mul, a_i, b_i)
+
+    # MXU: bf16 matmul throughput reference
+    M = 1024
+    am = jax.random.normal(key, (M, M), dtype=jnp.bfloat16)
+    bm = jax.random.normal(key, (M, M), dtype=jnp.bfloat16)
+
+    @jax.jit
+    def mm(a, b):
+        x = a
+        for _ in range(8):
+            x = jnp.dot(x, b, preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+        return x
+
+    bench("bf16 1024^3 matmul x8", mm, am, bm)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+"""Can the limb-product convolution run as lax.conv (MXU)? Check exactness
+with adversarial max-bound limbs and measure speed."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+from tendermint_tpu.crypto import ed25519 as ed
+
+P = ed.P
+NL = 32
+R = 256.0
+RINV = 1.0 / 256.0
+
+
+def _roll38(hi):
+    return jnp.concatenate([38.0 * hi[NL - 1:], hi[: NL - 1]], axis=0)
+
+
+def _carry1(x):
+    hi = jnp.floor(x * RINV)
+    return x - hi * R + _roll38(hi)
+
+
+def fmul_conv(a, b):
+    # a, b: (32, B) f32. Depthwise conv: channels = batch, spatial = limbs.
+    # c[k] = sum_i a[i] * b[k-i], k in 0..62 (full correlation output)
+    Bn = a.shape[-1]
+    lhs = a.T[None]  # (1, B, 32)  NCW
+    rhs = b.T[:, None, ::-1]  # (B, 1, 32) OIW, reversed for convolution
+    out = jax.lax.conv_general_dilated(
+        lhs, rhs,
+        window_strides=(1,),
+        padding=[(31, 31)],
+        feature_group_count=Bn,
+        dimension_numbers=("NCW", "OIW", "NCW"),
+        precision=jax.lax.Precision.HIGHEST,
+    )  # (1, B, 63)
+    rows = out[0].T  # (63, B)
+    t = rows[NL:]
+    t_hi = jnp.floor(t * RINV)
+    t_lo = t - t_hi * R
+    out32 = rows[:NL]
+    out32 = out32.at[:31].add(38.0 * t_lo)
+    out32 = out32.at[1:32].add(38.0 * t_hi)
+    return _carry1(_carry1(_carry1(out32)))
+
+
+def limbs_to_int(col):
+    return sum(int(round(float(col[k]))) << (8 * k) for k in range(NL))
+
+
+def main():
+    print(jax.devices()[0], file=sys.stderr)
+    B = 8192
+    rng = np.random.default_rng(1)
+
+    # adversarial: limbs at the loose-bound maxima (749 limb0, 268 others)
+    a_np = rng.integers(0, 268, (NL, B)).astype(np.float32)
+    b_np = rng.integers(0, 268, (NL, B)).astype(np.float32)
+    a_np[0] = rng.integers(600, 750, B)
+    b_np[0] = rng.integers(600, 750, B)
+    a = jnp.asarray(a_np)
+    b = jnp.asarray(b_np)
+
+    fn = jax.jit(fmul_conv)
+    t0 = time.perf_counter()
+    out = np.asarray(fn(a, b))
+    print(f"compile: {time.perf_counter()-t0:.1f}s")
+
+    ok = True
+    for i in range(64):
+        ai = limbs_to_int(a_np[:, i])
+        bi = limbs_to_int(b_np[:, i])
+        got = limbs_to_int(out[:, i]) % P
+        if got != (ai * bi) % P:
+            ok = False
+            print(f"MISMATCH lane {i}")
+            break
+    print("exact:", ok, "| max limb:", out.max())
+
+    # speed: scan chain slope
+    def make(K):
+        @jax.jit
+        def chain(a, b):
+            def body(x, _):
+                return fmul_conv(x, b), None
+            x, _ = jax.lax.scan(body, a, None, length=K)
+            return x
+        return chain
+
+    f1, f2 = make(100), make(400)
+    np.asarray(f1(a, b)); np.asarray(f2(a, b))
+    reps = 6
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        np.asarray(f1(a, b))
+    e1 = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        np.asarray(f2(a, b))
+    e2 = (time.perf_counter() - t0) / reps
+    print(f"conv fmul: {(e2-e1)/300*1e6:.1f} us/fmul (chain slope)")
+
+
+if __name__ == "__main__":
+    main()

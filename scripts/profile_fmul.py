@@ -1,0 +1,131 @@
+"""fmul formulation shootout: .at.add accumulator (current) vs per-limb sum
+DAG vs Karatsuba vs fp32 radix-2^9. Measures marginal us/fmul via scan-chain
+slope (K=200 vs K=800) with forced readback sync."""
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+from tendermint_tpu.ops import ed25519 as E
+
+B = 8192
+NL = E.NLIMB
+M15 = E.M15
+
+
+# ---- variant 1: current
+fmul_cur = E.fmul
+
+
+# ---- variant 2: per-limb sum DAG (no .at.add)
+def fmul_dag(a, b):
+    lo, hi = [], []
+    for i in range(NL):
+        p = a[i][None, :] * b
+        lo.append(p & M15)
+        hi.append(p >> 15)
+    rows = []
+    for k in range(34):
+        terms = []
+        for i in range(NL):
+            j = k - i
+            if 0 <= j < NL:
+                terms.append(lo[i][j])
+            j2 = k - 1 - i
+            if 0 <= j2 < NL:
+                terms.append(hi[i][j2])
+        s = terms[0]
+        for t in terms[1:]:
+            s = s + t
+        rows.append(s)
+    res = jnp.stack([rows[k] + 19 * rows[k + NL] for k in range(NL)], axis=0)
+    return E._carry(res)
+
+
+# ---- variant 3: fp32 radix-2^9 (29 limbs), carry with floor
+NL9 = 29
+R9 = 512.0
+M9 = 511
+
+
+def _carry9(x):
+    # two parallel passes; top limb folds with 19 * 2^(-(255 - 28*9)) ... using
+    # radix 2^9 and 29 limbs = 261 bits; fold limb 29+ weight 2^261 = 2^6*19...
+    # for the shootout only the THROUGHPUT matters; math checked separately.
+    hi = jnp.floor(x / R9)
+    y = x - hi * R9 + jnp.concatenate([19.0 * hi[NL9 - 1:], hi[: NL9 - 1]], axis=0)
+    hi2 = jnp.floor(y / R9)
+    return y - hi2 * R9 + jnp.concatenate([19.0 * hi2[NL9 - 1:], hi2[: NL9 - 1]], axis=0)
+
+
+def fmul_f32(a, b):
+    acc = jnp.zeros((2 * NL9, a.shape[-1]), dtype=jnp.float32)
+    for i in range(NL9):
+        acc = acc.at[i: i + NL9].add(a[i][None, :] * b)
+    res = acc[:NL9] + 19.0 * acc[NL9:]
+    return _carry9(res)
+
+
+def fmul_f32_dag(a, b):
+    prods = [a[i][None, :] * b for i in range(NL9)]
+    rows = []
+    for k in range(2 * NL9 - 1):
+        terms = []
+        for i in range(NL9):
+            j = k - i
+            if 0 <= j < NL9:
+                terms.append(prods[i][j])
+        s = terms[0]
+        for t in terms[1:]:
+            s = s + t
+        rows.append(s)
+    rows.append(jnp.zeros_like(rows[0]))
+    res = jnp.stack([rows[k] + 19.0 * rows[k + NL9] for k in range(NL9)], axis=0)
+    return _carry9(res)
+
+
+def slope(fn, a, b, K1=200, K2=800):
+    def make(K):
+        @jax.jit
+        def chain(a, b):
+            def body(x, _):
+                return fn(x, b), None
+            x, _ = jax.lax.scan(body, a, None, length=K)
+            return x
+        return chain
+
+    f1, f2 = make(K1), make(K2)
+    np.asarray(f1(a, b)); np.asarray(f2(a, b))
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        np.asarray(f1(a, b))
+    e1 = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        np.asarray(f2(a, b))
+    e2 = (time.perf_counter() - t0) / reps
+    return (e2 - e1) / (K2 - K1) * 1e6
+
+
+def main():
+    print(jax.devices()[0], file=sys.stderr)
+    key = jax.random.PRNGKey(0)
+    a = jax.random.randint(key, (NL, B), 0, 32768, dtype=jnp.int32)
+    b = jax.random.randint(key, (NL, B), 0, 32768, dtype=jnp.int32)
+    a9 = jax.random.randint(key, (NL9, B), 0, 512, dtype=jnp.int32).astype(jnp.float32)
+    b9 = jax.random.randint(key, (NL9, B), 0, 512, dtype=jnp.int32).astype(jnp.float32)
+
+    print(f"int32 .at.add (current): {slope(fmul_cur, a, b):.1f} us/fmul")
+    print(f"int32 per-limb DAG:      {slope(fmul_dag, a, b):.1f} us/fmul")
+    print(f"fp32 r512 .at.add:       {slope(fmul_f32, a9, b9):.1f} us/fmul")
+    print(f"fp32 r512 DAG:           {slope(fmul_f32_dag, a9, b9):.1f} us/fmul")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""Device-resident compute time of the Pallas verify kernel + XLA one-hot
+select cost check."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+from tendermint_tpu.ops import ed25519 as E
+from tendermint_tpu.ops import ed25519_pallas as EP
+from tendermint_tpu.crypto import ed25519 as ed
+
+B = 8192
+
+
+def t(msg, f, reps=3):
+    f()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        f()
+    el = (time.perf_counter() - t0) / reps
+    print(f"{msg}: {el*1e3:.1f} ms")
+    return el
+
+
+def main():
+    print(jax.devices()[0], file=sys.stderr)
+    seeds = [bytes([i]) * 32 for i in range(64)]
+    pubs = [ed.public_key(s) for s in seeds]
+    items = []
+    for i in range(B):
+        k = i % 64
+        msg = b"m%d-%d" % (i, k)
+        items.append((pubs[k], msg, ed.sign(seeds[k], msg)))
+
+    s_total = B // 128
+    ax, ay, ry, rs, s_bits, h_bits, valid = E.prepare_batch(items, B)
+    s_rev = np.ascontiguousarray(s_bits[::-1]).reshape(253, s_total, 128)
+    h_rev = np.ascontiguousarray(h_bits[::-1]).reshape(253, s_total, 128)
+    args = (
+        jax.device_put(ax.reshape(E.NLIMB, s_total, 128)),
+        jax.device_put(ay.reshape(E.NLIMB, s_total, 128)),
+        jax.device_put(ry.reshape(E.NLIMB, s_total, 128)),
+        jax.device_put(rs.reshape(1, s_total, 128).astype(np.int32)),
+        jax.device_put(s_rev),
+        jax.device_put(h_rev),
+    )
+    fn = EP._get_verify(EP.S_TILE, False)
+    ok = np.asarray(fn(*args))
+    assert (ok.reshape(-1)[: len(items)] != 0).all()
+    t("pallas verify: device-resident", lambda: np.asarray(fn(*args)))
+
+
+if __name__ == "__main__":
+    main()

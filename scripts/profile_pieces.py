@@ -1,0 +1,86 @@
+"""Dev tool: time individual field/point ops of the jnp Ed25519 kernel to
+find where the 405ms/batch goes."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+from tendermint_tpu.ops import ed25519 as E
+
+B = 8192
+NL = E.NLIMB
+
+
+def bench(name, fn, *args, reps=20):
+    o = fn(*args)
+    jax.block_until_ready(o)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        o = fn(*args)
+    jax.block_until_ready(o)
+    el = (time.perf_counter() - t0) / reps
+    print(f"{name}: {el*1e3:.2f} ms")
+    return el
+
+
+def main():
+    print(jax.devices()[0], file=sys.stderr)
+    key = jax.random.PRNGKey(0)
+    a = jax.random.randint(key, (NL, B), 0, 32768, dtype=jnp.int32)
+    b = jax.random.randint(key, (NL, B), 0, 32768, dtype=jnp.int32)
+
+    K = 100
+
+    @jax.jit
+    def fmul_scan(a, b):
+        def body(x, _):
+            return E.fmul(x, b), None
+        x, _ = jax.lax.scan(body, a, None, length=K)
+        return x
+
+    @jax.jit
+    def carry_scan(a):
+        def body(x, _):
+            return E._carry(x + 7), None
+        x, _ = jax.lax.scan(body, a, None, length=K)
+        return x
+
+    pt = (a, b, a, b)
+
+    @jax.jit
+    def dbl_scan(pt):
+        def body(p, _):
+            return E.point_double(p), None
+        p, _ = jax.lax.scan(body, pt, None, length=K)
+        return p[0]
+
+    t = bench(f"fmul x{K} scan", fmul_scan, a, b)
+    print(f"  -> per fmul: {t/K*1e6:.0f} us ; ladder(3440 fmul) est {t/K*3440*1e3:.0f} ms")
+    t = bench(f"carry x{K} scan", carry_scan, a)
+    print(f"  -> per carry: {t/K*1e6:.0f} us")
+    t = bench(f"point_double x{K} scan", dbl_scan, pt)
+    print(f"  -> per dbl: {t/K*1e6:.0f} us ; 254 dbl est {t/K*254*1e3:.0f} ms")
+
+    # one-hot select cost (16-entry table)
+    tc = jax.random.randint(key, (16, NL, B), 0, 32768, dtype=jnp.int32)
+    sel = jax.random.randint(key, (B,), 0, 16, dtype=jnp.int32)
+    idx16 = jnp.arange(16, dtype=jnp.int32)
+
+    @jax.jit
+    def select_chain(tc, sel):
+        out = jnp.zeros((NL, B), jnp.int32)
+        for i in range(K // 4):
+            onehot = ((sel + i) % 16 == idx16[:, None]).astype(jnp.int32)
+            out = out + jnp.sum(onehot[:, None, :] * tc, axis=0)
+        return out
+
+    t = bench(f"one-hot 16-select x{K//4}", select_chain, tc, sel)
+    print(f"  -> per select(x4 coords): {t/(K//4)*4*1e6:.0f} us; 127 steps est {t/(K//4)*4*127*1e3:.0f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Sanity-check the fmul scan timing: scaling with K and output dependence."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+from tendermint_tpu.ops import ed25519 as E
+
+B = 8192
+NL = E.NLIMB
+
+
+def main():
+    print(jax.devices()[0], file=sys.stderr)
+    key = jax.random.PRNGKey(0)
+    a = jax.random.randint(key, (NL, B), 0, 32768, dtype=jnp.int32)
+    b = jax.random.randint(key, (NL, B), 0, 32768, dtype=jnp.int32)
+
+    def make(K):
+        @jax.jit
+        def fmul_scan(a, b):
+            def body(x, _):
+                return E.fmul(x, b), None
+            x, _ = jax.lax.scan(body, a, None, length=K)
+            return x
+        return fmul_scan
+
+    for K in (50, 200, 800):
+        fn = make(K)
+        np.asarray(fn(a, b))
+        t0 = time.perf_counter()
+        for _ in range(10):
+            o = fn(a, b)
+            np.asarray(o)  # force full sync via host readback
+        el = (time.perf_counter() - t0) / 10
+        print(f"K={K}: {el*1e3:.3f} ms total, {el/K*1e6:.2f} us/fmul")
+
+    # correctness: does one fmul match the CPU big-int multiply?
+    av = np.asarray(a[:, 0])
+    bv = np.asarray(b[:, 0])
+    ai = E.limbs_to_int(av)
+    bi = E.limbs_to_int(bv)
+    out = np.asarray(E.fmul(a, b))
+    got = E.limbs_to_int(E.fcanon(jnp.asarray(out))[:, 0]) if False else None
+    got_i = E.limbs_to_int(np.asarray(E.fcanon(E.fmul(a, b)))[:, 0])
+    print("fmul correct:", got_i == (ai * bi) % E.P)
+
+
+if __name__ == "__main__":
+    main()

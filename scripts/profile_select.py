@@ -1,0 +1,117 @@
+"""How much of the 163ms XLA verify is the 16-entry one-hot table select?
+Compare: real kernel vs fixed-addend kernel vs where-tree select variant."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+from tendermint_tpu.ops import ed25519 as E
+
+B = 8192
+NLIMB = E.NLIMB
+REPS = 6
+
+
+def sustained(fn, args):
+    np.asarray(fn(*args))
+    t0 = time.perf_counter()
+    outs = [fn(*args) for _ in range(REPS)]
+    [np.asarray(o) for o in outs]
+    return (time.perf_counter() - t0) / REPS
+
+
+def make_variant(select_mode: str):
+    def impl(ax, ay, r_y, r_sign, s_limbs, h_limbs):
+        batch = ax.shape[-1]
+        zeros = jnp.zeros((NLIMB, batch), dtype=jnp.int32)
+        one = zeros.at[0].set(1)
+
+        def const_pt(xc, yc):
+            x = jnp.broadcast_to(jnp.asarray(xc)[:, None], (NLIMB, batch))
+            y = jnp.broadcast_to(jnp.asarray(yc)[:, None], (NLIMB, batch))
+            return (x, y, one, E.fmul(x, y))
+
+        nax = E.fsub(zeros, ax)
+        neg_a = (nax, ay, one, E.fmul(nax, ay))
+        na2 = E.point_double(neg_a)
+        na3 = E.point_add(na2, neg_a)
+        ident = E._identity(batch)
+        b_row = [ident, const_pt(E._BX, E._BY), const_pt(E._B2X, E._B2Y), const_pt(E._B3X, E._B3Y)]
+        a_row = [ident, neg_a, na2, na3]
+        table = []
+        for j in range(4):
+            for i in range(4):
+                if i == 0:
+                    table.append(a_row[j])
+                elif j == 0:
+                    table.append(b_row[i])
+                else:
+                    table.append(E.point_add(b_row[i], a_row[j]))
+        tcoords = [jnp.stack([t[c] for t in table], axis=0) for c in range(4)]
+
+        xs = jnp.stack(
+            [E._digits2_from_limbs(s_limbs), E._digits2_from_limbs(h_limbs)], axis=1
+        )
+        idx16 = jnp.arange(16, dtype=jnp.int32)
+
+        def step(acc, dig):
+            acc = E.point_double(E.point_double(acc))
+            sel = dig[0] + 4 * dig[1]
+            if select_mode == "onehot":
+                onehot = (sel[None, :] == idx16[:, None]).astype(jnp.int32)
+                addend = tuple(jnp.sum(onehot[:, None, :] * tc, axis=0) for tc in tcoords)
+            elif select_mode == "fixed":
+                addend = tuple(tc[1] for tc in tcoords)
+            elif select_mode == "wheretree":
+                b0 = (sel & 1)[None, :].astype(bool)
+                b1 = (sel & 2)[None, :].astype(bool)
+                b2 = (sel & 4)[None, :].astype(bool)
+                b3 = (sel & 8)[None, :].astype(bool)
+                addend = []
+                for tc in tcoords:
+                    lvl = [jnp.where(b0, tc[2 * i + 1], tc[2 * i]) for i in range(8)]
+                    lvl = [jnp.where(b1, lvl[2 * i + 1], lvl[2 * i]) for i in range(4)]
+                    lvl = [jnp.where(b2, lvl[2 * i + 1], lvl[2 * i]) for i in range(2)]
+                    addend.append(jnp.where(b3, lvl[1], lvl[0]))
+                addend = tuple(addend)
+            return E.point_add(acc, addend), None
+
+        acc, _ = jax.lax.scan(step, ident, xs)
+        px, py, pz, _ = acc
+        zinv = E.finv(pz)
+        x_aff = E.fcanon(E.fmul(px, zinv))
+        y_aff = E.fcanon(E.fmul(py, zinv))
+        sign = x_aff[0] & 1
+        return jnp.all(y_aff == E.fcanon(r_y), axis=0) & (sign == r_sign)
+
+    return jax.jit(impl)
+
+
+def main():
+    from tendermint_tpu.crypto import ed25519 as ed
+
+    print(jax.devices()[0], file=sys.stderr)
+    seeds = [bytes([i]) * 32 for i in range(8)]
+    pubs = [ed.public_key(s) for s in seeds]
+    items = []
+    for i in range(B):
+        k = i % 8
+        m = b"m%d" % i
+        items.append((pubs[k], m, ed.sign(seeds[k], m)))
+    prep = E.prepare_batch_limbs(items, B)
+    args = tuple(jax.device_put(np.asarray(a)) for a in prep[:6])
+
+    for mode in ("onehot", "wheretree", "fixed"):
+        fn = make_variant(mode)
+        el = sustained(fn, args)
+        ok = np.asarray(fn(*args))
+        note = "" if mode == "fixed" else f" all-ok={bool(ok.all())}"
+        print(f"{mode}: {el*1e3:.1f} ms/batch{note}")
+
+
+if __name__ == "__main__":
+    main()

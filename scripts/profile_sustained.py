@@ -1,0 +1,64 @@
+"""Sustained back-to-back kernel throughput: enqueue N executions, sync once.
+This is what a pipelined verifier achieves when transfers/marshal overlap."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+from tendermint_tpu.ops import ed25519 as E
+from tendermint_tpu.ops import ed25519_pallas as EP
+from tendermint_tpu.crypto import ed25519 as ed
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+REPS = 10
+
+
+def main():
+    print(jax.devices()[0], file=sys.stderr)
+    seeds = [bytes([i]) * 32 for i in range(64)]
+    pubs = [ed.public_key(s) for s in seeds]
+    items = []
+    for i in range(B):
+        k = i % 64
+        msg = b"m%d-%d" % (i, k)
+        items.append((pubs[k], msg, ed.sign(seeds[k], msg)))
+
+    # ---- XLA kernel
+    prep = E.prepare_batch_limbs(items, B)
+    dev_args = tuple(jax.device_put(np.asarray(a)) for a in prep[:6])
+    ok = np.asarray(E._verify_jit(*dev_args))
+    assert ok[: len(items)].all()
+    t0 = time.perf_counter()
+    outs = [E._verify_jit(*dev_args) for _ in range(REPS)]
+    res = [np.asarray(o) for o in outs]
+    el = (time.perf_counter() - t0) / REPS
+    print(f"xla sustained: {el*1e3:.1f} ms/batch = {B/el:.0f} sigs/s")
+
+    # ---- Pallas kernel
+    s_total = B // 128
+    ax, ay, ry, rs, s_bits, h_bits, valid = E.prepare_batch(items, B)
+    s_rev = np.ascontiguousarray(s_bits[::-1]).reshape(253, s_total, 128)
+    h_rev = np.ascontiguousarray(h_bits[::-1]).reshape(253, s_total, 128)
+    args = (
+        jax.device_put(ax.reshape(E.NLIMB, s_total, 128)),
+        jax.device_put(ay.reshape(E.NLIMB, s_total, 128)),
+        jax.device_put(ry.reshape(E.NLIMB, s_total, 128)),
+        jax.device_put(rs.reshape(1, s_total, 128).astype(np.int32)),
+        jax.device_put(s_rev),
+        jax.device_put(h_rev),
+    )
+    fn = EP._get_verify(EP.S_TILE, False)
+    np.asarray(fn(*args))
+    t0 = time.perf_counter()
+    outs = [fn(*args) for _ in range(REPS)]
+    res = [np.asarray(o) for o in outs]
+    el = (time.perf_counter() - t0) / REPS
+    print(f"pallas sustained: {el*1e3:.1f} ms/batch = {B/el:.0f} sigs/s")
+
+
+if __name__ == "__main__":
+    main()

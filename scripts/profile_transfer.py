@@ -1,0 +1,70 @@
+"""Decompose verify-batch time: device-resident compute vs host transfer
+on this (tunneled) TPU."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+from tendermint_tpu.ops import ed25519 as E
+from tendermint_tpu.crypto import ed25519 as ed
+
+B = 8192
+
+
+def t(msg, f, reps=5):
+    f()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        f()
+    el = (time.perf_counter() - t0) / reps
+    print(f"{msg}: {el*1e3:.1f} ms")
+    return el
+
+
+def main():
+    print(jax.devices()[0], file=sys.stderr)
+
+    # roundtrip latency floor: tiny transfer both ways
+    one = np.zeros(8, np.int32)
+    t("tiny roundtrip (device_put + asarray)", lambda: np.asarray(jnp.asarray(one) + 1))
+
+    big = np.zeros((17, B), np.int32)
+    t("557KB host->device (device_put, sync'd by tiny readback)",
+      lambda: np.asarray(jax.device_put(big)[0, :8]))
+    dev = jax.device_put(big)
+    t("557KB device->host", lambda: np.asarray(dev))
+
+    # real verify batch, data pre-staged on device
+    seeds = [bytes([i]) * 32 for i in range(64)]
+    pubs = [ed.public_key(s) for s in seeds]
+    items = []
+    for i in range(B):
+        k = i % 64
+        msg = b"m%d-%d" % (i, k)
+        items.append((pubs[k], msg, ed.sign(seeds[k], msg)))
+
+    prep = E.prepare_batch_limbs(items, B)
+    host_args = prep[:6]
+    dev_args = tuple(jax.device_put(np.asarray(a)) for a in host_args)
+
+    # compile
+    np.asarray(E._verify_jit(*dev_args))
+
+    e_resident = t("verify: device-resident args + bool readback",
+                   lambda: np.asarray(E._verify_jit(*dev_args)), reps=3)
+    e_host = t("verify: host args (transfer included)",
+               lambda: np.asarray(E._verify_jit(*[jnp.asarray(a) for a in host_args])), reps=3)
+    print(f"-> transfer share: {(e_host-e_resident)*1e3:.0f} ms")
+
+    # marshaling cost on host
+    t0 = time.perf_counter()
+    E.prepare_batch_limbs(items, B)
+    print(f"host marshal (prepare_batch_limbs): {(time.perf_counter()-t0)*1e3:.0f} ms")
+
+
+if __name__ == "__main__":
+    main()

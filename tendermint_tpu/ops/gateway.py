@@ -55,14 +55,11 @@ class Verifier:
             return []
         if self._tpu_ok and n >= self.min_tpu_batch:
             try:
-                import jax
-
-                if jax.devices()[0].platform == "tpu":
-                    # hand-written Pallas ladder: VMEM-resident limbs
-                    from tendermint_tpu.ops import ed25519_pallas as ops_ed
-                else:
-                    # XLA-composed variant (CPU/GPU backends, tests)
-                    from tendermint_tpu.ops import ed25519 as ops_ed
+                # fp32 radix-2^8 conv kernel: the production path on every
+                # backend. Measured on a v5e at batch 8192: 94.4k sigs/s
+                # vs 50.0k (int32 radix-2^15 jnp) vs 32.6k (pallas ladder)
+                # vs 3.9k (CPU loop) — see ops/ed25519_f32.py docstring.
+                from tendermint_tpu.ops import ed25519_f32 as ops_ed
 
                 out = ops_ed.verify_batch(items)
                 with self._mtx:
@@ -75,6 +72,43 @@ class Verifier:
         with self._mtx:
             self._stats["cpu_sigs"] += n
         return _cpu_verify_batch(items)
+
+    def verify_batch_async(self, items: list[Item]):
+        """Pipelined form of verify_batch: marshals + enqueues the device
+        kernel now, returns a zero-arg resolver that blocks for results.
+        Host marshaling of the next batch can overlap device execution of
+        this one (jax async dispatch). Falls back to an already-resolved
+        CPU result below the batch threshold or after a TPU failure."""
+        n = len(items)
+        if n == 0:
+            return lambda: []
+        if self._tpu_ok and n >= self.min_tpu_batch:
+            try:
+                import jax.numpy as jnp
+
+                from tendermint_tpu.ops import ed25519_f32 as ops_ed
+
+                bucket = ops_ed._next_pow2(n)
+                ax, ay, ry, rs, s8, h8, valid = ops_ed.prepare_batch8(items, bucket)
+                ok_dev = ops_ed._verify_jit(
+                    jnp.asarray(ax), jnp.asarray(ay), jnp.asarray(ry),
+                    jnp.asarray(rs), jnp.asarray(s8), jnp.asarray(h8),
+                )
+                with self._mtx:
+                    self._stats["tpu_batches"] += 1
+                    self._stats["tpu_sigs"] += n
+
+                def resolve():
+                    return [bool(b) for b in (np.asarray(ok_dev)[:n] & valid[:n])]
+
+                return resolve
+            except Exception:
+                logger.exception("TPU verify failed; falling back to CPU")
+                self._tpu_ok = False
+        with self._mtx:
+            self._stats["cpu_sigs"] += n
+        res = _cpu_verify_batch(items)
+        return lambda: res
 
     def verify_one(self, pubkey: bytes, msg: bytes, sig: bytes) -> bool:
         """Single-signature path (vote-by-vote arrival): CPU — latency over
@@ -109,7 +143,7 @@ class ShardedVerifier(Verifier):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as PS
 
-        from tendermint_tpu.ops import ed25519 as ops_ed
+        from tendermint_tpu.ops import ed25519_f32 as ops_ed
 
         self.mesh = mesh
         self._n_dev = mesh.size
@@ -130,7 +164,7 @@ class ShardedVerifier(Verifier):
         try:
             import jax.numpy as jnp
 
-            from tendermint_tpu.ops import ed25519 as ops_ed
+            from tendermint_tpu.ops import ed25519_f32 as ops_ed
 
             # bucket so every device gets an equal, stable-shaped slice:
             # power-of-two rounded up to a multiple of the mesh size
@@ -138,10 +172,10 @@ class ShardedVerifier(Verifier):
             bucket = ops_ed._next_pow2(max(n, m))
             if bucket % m:
                 bucket = ((bucket + m - 1) // m) * m
-            ax, ay, ry, rs, s_l, h_l, valid = ops_ed.prepare_batch_limbs(items, bucket)
+            ax, ay, ry, rs, s8, h8, valid = ops_ed.prepare_batch8(items, bucket)
             ok = self._verify(
                 jnp.asarray(ax), jnp.asarray(ay), jnp.asarray(ry),
-                jnp.asarray(rs), jnp.asarray(s_l), jnp.asarray(h_l),
+                jnp.asarray(rs), jnp.asarray(s8), jnp.asarray(h8),
             )
             with self._mtx:
                 self._stats["tpu_batches"] += 1
