@@ -7,11 +7,20 @@ selects the fp32 radix-2^8 conv kernel in ops/ed25519_f32.py), against
 our own CPU reference loop (the Go-equivalent baseline; upstream
 publishes no numbers, BASELINE.md).
 
-The accelerator measurement is SUSTAINED pipelined throughput: prep
-threads marshal upcoming batches while the device runs the current
-kernel (jax async dispatch), exactly how a fast-syncing node streams
-commits through the verifier. Results are resolved (and parity-checked
-against the CPU verifier on a sample) at the end.
+The accelerator measurement is SUSTAINED pipelined throughput, shaped
+like a fast-syncing node streaming commits through the verifier:
+- prep threads marshal batches and enqueue the device kernel
+  (gateway.verify_batch_async — host marshal overlaps device execution);
+- resolver threads block on results CONCURRENTLY, which matters when the
+  chip sits behind a network tunnel: each result fetch pays the tunnel
+  round trip, so overlapping fetches is the difference between the
+  kernel's rate and half of it.
+Results are order-preserved and parity-checked against the CPU verifier
+on a mixed valid/tampered sample.
+
+CPU baseline methodology (pinned; round-2 review flagged run-to-run
+wobble): fixed 512-signature sample, best-of-3 passes (max rate =
+min time), same process, measured before any device work starts.
 
 Prints ONE JSON line:
   {"metric": "verify_commit_sigs_per_sec", "value": N, "unit": "sigs/s",
@@ -29,10 +38,12 @@ from tendermint_tpu.jitcache import enable as _enable_jit_cache
 
 _enable_jit_cache()
 
-BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
-N_BATCHES = int(os.environ.get("BENCH_N_BATCHES", "8"))
+BATCH = int(os.environ.get("BENCH_BATCH", "4096"))
+N_BATCHES = int(os.environ.get("BENCH_N_BATCHES", "32"))
 CPU_SAMPLE = int(os.environ.get("BENCH_CPU_SAMPLE", "512"))
+CPU_PASSES = int(os.environ.get("BENCH_CPU_PASSES", "3"))
 PREP_THREADS = int(os.environ.get("BENCH_PREP_THREADS", "2"))
+RESOLVE_THREADS = int(os.environ.get("BENCH_RESOLVE_THREADS", "4"))
 
 
 def _make_items(n: int, salt: int = 0):
@@ -67,51 +78,65 @@ def main() -> None:
     verifier = Verifier(min_tpu_batch=1)
 
     # --- CPU baseline: the reference-faithful sequential loop ------------
-    t0 = time.perf_counter()
-    for pub, msg, sig in chunks[0][:CPU_SAMPLE]:
-        assert ed_cpu.verify(pub, msg, sig)
-    cpu_rate = CPU_SAMPLE / (time.perf_counter() - t0)
+    # (best-of-k over a fixed sample pins the methodology across rounds)
+    cpu_rate = 0.0
+    for _ in range(CPU_PASSES):
+        t0 = time.perf_counter()
+        for pub, msg, sig in chunks[0][:CPU_SAMPLE]:
+            assert ed_cpu.verify(pub, msg, sig)
+        cpu_rate = max(cpu_rate, CPU_SAMPLE / (time.perf_counter() - t0))
 
     # warmup (compile) through the production path
     ok = verifier.verify_batch(chunks[0])
     assert all(ok), "warmup verify failed"
 
     # --- sustained pipelined throughput ---------------------------------
-    # prep threads run verify_batch_async (host marshal + async device
-    # dispatch); the main thread collects resolvers in order and blocks
-    # only at the end. In-flight window is bounded by the queue.
-    fed: _q.Queue = _q.Queue(maxsize=PREP_THREADS + 1)
-    idx = {"next": 0}
+    results: list = [None] * N_BATCHES
+    next_idx = {"v": 0}
     idx_mtx = _t.Lock()
+    dispatched: _q.Queue = _q.Queue(maxsize=PREP_THREADS + RESOLVE_THREADS)
 
     def prep_worker():
         while True:
             with idx_mtx:
-                i = idx["next"]
-                if i >= len(chunks):
+                i = next_idx["v"]
+                if i >= N_BATCHES:
                     return
-                idx["next"] = i + 1
-            fed.put((i, verifier.verify_batch_async(chunks[i])))
+                next_idx["v"] = i + 1
+            dispatched.put((i, verifier.verify_batch_async(chunks[i])))
+
+    def resolve_worker():
+        while True:
+            item = dispatched.get()
+            if item is None:
+                return
+            i, resolve = item
+            results[i] = resolve()
 
     t0 = time.perf_counter()
-    threads = [
-        _t.Thread(target=prep_worker, daemon=True) for _ in range(PREP_THREADS)
+    preps = [_t.Thread(target=prep_worker, daemon=True) for _ in range(PREP_THREADS)]
+    resolvers = [
+        _t.Thread(target=resolve_worker, daemon=True) for _ in range(RESOLVE_THREADS)
     ]
-    for th in threads:
+    for th in preps + resolvers:
         th.start()
-    resolvers = [None] * len(chunks)
-    for _ in range(len(chunks)):
-        i, resolve = fed.get()
-        resolvers[i] = resolve
-    results = [r() for r in resolvers]
+    for th in preps:
+        th.join()
+    for _ in resolvers:
+        dispatched.put(None)
+    for th in resolvers:
+        th.join()
     elapsed = time.perf_counter() - t0
-    assert all(all(r) for r in results), "verify failed in sustained run"
+    assert all(r is not None and all(r) for r in results), "sustained verify failed"
     total = BATCH * N_BATCHES
     rate = total / elapsed
 
     # --- parity check: TPU verdicts == CPU verdicts on a mixed sample ----
     sample = chunks[0][:64]
-    tampered = [(p, m, sig[:10] + bytes([sig[10] ^ 1]) + sig[11:]) for p, m, sig in chunks[1][:64]]
+    tampered = [
+        (p, m, sig[:10] + bytes([sig[10] ^ 1]) + sig[11:])
+        for p, m, sig in chunks[1][:64]
+    ]
     mixed = sample + tampered
     tpu_verdicts = verifier.verify_batch(mixed)
     cpu_verdicts = [ed_cpu.verify(p, m, s) for p, m, s in mixed]
@@ -130,6 +155,7 @@ def main() -> None:
                     "n_batches": N_BATCHES,
                     "elapsed_s": round(elapsed, 3),
                     "cpu_sigs_per_sec": round(cpu_rate, 1),
+                    "cpu_methodology": f"best-of-{CPU_PASSES} over {CPU_SAMPLE} fixed sigs",
                     "platform": jax.devices()[0].platform,
                     "gateway_stats": stats,
                     "parity": "ok",

@@ -114,66 +114,26 @@ void tm_ed25519_verify_batch(const uint8_t* pubs, const uint8_t* sigs,
         sigs + 64 * i);
 }
 
-// TPU-prep marshal: for each item emit canonical little-endian 32-byte
-// field elements (ax, ay, ry) + r_sign + (s, h) scalars mod L + valid.
-// The Python side converts the 32-byte LE values to kernel limb layout
-// with its vectorized converter. Invalid rows get neutral values.
-void tm_ed25519_prepare(const uint8_t* pubs, const uint8_t* sigs,
-                        const uint8_t* msgs, const uint64_t* offsets,
-                        int64_t n, uint8_t* ax /* n*32 */,
-                        uint8_t* ay /* n*32 */, uint8_t* ry /* n*32 */,
-                        int32_t* r_sign, uint8_t* s_out /* n*32 */,
-                        uint8_t* h_out /* n*32 */, uint8_t* valid) {
-  static const uint8_t PB[32] = {
-      0xed, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
-      0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
-      0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x7f};
-  static const uint8_t LB[32] = {0xed, 0xd3, 0xf5, 0x5c, 0x1a, 0x63, 0x12,
-                                 0x58, 0xd6, 0x9c, 0xf7, 0xa2, 0xde, 0xf9,
-                                 0xde, 0x14, 0,    0,    0,    0,    0,
-                                 0,    0,    0,    0,    0,    0,    0,
-                                 0,    0,    0,    0x10};
-  for (int64_t i = 0; i < n; i++) {
-    valid[i] = 0;
-    r_sign[i] = 0;
-    std::memset(ax + 32 * i, 0, 32);
-    std::memset(ay + 32 * i, 0, 32);
-    ay[32 * i] = 1;
-    std::memset(ry + 32 * i, 0, 32);
-    ry[32 * i] = 1;
-    std::memset(s_out + 32 * i, 0, 32);
-    std::memset(h_out + 32 * i, 0, 32);
+// batch h = SHA512(R || A || M) mod L for the TPU-kernel marshal
+// (the per-item host cost the Python loop can't vectorize; one FFI call
+// per batch, no per-item overhead). sigs n*64 (R = first 32 bytes),
+// pubs n*32, msgs concatenated + offsets. h_out n*32 little-endian.
+void tm_ed25519_hram_batch(const uint8_t* sigs, const uint8_t* pubs,
+                           const uint8_t* msgs, const uint64_t* offsets,
+                           int64_t n, uint8_t* h_out) {
+  for (int64_t i = 0; i < n; i++)
+    ed25519_hram(sigs + 64 * i, pubs + 32 * i, msgs + offsets[i],
+                 offsets[i + 1] - offsets[i], h_out + 32 * i);
+}
 
-    const uint8_t* pub = pubs + 32 * i;
-    const uint8_t* sig = sigs + 64 * i;
-    // s < L
-    int s_ge = 1;
-    for (int k = 31; k >= 0; k--) {
-      if (sig[32 + k] < LB[k]) { s_ge = 0; break; }
-      if (sig[32 + k] > LB[k]) { s_ge = 1; break; }
-    }
-    if (s_ge) continue;
-    // R.y canonical
-    uint8_t rm[32];
-    std::memcpy(rm, sig, 32);
-    int rs = rm[31] >> 7;
-    rm[31] &= 0x7f;
-    int r_ge = 1;
-    for (int k = 31; k >= 0; k--) {
-      if (rm[k] < PB[k]) { r_ge = 0; break; }
-      if (rm[k] > PB[k]) { r_ge = 1; break; }
-    }
-    if (r_ge) continue;
-    // decompress A
-    if (!ed25519_decompress(pub, ax + 32 * i, ay + 32 * i)) continue;
-    // h = SHA512(R || A || M) mod L
-    ed25519_hram(sig, pub, msgs + offsets[i], offsets[i + 1] - offsets[i],
-                 h_out + 32 * i);
-    std::memcpy(ry + 32 * i, rm, 32);
-    std::memcpy(s_out + 32 * i, sig + 32, 32);
-    r_sign[i] = rs;
-    valid[i] = 1;
-  }
+// batch pubkey decompress (for UNIQUE keys; callers dedupe + cache):
+// xy_out[i] = x||y as 2*32 little-endian bytes, ok[i] = 1 on success.
+void tm_ed25519_decompress_batch(const uint8_t* pubs, int64_t n,
+                                 uint8_t* xy_out /* n*64 */,
+                                 uint8_t* ok) {
+  for (int64_t i = 0; i < n; i++)
+    ok[i] = (uint8_t)ed25519_decompress(pubs + 32 * i, xy_out + 64 * i,
+                                        xy_out + 64 * i + 32);
 }
 
 }  // extern "C"

@@ -197,13 +197,6 @@ int fe_is_zero(const fe a) {
   return acc == 0;
 }
 
-int fe_eq(const fe a, const fe b) {
-  uint8_t sa[32], sb[32];
-  fe_to_bytes(sa, a);
-  fe_to_bytes(sb, b);
-  return std::memcmp(sa, sb, 32) == 0;
-}
-
 int fe_parity(const fe a) {
   uint8_t s[32];
   fe_to_bytes(s, a);
@@ -365,46 +358,106 @@ int bytes_ge(const uint8_t* a, const uint8_t* b, int n) {
   return 1;  // equal
 }
 
-// r = x mod L for a 64-byte little-endian x; bitwise binary reduction.
-void sc_reduce64(uint8_t r[32], const uint8_t x[64]) {
-  // acc as 5x64-bit little-endian (L < 2^253 so 4 words + carry room)
-  uint64_t acc[5] = {0, 0, 0, 0, 0};
-  uint64_t l[4];
-  for (int i = 0; i < 4; i++) {
-    uint64_t v = 0;
-    for (int j = 7; j >= 0; j--) v = (v << 8) | LBYTES[8 * i + j];
-    l[i] = v;
+// r = x mod L for a 64-byte little-endian x.
+//
+// Table-based digit sum + one fold: x = sum_i d_i * 2^(16 i) with 16-bit
+// digits, so x === sum_i d_i * (2^(16 i) mod L). The column sums fit
+// easily in 128 bits (32 digits * 2^16 * 2^64 = 2^85) and normalize to a
+// value v < 32 * 65535 * L < 2^273. Then one fold at the 2^252 boundary:
+// with L = 2^252 + delta (delta < 2^125), v === lo - hi*delta (mod L)
+// where hi = v >> 252 < 2^21, so hi*delta < 2^146 (3 limbs, and
+// < L so one conditional add of L after an underflowing subtraction
+// restores [0, L)). This is ~500 simple
+// word ops vs the 512-iteration bitwise loop it replaces (which
+// dominated the batch-marshal profile at ~3us per digest).
+namespace {
+
+struct ScTables {
+  uint64_t r16[32][4];  // 2^(16 i) mod L, 4 LE 64-bit limbs
+  uint64_t l[4];        // L
+  uint64_t delta[2];    // L - 2^252 (< 2^125)
+  ScTables() {
+    for (int i = 0; i < 4; i++) {
+      uint64_t v = 0;
+      for (int j = 7; j >= 0; j--) v = (v << 8) | LBYTES[8 * i + j];
+      l[i] = v;
+    }
+    delta[0] = l[0];
+    delta[1] = l[1];
+    // clear the 2^252 bit (L's only set bit at/above limb 2 is bit 252)
+    uint64_t t[4] = {1, 0, 0, 0};  // current = 2^(16 i) mod L, start i=0
+    for (int i = 0; i < 32; i++) {
+      for (int j = 0; j < 4; j++) r16[i][j] = t[j];
+      for (int b = 0; b < 16; b++) {
+        // t = (2 t) mod L; t < L < 2^253 so 2t fits in 4 limbs
+        uint64_t carry = 0;
+        for (int j = 0; j < 4; j++) {
+          uint64_t nv = (t[j] << 1) | carry;
+          carry = t[j] >> 63;
+          t[j] = nv;
+        }
+        int ge = 1;
+        for (int j = 3; j >= 0; j--) {
+          if (t[j] > l[j]) { ge = 1; break; }
+          if (t[j] < l[j]) { ge = 0; break; }
+        }
+        if (ge) {
+          unsigned __int128 borrow = 0;
+          for (int j = 0; j < 4; j++) {
+            unsigned __int128 d =
+                (unsigned __int128)t[j] - l[j] - (uint64_t)borrow;
+            t[j] = (uint64_t)d;
+            borrow = (d >> 64) & 1;
+          }
+        }
+      }
+    }
   }
-  for (int bit = 511; bit >= 0; bit--) {
-    // acc = acc * 2 + bit
-    uint64_t carry = 0;
-    for (int i = 0; i < 5; i++) {
-      uint64_t nv = (acc[i] << 1) | carry;
-      carry = acc[i] >> 63;
-      acc[i] = nv;
-    }
-    acc[0] |= (x[bit / 8] >> (bit % 8)) & 1;
-    // if acc >= L: acc -= L  (acc < 2L here, top word acc[4] is 0/1)
-    int ge_l = acc[4] != 0;
-    if (!ge_l) {
-      ge_l = 1;
-      for (int i = 3; i >= 0; i--) {
-        if (acc[i] > l[i]) { ge_l = 1; break; }
-        if (acc[i] < l[i]) { ge_l = 0; break; }
-      }
-    }
-    if (ge_l) {
-      unsigned __int128 borrow = 0;
-      for (int i = 0; i < 4; i++) {
-        unsigned __int128 d = (unsigned __int128)acc[i] - l[i] - (uint64_t)borrow;
-        acc[i] = (uint64_t)d;
-        borrow = (d >> 64) & 1;
-      }
-      acc[4] -= (uint64_t)borrow;
+};
+
+}  // namespace
+
+void sc_reduce64(uint8_t r[32], const uint8_t x[64]) {
+  static const ScTables T;  // thread-safe magic-static init
+  unsigned __int128 col[4] = {0, 0, 0, 0};
+  for (int i = 0; i < 32; i++) {
+    uint64_t d = (uint64_t)x[2 * i] | ((uint64_t)x[2 * i + 1] << 8);
+    if (!d) continue;
+    for (int j = 0; j < 4; j++)
+      col[j] += (unsigned __int128)d * T.r16[i][j];
+  }
+  uint64_t v[5];
+  unsigned __int128 carry = 0;
+  for (int j = 0; j < 4; j++) {
+    carry += col[j];
+    v[j] = (uint64_t)carry;
+    carry >>= 64;
+  }
+  v[4] = (uint64_t)carry;
+  // fold at 2^252 (252 = 3*64 + 60)
+  uint64_t hi = (v[3] >> 60) | (v[4] << 4);
+  uint64_t lo[4] = {v[0], v[1], v[2], v[3] & ((1ULL << 60) - 1)};
+  unsigned __int128 m0 = (unsigned __int128)hi * T.delta[0];
+  unsigned __int128 m1 = (unsigned __int128)hi * T.delta[1];
+  unsigned __int128 mid = (m0 >> 64) + (uint64_t)m1;
+  uint64_t s[4] = {(uint64_t)m0, (uint64_t)mid,
+                   (uint64_t)((mid >> 64) + (uint64_t)(m1 >> 64)), 0};
+  unsigned __int128 borrow = 0;
+  for (int j = 0; j < 4; j++) {
+    unsigned __int128 d = (unsigned __int128)lo[j] - s[j] - (uint64_t)borrow;
+    lo[j] = (uint64_t)d;
+    borrow = (d >> 64) & 1;
+  }
+  if (borrow) {  // negative: one add of L restores [0, L)
+    unsigned __int128 c2 = 0;
+    for (int j = 0; j < 4; j++) {
+      c2 += (unsigned __int128)lo[j] + T.l[j];
+      lo[j] = (uint64_t)c2;
+      c2 >>= 64;
     }
   }
   for (int i = 0; i < 4; i++)
-    for (int j = 0; j < 8; j++) r[8 * i + j] = uint8_t(acc[i] >> (8 * j));
+    for (int j = 0; j < 8; j++) r[8 * i + j] = uint8_t(lo[i] >> (8 * j));
 }
 
 // ---------------------------------------------------------------------------

@@ -180,8 +180,12 @@ void sha512_final(Sha512Ctx* c, uint8_t out[64]) {
   uint64_t bits = c->total * 8;
   uint8_t pad = 0x80;
   sha512_update(c, &pad, 1);
-  uint8_t zero = 0;
-  while (c->buflen != 112) sha512_update(c, &zero, 1);
+  if (c->buflen > 112) {
+    std::memset(c->buf + c->buflen, 0, 128 - c->buflen);
+    sha512_block(c->h, c->buf);
+    c->buflen = 0;
+  }
+  std::memset(c->buf + c->buflen, 0, 112 - c->buflen);
   uint8_t lenbuf[16] = {0};
   for (int i = 0; i < 8; i++) lenbuf[15 - i] = uint8_t(bits >> (8 * i));
   // total was already advanced by padding updates; write length directly

@@ -75,16 +75,14 @@ def get_lib():
             return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
         u64p = ctypes.POINTER(ctypes.c_uint64)
-        i32p = ctypes.POINTER(ctypes.c_int32)
         i64 = ctypes.c_int64
         lib.tm_sha256_batch.argtypes = [u8p, u64p, i64, u8p]
         lib.tm_ripemd160_batch.argtypes = [u8p, u64p, i64, u8p]
         lib.tm_merkle_leaf_hashes.argtypes = [u8p, u64p, i64, u8p]
         lib.tm_merkle_root.argtypes = [u8p, i64, u8p]
         lib.tm_ed25519_verify_batch.argtypes = [u8p, u8p, u8p, u64p, i64, u8p]
-        lib.tm_ed25519_prepare.argtypes = [
-            u8p, u8p, u8p, u64p, i64, u8p, u8p, u8p, i32p, u8p, u8p, u8p,
-        ]
+        lib.tm_ed25519_hram_batch.argtypes = [u8p, u8p, u8p, u64p, i64, u8p]
+        lib.tm_ed25519_decompress_batch.argtypes = [u8p, i64, u8p, u8p]
         _lib = lib
         return _lib
 
@@ -182,42 +180,27 @@ def ed25519_verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> list[bool]:
     return [bool(o and s) for o, s in zip(out, ok_shape)]
 
 
-def ed25519_prepare(items: list[tuple[bytes, bytes, bytes]], bucket: int):
-    """Native TPU-kernel marshal: returns (ax, ay, ry, r_sign, s, h, valid)
-    where field/scalar columns are (bucket, 32) uint8 little-endian."""
+def ed25519_hram_batch(
+    sigs: np.ndarray, pubs: np.ndarray, msgs_data: np.ndarray,
+    offsets: np.ndarray, n: int,
+) -> np.ndarray:
+    """h = SHA512(R || A || M) mod L per row -> (n, 32) uint8 LE.
+    sigs: (n*64,) u8 contiguous; pubs: (n*32,) u8; msgs concatenated."""
     lib = get_lib()
-    n = len(items)
-    pubs = np.zeros(bucket * 32, dtype=np.uint8)
-    sigs = np.zeros(bucket * 64, dtype=np.uint8)
-    msgs = []
-    shape_ok = np.ones(bucket, dtype=np.uint8)
-    for i in range(bucket):
-        if i >= n:
-            msgs.append(b"")
-            shape_ok[i] = 0
-            continue
-        pub, msg, sig = items[i]
-        if len(pub) != 32 or len(sig) != 64:
-            msgs.append(b"")
-            shape_ok[i] = 0
-            continue
-        pubs[32 * i : 32 * i + 32] = np.frombuffer(pub, dtype=np.uint8)
-        sigs[64 * i : 64 * i + 64] = np.frombuffer(sig, dtype=np.uint8)
-        msgs.append(bytes(msg))
-    data, offsets = _concat(msgs)
-    ax = np.zeros((bucket, 32), dtype=np.uint8)
-    ay = np.zeros((bucket, 32), dtype=np.uint8)
-    ry = np.zeros((bucket, 32), dtype=np.uint8)
-    s = np.zeros((bucket, 32), dtype=np.uint8)
-    h = np.zeros((bucket, 32), dtype=np.uint8)
-    rs = np.zeros(bucket, dtype=np.int32)
-    valid = np.zeros(bucket, dtype=np.uint8)
-    lib.tm_ed25519_prepare(
-        _as_u8p(pubs), _as_u8p(sigs), _as_u8p(data),
-        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), bucket,
-        _as_u8p(ax), _as_u8p(ay), _as_u8p(ry),
-        rs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        _as_u8p(s), _as_u8p(h), _as_u8p(valid),
+    out = np.zeros(n * 32, dtype=np.uint8)
+    lib.tm_ed25519_hram_batch(
+        _as_u8p(sigs), _as_u8p(pubs), _as_u8p(msgs_data),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n, _as_u8p(out),
     )
-    valid = (valid & shape_ok).astype(bool)
-    return ax, ay, ry, rs, s, h, valid
+    return out.reshape(n, 32)
+
+
+def ed25519_decompress_batch(pubs: np.ndarray, n: int):
+    """(n*32,) u8 compressed keys -> ((n, 64) u8 x||y LE, (n,) bool ok)."""
+    lib = get_lib()
+    xy = np.zeros(n * 64, dtype=np.uint8)
+    ok = np.zeros(n, dtype=np.uint8)
+    lib.tm_ed25519_decompress_batch(_as_u8p(pubs), n, _as_u8p(xy), _as_u8p(ok))
+    return xy.reshape(n, 64), ok.astype(bool)
+
+

@@ -99,7 +99,23 @@ class Verifier:
                     self._stats["tpu_sigs"] += n
 
                 def resolve():
-                    return [bool(b) for b in (np.asarray(ok_dev)[:n] & valid[:n])]
+                    # async dispatch surfaces device-side failures only at
+                    # materialization: keep the sync path's CPU-fallback
+                    # guarantee here too.
+                    try:
+                        return [
+                            bool(b) for b in (np.asarray(ok_dev)[:n] & valid[:n])
+                        ]
+                    except Exception:
+                        logger.exception(
+                            "TPU verify failed at resolve; falling back to CPU"
+                        )
+                        self._tpu_ok = False
+                        with self._mtx:
+                            self._stats["tpu_batches"] -= 1
+                            self._stats["tpu_sigs"] -= n
+                            self._stats["cpu_sigs"] += n
+                        return _cpu_verify_batch(items)
 
                 return resolve
             except Exception:
