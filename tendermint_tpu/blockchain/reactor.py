@@ -38,6 +38,8 @@ class BlockchainReactor(Reactor, BaseService):
         fast_sync: bool,
         event_cache=None,
         batch_verifier=None,
+        async_batch_verifier=None,
+        part_hasher=None,
         status_update_interval: float = STATUS_UPDATE_INTERVAL,
     ):
         BaseService.__init__(self, name="blockchain.reactor")
@@ -53,6 +55,11 @@ class BlockchainReactor(Reactor, BaseService):
         self.fast_sync = fast_sync
         self.event_cache = event_cache
         self.batch_verifier = batch_verifier
+        self.async_batch_verifier = async_batch_verifier
+        self.part_hasher = part_hasher
+        # single-slot lookahead: (block_hash, PartSet) built while the
+        # previous block's signature batch ran on the device
+        self._parts_ahead: tuple[bytes, object] | None = None
         self.pool = BlockPool(
             store.height() + 1,
             request_fn=self._send_block_request,
@@ -193,27 +200,53 @@ class BlockchainReactor(Reactor, BaseService):
             if not synced_any:
                 time.sleep(TRY_SYNC_INTERVAL)
 
+    def _make_parts(self, block):
+        """Part set via the TPU hashing gateway (reactor.go:229 rebuilds
+        and re-hashes every synced block — the fast-sync hash hot path)."""
+        return block.make_part_set(
+            self.state.params().block_gossip.block_part_size_bytes,
+            hasher=self.part_hasher,
+        )
+
     def _try_sync(self) -> bool:
-        """Verify+apply one block; True if a block was consumed."""
+        """Verify+apply one block; True if a block was consumed.
+
+        Pipelined when an async verifier is wired: block N's signature
+        batch runs on the device while the host hashes block N+1's part
+        set (which the next call consumes from the lookahead slot)."""
         first, second = self.pool.peek_two_blocks()
         if first is None or second is None:
             return False
         # rebuild the part set: the header's PartsHeader committed to it
-        # (reactor.go:229) — TPU-hashed via the gateway when available
-        first_parts = first.make_part_set(
-            self.state.params().block_gossip.block_part_size_bytes
-        )
+        if self._parts_ahead is not None and self._parts_ahead[0] == first.hash():
+            first_parts = self._parts_ahead[1]
+        else:
+            first_parts = self._make_parts(first)
+        self._parts_ahead = None
         first_id = BlockID(first.hash(), first_parts.header())
         try:
-            self.state.validators.verify_commit(
-                self.state.chain_id,
-                first_id,
-                first.header.height,
-                second.last_commit,
-                batch_verifier=self.batch_verifier,
-            )
+            if self.async_batch_verifier is not None:
+                finish = self.state.validators.verify_commit_async(
+                    self.state.chain_id,
+                    first_id,
+                    first.header.height,
+                    second.last_commit,
+                    self.async_batch_verifier,
+                )
+                # overlap device execution with the next block's hashing
+                self._parts_ahead = (second.hash(), self._make_parts(second))
+                finish()
+            else:
+                self.state.validators.verify_commit(
+                    self.state.chain_id,
+                    first_id,
+                    first.header.height,
+                    second.last_commit,
+                    batch_verifier=self.batch_verifier,
+                )
         except Exception as exc:  # noqa: BLE001 — bad block/commit
             self.logger.info("invalid block %d during fast sync: %s", first.header.height, exc)
+            self._parts_ahead = None
             bad = self.pool.redo_request(first.header.height)
             # second's commit could also be forged; refetch it too
             self.pool.redo_request(second.header.height)

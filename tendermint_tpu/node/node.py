@@ -25,6 +25,7 @@ from tendermint_tpu.libs.service import BaseService
 from tendermint_tpu.mempool import Mempool
 from tendermint_tpu.mempool.reactor import MempoolReactor
 from tendermint_tpu.ops import gateway
+from tendermint_tpu.types import tx as tx_types
 from tendermint_tpu.p2p import NodeInfo, PeerConfig, Switch
 from tendermint_tpu.p2p.addrbook import AddrBook
 from tendermint_tpu.p2p.conn import MConnConfig
@@ -72,8 +73,13 @@ class Node(BaseService):
         self.genesis_doc = genesis_doc
         self.priv_validator = priv_validator
 
-        # -- TPU crypto gateway: one batching point for every verify site
+        # -- TPU crypto gateway: one batching point for every verify site,
+        # one hashing gateway for the part/tx Merkle hot paths. The tx-tree
+        # hook routes every Data.hash (block build + validate) through the
+        # batched kernel (ref types/tx.go:33-46).
         self.verifier = gateway.default_verifier()
+        self.hasher = gateway.default_hasher()
+        tx_types.set_batch_tx_root(self.hasher.tx_merkle_root)
 
         # -- tx index (node.go:164-176) -----------------------------------
         if config.base.tx_index == "kv":
@@ -131,6 +137,8 @@ class Node(BaseService):
             fast_sync,
             event_cache=None,
             batch_verifier=self.verifier.commit_batch_verifier(),
+            async_batch_verifier=self.verifier.verify_batch_async,
+            part_hasher=self.hasher.part_leaf_hashes,
         )
 
         # -- p2p switch (node.go:231-245) ---------------------------------

@@ -570,16 +570,17 @@ def _next_pow2(n: int) -> int:
     return b
 
 
-def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
-    """Batched strict-RFC8032 verify -> bool[B]; semantics identical to
-    crypto.ed25519.verify per item. Padded to power-of-two buckets so jit
-    recompilation is bounded."""
+def verify_batch_async(items: list[tuple[bytes, bytes, bytes]]):
+    """Marshal + enqueue the device kernel now; return a zero-arg resolver
+    that materializes bool[B]. The single definition of the marshal/
+    dispatch/mask sequence — verify_batch is this plus an immediate
+    resolve, so the sync and async paths cannot drift."""
     n = len(items)
     if n == 0:
-        return np.zeros(0, dtype=bool)
+        return lambda: np.zeros(0, dtype=bool)
     bucket = _next_pow2(n)
     ax, ay, ry, rs, s8, h8, valid = prepare_batch8(items, bucket)
-    ok = _verify_jit(
+    ok_dev = _verify_jit(
         jnp.asarray(ax),
         jnp.asarray(ay),
         jnp.asarray(ry),
@@ -587,4 +588,11 @@ def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
         jnp.asarray(s8),
         jnp.asarray(h8),
     )
-    return np.asarray(ok)[:n] & valid[:n]
+    return lambda: np.asarray(ok_dev)[:n] & valid[:n]
+
+
+def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
+    """Batched strict-RFC8032 verify -> bool[B]; semantics identical to
+    crypto.ed25519.verify per item. Padded to power-of-two buckets so jit
+    recompilation is bounded."""
+    return verify_batch_async(items)()

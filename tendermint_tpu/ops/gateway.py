@@ -84,16 +84,9 @@ class Verifier:
             return lambda: []
         if self._tpu_ok and n >= self.min_tpu_batch:
             try:
-                import jax.numpy as jnp
-
                 from tendermint_tpu.ops import ed25519_f32 as ops_ed
 
-                bucket = ops_ed._next_pow2(n)
-                ax, ay, ry, rs, s8, h8, valid = ops_ed.prepare_batch8(items, bucket)
-                ok_dev = ops_ed._verify_jit(
-                    jnp.asarray(ax), jnp.asarray(ay), jnp.asarray(ry),
-                    jnp.asarray(rs), jnp.asarray(s8), jnp.asarray(h8),
-                )
+                kernel_resolve = ops_ed.verify_batch_async(items)
                 with self._mtx:
                     self._stats["tpu_batches"] += 1
                     self._stats["tpu_sigs"] += n
@@ -103,9 +96,7 @@ class Verifier:
                     # materialization: keep the sync path's CPU-fallback
                     # guarantee here too.
                     try:
-                        return [
-                            bool(b) for b in (np.asarray(ok_dev)[:n] & valid[:n])
-                        ]
+                        return [bool(b) for b in kernel_resolve()]
                     except Exception:
                         logger.exception(
                             "TPU verify failed at resolve; falling back to CPU"
@@ -214,6 +205,15 @@ class Hasher:
             use_tpu = os.environ.get("TENDERMINT_TPU_DISABLE", "") == ""
         self.min_tpu_batch = min_tpu_batch
         self._tpu_ok = use_tpu
+        self._mtx = threading.Lock()
+        self._stats = {
+            "tpu_part_batches": 0, "tpu_leaves": 0,
+            "tpu_tx_roots": 0, "cpu_leaves": 0,
+        }
+
+    def stats(self) -> dict:
+        with self._mtx:
+            return dict(self._stats)
 
     def part_leaf_hashes(self, chunks: list[bytes]) -> list[bytes]:
         """Part.Hash batch — for PartSet.from_data(hasher=...)."""
@@ -221,27 +221,42 @@ class Hasher:
             try:
                 from tendermint_tpu.ops import merkle as ops_merkle
 
-                return ops_merkle.part_leaf_hashes(chunks)
+                out = ops_merkle.part_leaf_hashes(chunks)
+                with self._mtx:
+                    self._stats["tpu_part_batches"] += 1
+                    self._stats["tpu_leaves"] += len(chunks)
+                return out
             except Exception:
                 logger.exception("TPU part hashing failed; falling back to CPU")
                 self._tpu_ok = False
         from tendermint_tpu.crypto.hashing import ripemd160
 
+        with self._mtx:
+            self._stats["cpu_leaves"] += len(chunks)
         return [ripemd160(c) for c in chunks]
 
     def tx_merkle_root(self, txs: list[bytes]) -> bytes:
+        """Txs.Hash — the tx-tree root (types/tx.go:33-46), batched when
+        wide enough. Injected into types/tx via set_batch_tx_root at node
+        assembly so every block build/validate rides it."""
         if self._tpu_ok and len(txs) >= self.min_tpu_batch:
             try:
                 from tendermint_tpu.ops import merkle as ops_merkle
 
-                return ops_merkle.merkle_root_from_leaf_digests(
+                out = ops_merkle.merkle_root_from_leaf_digests(
                     ops_merkle.leaf_hashes(txs)
                 )
+                with self._mtx:
+                    self._stats["tpu_tx_roots"] += 1
+                    self._stats["tpu_leaves"] += len(txs)
+                return out
             except Exception:
                 logger.exception("TPU tx hashing failed; falling back to CPU")
                 self._tpu_ok = False
         from tendermint_tpu.merkle.simple import simple_hash_from_byteslices
 
+        with self._mtx:
+            self._stats["cpu_leaves"] += len(txs)
         return simple_hash_from_byteslices(txs)
 
 

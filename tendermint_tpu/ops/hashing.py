@@ -61,38 +61,68 @@ _INIT_RIPEMD = np.array(
     [0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0], dtype=np.uint32
 )
 
+# The 80 rounds are rolled into a lax.scan rather than unrolled python
+# loops: the unrolled 320-op dependency chain made XLA:CPU's LLVM
+# pipeline take minutes per shape, and scan keeps the graph O(1) in
+# round count. _SCAN_UNROLL re-unrolls chunks inside the compiled loop
+# so the TPU VPU still sees fused multi-round chains.
+_SCAN_UNROLL = 8
+
+# Flattened per-step round tables (step j = round j//16, index j%16).
+_R1F = np.concatenate([np.asarray(r, np.int32) for r in _R1])
+_R2F = np.concatenate([np.asarray(r, np.int32) for r in _R2])
+_S1F = np.concatenate([np.asarray(s, np.uint32) for s in _S1])
+_S2F = np.concatenate([np.asarray(s, np.uint32) for s in _S2])
+_K1F = np.repeat(np.asarray(_K1, np.uint32), 16)
+_K2F = np.repeat(np.asarray(_K2, np.uint32), 16)
+_RNDF = np.repeat(np.arange(5, dtype=np.int32), 16)
+
 
 def _rol(x, n):
     return (x << n) | (x >> (32 - n))
 
 
-def _f_ripemd(j, x, y, z):
-    if j == 0:
-        return x ^ y ^ z
-    if j == 1:
-        return (x & y) | (~x & z)
-    if j == 2:
-        return (x | ~y) ^ z
-    if j == 3:
-        return (x & z) | (y & ~z)
-    return x ^ (y | ~z)
+def _f_sel(j, x, y, z):
+    """RIPEMD round function selected by traced round index j (0..4):
+    all five are cheap VPU bitwise ops, so compute-and-select beats a
+    branch inside the scan body."""
+    f0 = x ^ y ^ z
+    f1 = (x & y) | (~x & z)
+    f2 = (x | ~y) ^ z
+    f3 = (x & z) | (y & ~z)
+    f4 = x ^ (y | ~z)
+    return jnp.where(
+        j == 0, f0, jnp.where(j == 1, f1, jnp.where(j == 2, f2, jnp.where(j == 3, f3, f4)))
+    )
 
 
 def _ripemd160_block(state, words):
     """One compression step. state: (B,5) uint32; words: (B,16) uint32."""
-    h0, h1, h2, h3, h4 = [state[:, i] for i in range(5)]
-    a1, b1, c1, d1, e1 = h0, h1, h2, h3, h4
-    a2, b2, c2, d2, e2 = h0, h1, h2, h3, h4
-    for rnd in range(5):
-        k1 = jnp.uint32(_K1[rnd])
-        k2 = jnp.uint32(_K2[rnd])
-        for i in range(16):
-            t = a1 + _f_ripemd(rnd, b1, c1, d1) + words[:, _R1[rnd][i]] + k1
-            t = _rol(t, _S1[rnd][i]) + e1
-            a1, e1, d1, c1, b1 = e1, d1, _rol(c1, 10), b1, t
-            t = a2 + _f_ripemd(4 - rnd, b2, c2, d2) + words[:, _R2[rnd][i]] + k2
-            t = _rol(t, _S2[rnd][i]) + e2
-            a2, e2, d2, c2, b2 = e2, d2, _rol(c2, 10), b2, t
+    h = [state[:, i] for i in range(5)]
+    # message-word selection is a static gather outside the loop
+    w1 = jnp.swapaxes(jnp.take(words, jnp.asarray(_R1F), axis=1), 0, 1)  # (80,B)
+    w2 = jnp.swapaxes(jnp.take(words, jnp.asarray(_R2F), axis=1), 0, 1)
+    xs = (
+        w1, w2,
+        jnp.asarray(_S1F), jnp.asarray(_S2F),
+        jnp.asarray(_K1F), jnp.asarray(_K2F),
+        jnp.asarray(_RNDF),
+    )
+
+    def step(carry, inp):
+        a1, b1, c1, d1, e1, a2, b2, c2, d2, e2 = carry
+        x1, x2, s1, s2, k1, k2, rnd = inp
+        t = _rol(a1 + _f_sel(rnd, b1, c1, d1) + x1 + k1, s1) + e1
+        a1, e1, d1, c1, b1 = e1, d1, _rol(c1, jnp.uint32(10)), b1, t
+        t = _rol(a2 + _f_sel(4 - rnd, b2, c2, d2) + x2 + k2, s2) + e2
+        a2, e2, d2, c2, b2 = e2, d2, _rol(c2, jnp.uint32(10)), b2, t
+        return (a1, b1, c1, d1, e1, a2, b2, c2, d2, e2), None
+
+    init = (*h, *h)
+    (a1, b1, c1, d1, e1, a2, b2, c2, d2, e2), _ = jax.lax.scan(
+        step, init, xs, unroll=_SCAN_UNROLL
+    )
+    h0, h1, h2, h3, h4 = h
     return jnp.stack(
         [h1 + c1 + d2, h2 + d1 + e2, h3 + e1 + a2, h4 + a1 + b2, h0 + b1 + c2],
         axis=1,
@@ -164,23 +194,38 @@ def _ror(x, n):
 
 
 def _sha256_block(state, words):
-    """state: (B,8); words: (B,16) big-endian-packed."""
-    w = [words[:, i] for i in range(16)]
-    for i in range(16, 64):
-        s0 = _ror(w[i - 15], 7) ^ _ror(w[i - 15], 18) ^ (w[i - 15] >> 3)
-        s1 = _ror(w[i - 2], 17) ^ _ror(w[i - 2], 19) ^ (w[i - 2] >> 10)
-        w.append(w[i - 16] + s0 + w[i - 7] + s1)
-    a, b, c, d, e, f, g, h = [state[:, i] for i in range(8)]
-    for i in range(64):
+    """state: (B,8); words: (B,16) big-endian-packed.
+
+    Message schedule and rounds both run as lax.scan (see the RIPEMD
+    note above on why rolled loops: unrolled bodies stall XLA:CPU's
+    LLVM passes for minutes; _SCAN_UNROLL restores in-loop fusion)."""
+
+    def sched_step(win, _):
+        # win: (B,16) sliding window of the last 16 schedule words
+        w15, w2, w16, w7 = win[:, 1], win[:, 14], win[:, 0], win[:, 9]
+        s0 = _ror(w15, 7) ^ _ror(w15, 18) ^ (w15 >> 3)
+        s1 = _ror(w2, 17) ^ _ror(w2, 19) ^ (w2 >> 10)
+        new = w16 + s0 + w7 + s1
+        return jnp.concatenate([win[:, 1:], new[:, None]], axis=1), new
+
+    _, tail = jax.lax.scan(sched_step, words, None, length=48, unroll=_SCAN_UNROLL)
+    all_w = jnp.concatenate([jnp.swapaxes(words, 0, 1), tail], axis=0)  # (64,B)
+
+    def round_step(st, inp):
+        w_t, k_t = inp
+        a, b, c, d, e, f, g, h = st
         s1 = _ror(e, 6) ^ _ror(e, 11) ^ _ror(e, 25)
         ch = (e & f) ^ (~e & g)
-        t1 = h + s1 + ch + jnp.uint32(_SHA_K[i]) + w[i]
+        t1 = h + s1 + ch + k_t + w_t
         s0 = _ror(a, 2) ^ _ror(a, 13) ^ _ror(a, 22)
         maj = (a & b) ^ (a & c) ^ (b & c)
-        t2 = s0 + maj
-        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
-    new = jnp.stack([a, b, c, d, e, f, g, h], axis=1)
-    return state + new
+        return (t1 + s0 + maj, a, b, c, d + t1, e, f, g), None
+
+    init = tuple(state[:, i] for i in range(8))
+    final, _ = jax.lax.scan(
+        round_step, init, (all_w, jnp.asarray(_SHA_K)), unroll=_SCAN_UNROLL
+    )
+    return state + jnp.stack(final, axis=1)
 
 
 @jax.jit

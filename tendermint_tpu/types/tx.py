@@ -22,8 +22,22 @@ def tx_hash(tx: Tx) -> bytes:
     return leaf_hash(tx)
 
 
+# Batched tx-tree hook: node assembly injects the TPU hashing gateway
+# (ops/gateway.Hasher.tx_merkle_root) so Data.hash / block validation ride
+# the batched kernel; None means pure-CPU. The gateway preserves the exact
+# tree shape, so hashes are identical either way (enforced by tests).
+_batch_tx_root = None
+
+
+def set_batch_tx_root(fn) -> None:
+    global _batch_tx_root
+    _batch_tx_root = fn
+
+
 def txs_hash(txs: list[Tx]) -> bytes:
     """Merkle root of tx hashes (types/tx.go:33-46). Empty list -> b""."""
+    if _batch_tx_root is not None:
+        return _batch_tx_root(list(txs))
     return simple_hash_from_hashes([tx_hash(tx) for tx in txs])
 
 

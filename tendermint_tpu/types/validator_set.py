@@ -153,6 +153,43 @@ class ValidatorSet:
         the commit is verified in ONE batch (the TPU kernel); per-signature
         results feed the same accept/reject logic the sequential loop has.
         """
+        items = self._commit_structural_check(chain_id, height, commit)
+        if batch_verifier is not None:
+            oks = batch_verifier(
+                [(val.pub_key.raw, sb, sig.raw) for _, _, val, sb, sig in items]
+            )
+        else:
+            oks = [
+                val.pub_key.verify_bytes(sb, sig) for _, _, val, sb, sig in items
+            ]
+        self._commit_tally(block_id, items, oks)
+
+    def verify_commit_async(
+        self, chain_id: str, block_id: BlockID, height: int, commit,
+        async_batch_verifier,
+    ):
+        """Pipelined verify_commit: structural checks run now (raising
+        CommitError immediately), the signature batch is dispatched to the
+        device, and the returned zero-arg resolver finishes the tally —
+        raising CommitError exactly as verify_commit would. Lets a caller
+        overlap host work (e.g. the NEXT block's part-set hashing in fast
+        sync) with device execution.
+
+        async_batch_verifier: callable(items) -> resolver() -> list[bool]
+        (ops/gateway.Verifier.verify_batch_async)."""
+        items = self._commit_structural_check(chain_id, height, commit)
+        resolve = async_batch_verifier(
+            [(val.pub_key.raw, sb, sig.raw) for _, _, val, sb, sig in items]
+        )
+
+        def finish() -> None:
+            self._commit_tally(block_id, items, resolve())
+
+        return finish
+
+    def _commit_structural_check(self, chain_id: str, height: int, commit):
+        """Everything verify_commit checks before signatures; returns the
+        signature work items (idx, precommit, validator, sign_bytes, sig)."""
         if self.size() != len(commit.precommits):
             raise CommitError(
                 f"wrong set size: {self.size()} vs {len(commit.precommits)}"
@@ -161,8 +198,7 @@ class ValidatorSet:
             raise CommitError(f"wrong height: {height} vs {commit.height()}")
 
         round_ = commit.round_()
-        # structural pass + signature item collection
-        items = []  # (idx, precommit, pubkey, sign_bytes, sig)
+        items = []
         for idx, precommit in enumerate(commit.precommits):
             if precommit is None:
                 continue  # validator skipped: fine
@@ -179,16 +215,9 @@ class ValidatorSet:
             items.append(
                 (idx, precommit, val, precommit.sign_bytes(chain_id), precommit.signature)
             )
+        return items
 
-        if batch_verifier is not None:
-            oks = batch_verifier(
-                [(val.pub_key.raw, sb, sig.raw) for _, _, val, sb, sig in items]
-            )
-        else:
-            oks = [
-                val.pub_key.verify_bytes(sb, sig) for _, _, val, sb, sig in items
-            ]
-
+    def _commit_tally(self, block_id: BlockID, items, oks) -> None:
         tallied = 0
         for (idx, precommit, val, _, _), ok in zip(items, oks):
             if not ok:

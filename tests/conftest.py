@@ -27,3 +27,18 @@ jax.config.update("jax_platforms", "cpu")
 from tendermint_tpu.jitcache import enable as _enable_jit_cache  # noqa: E402
 
 _enable_jit_cache()
+
+
+def pytest_collection_modifyitems(config, items):
+    """Deselect slow-marked tests on whole-suite runs (keeps the default
+    `pytest tests/` under a minute), but honor an explicit -m expression
+    or a test named by node id — unlike an addopts `-m "not slow"`, which
+    would silently deselect even a directly requested slow test."""
+    if config.option.markexpr:
+        return
+    if any("::" in a for a in config.args):
+        return
+    slow = [i for i in items if "slow" in i.keywords]
+    if slow:
+        config.hook.pytest_deselected(items=slow)
+        items[:] = [i for i in items if "slow" not in i.keywords]

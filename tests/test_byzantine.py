@@ -22,7 +22,7 @@ from tendermint_tpu.p2p.node_info import NodeInfo, default_version
 from tendermint_tpu.types import BlockID, Proposal
 from tendermint_tpu.types.priv_validator import PrivValidatorFS
 from tests.test_reactors import TEST_CHAIN_ID, make_genesis, make_node, wait_until
-from tendermint_tpu.config import test_config
+from tendermint_tpu.config import test_config as _test_config
 
 
 class ByzantinePrivValidator:
@@ -126,7 +126,7 @@ def test_byzantine_proposer_cannot_halt_chain():
         con_r = ConsensusReactor(node.cs, fast_sync=False)
         con_r.set_event_switch(node.evsw)
         sw.add_reactor("CONSENSUS", con_r)
-        sw.add_reactor("MEMPOOL", MempoolReactor(test_config().mempool, node.mempool))
+        sw.add_reactor("MEMPOOL", MempoolReactor(_test_config().mempool, node.mempool))
         sw.set_node_info(
             NodeInfo(
                 pub_key=sw.node_priv_key.pub_key(),

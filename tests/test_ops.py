@@ -61,7 +61,12 @@ class TestMerkleKernel:
         assert leaf_hashes(items) == [leaf_hash(i) for i in items]
 
 
+@pytest.mark.slow
 class TestFieldArithmetic:
+    """int32 radix-2^15 reference-kernel math (dormant in production —
+    the gateway runs ops/ed25519_f32; see tests/test_ops_f32.py). Marked
+    slow: compiles the big ladder graphs."""
+
     def test_mul_inv_canon(self):
         import random
 
@@ -108,9 +113,12 @@ def _mk_items(n, corrupt=()):
     return items
 
 
+@pytest.mark.slow
 class TestVerifyKernel:
     """Compiles the full jnp verify program once (slow on CPU backend) and
-    reuses it; the pallas variant shares all math helpers."""
+    reuses it; the pallas variant shares all math helpers. Slow: the
+    int32 kernel is the dormant math reference — the production f32
+    kernel has its own always-on suite in tests/test_ops_f32.py."""
 
     def test_verify_and_reject(self):
         items = _mk_items(
@@ -140,6 +148,7 @@ class TestVerifyKernel:
             assert ops_ed.limbs_to_int(y[:, i]) == pt[1]
 
 
+@pytest.mark.slow
 class TestPallasKernelMath:
     """The Pallas kernel's row-based limb arithmetic is plain jnp outside
     the pallas_call plumbing — test it directly against the reference so
@@ -219,6 +228,28 @@ class TestPallasKernelMath:
 
 
 class TestGateway:
+    def test_tx_root_hook_parity(self):
+        """The node-assembly hook (types/tx.set_batch_tx_root) must route
+        Txs.Hash through the batched kernel with a byte-identical root
+        (ref types/tx.go:33-46) and move the hasher stats."""
+        from tendermint_tpu.merkle.simple import simple_hash_from_hashes
+        from tendermint_tpu.types import tx as tx_types
+
+        txs = [bytes([i]) * (i + 1) for i in range(20)]
+        # explicit CPU reference — independent of any hook a previously
+        # constructed Node may have left installed in this process
+        cpu_root = simple_hash_from_hashes([tx_types.tx_hash(t) for t in txs])
+        hasher = gateway.Hasher(min_tpu_batch=1, use_tpu=True)
+        prev = tx_types._batch_tx_root
+        tx_types.set_batch_tx_root(hasher.tx_merkle_root)
+        try:
+            tpu_root = tx_types.txs_hash(txs)
+        finally:
+            tx_types.set_batch_tx_root(prev)
+        assert tpu_root == cpu_root
+        st = hasher.stats()
+        assert st["tpu_tx_roots"] == 1 and st["tpu_leaves"] == 20
+
     def test_cpu_small_batch(self):
         v = gateway.Verifier(min_tpu_batch=1000)
         items = _mk_items(4, corrupt=[(2, "sig")])

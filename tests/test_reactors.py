@@ -15,7 +15,7 @@ from tendermint_tpu.abci.apps.kvstore import KVStoreApp
 from tendermint_tpu.abci.client import LocalClient
 from tendermint_tpu.blockchain.reactor import BlockchainReactor
 from tendermint_tpu.blockchain.store import BlockStore
-from tendermint_tpu.config import test_config
+from tendermint_tpu.config import test_config as _test_config
 from tendermint_tpu.consensus.reactor import ConsensusReactor
 from tendermint_tpu.consensus.state import ConsensusState
 from tendermint_tpu.crypto.keys import gen_priv_key_ed25519
@@ -69,11 +69,11 @@ def make_genesis(n: int):
 
 
 def make_node(doc: GenesisDoc, pv, app=None) -> Node:
-    config = test_config().consensus
+    config = _test_config().consensus
     config.root_dir = tempfile.mkdtemp(prefix="reactor-test-")
     app = app if app is not None else CounterApp()
     mtx = threading.RLock()
-    mempool = Mempool(test_config().mempool, AppConnMempool(LocalClient(app, mtx)))
+    mempool = Mempool(_test_config().mempool, AppConnMempool(LocalClient(app, mtx)))
     store = BlockStore(MemDB())
     state = State.get_state(MemDB(), doc)
     evsw = EventSwitch()
@@ -99,7 +99,7 @@ def start_consensus_net(n: int, app_factory=None):
         con_r = ConsensusReactor(node.cs, fast_sync=False)
         con_r.set_event_switch(node.evsw)
         sw.add_reactor("CONSENSUS", con_r)
-        mem_r = MempoolReactor(test_config().mempool, node.mempool)
+        mem_r = MempoolReactor(_test_config().mempool, node.mempool)
         sw.add_reactor("MEMPOOL", mem_r)
         from tendermint_tpu.p2p.node_info import NodeInfo, default_version
 
@@ -230,6 +230,74 @@ def test_fast_sync_catches_up_and_switches():
         stop_net([node_a, node_b], switches)
 
 
+def test_fast_sync_rides_the_tpu_gateway():
+    """Regression: fast sync with the gateway wired (as node/node.py wires
+    it) must actually route commit signatures AND part hashing through the
+    batched kernels — the stats counters move, and the synced chain is
+    byte-identical to the builder's (blockchain/reactor.go:229-236)."""
+    from tendermint_tpu.ops import gateway
+
+    verifier = gateway.Verifier(min_tpu_batch=1, use_tpu=True)
+    hasher = gateway.Hasher(min_tpu_batch=1, use_tpu=True)
+
+    doc, pvs = make_genesis(1)
+    node_a = make_node(doc, pvs[0])
+    node_b = make_node(doc, None)
+
+    def init(i, sw):
+        node = (node_a, node_b)[i]
+        fast_sync = i == 1
+        con_r = ConsensusReactor(node.cs, fast_sync=fast_sync)
+        con_r.set_event_switch(node.evsw)
+        sw.add_reactor("CONSENSUS", con_r)
+        bc_r = BlockchainReactor(
+            node.state.copy(),
+            node.cs.proxy_app_conn,
+            node.store,
+            fast_sync=fast_sync,
+            event_cache=None,
+            batch_verifier=verifier.commit_batch_verifier() if fast_sync else None,
+            async_batch_verifier=verifier.verify_batch_async if fast_sync else None,
+            part_hasher=hasher.part_leaf_hashes if fast_sync else None,
+            status_update_interval=0.5,
+        )
+        sw.add_reactor("BLOCKCHAIN", bc_r)
+        from tendermint_tpu.p2p.node_info import NodeInfo, default_version
+
+        sw.set_node_info(
+            NodeInfo(
+                pub_key=sw.node_priv_key.pub_key(),
+                moniker=f"node{i}",
+                network=TEST_CHAIN_ID,
+                version=default_version("test"),
+            )
+        )
+        return sw
+
+    from tendermint_tpu.p2p import Switch, connect2_switches
+
+    switches = [init(i, Switch()) for i in range(2)]
+    for sw in switches:
+        sw.start()
+    try:
+        assert wait_until(lambda: node_a.store.height() >= 4, timeout=60)
+        node_a.cs.stop()
+        target = node_a.store.height()
+        connect2_switches(switches, 0, 1)
+        assert wait_until(
+            lambda: node_b.store.height() >= target, timeout=60
+        ), f"B at {node_b.store.height()}, A at {target}"
+        for h in range(1, target + 1):
+            assert node_b.store.load_block(h).hash() == node_a.store.load_block(h).hash()
+        vstats, hstats = verifier.stats(), hasher.stats()
+        assert vstats["tpu_sigs"] > 0, vstats  # commit sigs rode the kernel
+        assert vstats["tpu_batches"] > 0, vstats
+        assert hstats["tpu_part_batches"] > 0, hstats  # part hashing did too
+        assert hstats["tpu_leaves"] > 0, hstats
+    finally:
+        stop_net([node_a, node_b], switches)
+
+
 # -- mempool reactor ----------------------------------------------------------
 
 
@@ -240,7 +308,7 @@ def test_mempool_reactor_gossips_txs():
 
     def init(i, sw):
         node = (n1, n2)[i]
-        sw.add_reactor("MEMPOOL", MempoolReactor(test_config().mempool, node.mempool))
+        sw.add_reactor("MEMPOOL", MempoolReactor(_test_config().mempool, node.mempool))
         from tendermint_tpu.p2p.node_info import NodeInfo, default_version
 
         sw.set_node_info(
