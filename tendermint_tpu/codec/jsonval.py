@@ -1,0 +1,45 @@
+"""Bounded JSON field decoding for wire-facing types.
+
+Everything decoded from a peer (consensus messages and the types nested
+inside them: Vote, Proposal, Part, BlockID, Heartbeat...) is attacker
+input. go-wire gave the reference typed, size-capped decoding for free
+(wire.ReadBinary with byte-length limits); this module is that contract
+for the JSON codec: every scalar is type- and range-checked, and any
+violation raises ValueError — which the p2p receive paths treat as a
+peer error (disconnect), never as a crash or an unbounded allocation.
+
+The same from_json paths also decode our own WAL and RPC data, so the
+bounds are generous protocol-level maxima, not policy limits: heights
+up to 2^62, 2^20 validators/parts, 64-byte hashes.
+"""
+
+from __future__ import annotations
+
+MAX_HEIGHT = 1 << 62
+MAX_ROUND = 1 << 31
+MAX_INDEX = 1 << 20  # validator / part indices and counts
+MAX_HASH_BYTES = 64
+
+
+def int_field(o, key, lo: int, hi: int) -> int:
+    v = o.get(key) if isinstance(o, dict) else None
+    if type(v) is not int or not (lo <= v <= hi):  # type() also rejects bool
+        raise ValueError(f"bad {key!r}: {v!r}")
+    return v
+
+
+def hex_field(o, key, max_bytes: int = MAX_HASH_BYTES) -> bytes:
+    v = o.get(key) if isinstance(o, dict) else None
+    if not isinstance(v, str) or len(v) > 2 * max_bytes:
+        raise ValueError(f"bad {key!r}")
+    try:
+        return bytes.fromhex(v)
+    except ValueError as exc:
+        raise ValueError(f"bad {key!r}: not hex") from exc
+
+
+def dict_field(o, key) -> dict:
+    v = o.get(key) if isinstance(o, dict) else None
+    if not isinstance(v, dict):
+        raise ValueError(f"bad {key!r}")
+    return v

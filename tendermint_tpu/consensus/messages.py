@@ -30,10 +30,42 @@ def msg_to_json(msg) -> dict:
 
 
 def msg_from_json(obj: dict):
+    """Decode one peer message. The input is attacker-controlled: the
+    envelope and every scalar field are type- and range-checked here (the
+    go-wire codec got this for free from typed byte decoding); anything
+    out of contract raises ValueError, which the reactor's receive()
+    treats as a peer error."""
+    if not isinstance(obj, dict) or not isinstance(obj.get("type"), str):
+        raise ValueError("malformed consensus message envelope")
     cls = _REGISTRY.get(obj["type"])
     if cls is None:
         raise ValueError(f"unknown consensus message type {obj['type']!r}")
-    return cls.from_json(obj["data"])
+    data = obj.get("data")
+    if not isinstance(data, dict):
+        raise ValueError("malformed consensus message body")
+    return cls.from_json(data)
+
+
+# -- field validators (attacker-facing bounds; shared with the nested
+# wire types via codec/jsonval) ---------------------------------------------
+
+from tendermint_tpu.codec.jsonval import (  # noqa: E402
+    MAX_HEIGHT as _MAX_HEIGHT,
+    MAX_INDEX as _MAX_INDEX,
+    MAX_ROUND as _MAX_ROUND,
+    dict_field as _dict_field,
+    int_field as _int_field,
+)
+
+_MAX_BITS = 1 << 20  # vote / part bit-arrays
+
+
+def _bitarray_field(o, key, max_bits=_MAX_BITS):
+    v = _dict_field(o, key)
+    bits = v.get("bits")
+    if type(bits) is not int or not (0 <= bits <= max_bits):
+        raise ValueError(f"bad {key!r} size: {bits!r}")
+    return BitArray.from_json(v)
 
 
 @register("new_round_step")
@@ -58,7 +90,13 @@ class NewRoundStepMessage:
 
     @classmethod
     def from_json(cls, o):
-        return cls(o["height"], o["round"], o["step"], o["seconds_since_start_time"], o["last_commit_round"])
+        return cls(
+            _int_field(o, "height", 0, _MAX_HEIGHT),
+            _int_field(o, "round", 0, _MAX_ROUND),
+            _int_field(o, "step", 0, 16),
+            _int_field(o, "seconds_since_start_time", -_MAX_ROUND, _MAX_ROUND),
+            _int_field(o, "last_commit_round", -1, _MAX_ROUND),
+        )
 
 
 @register("commit_step")
@@ -80,9 +118,9 @@ class CommitStepMessage:
     @classmethod
     def from_json(cls, o):
         return cls(
-            o["height"],
-            PartSetHeader.from_json(o["block_parts_header"]),
-            BitArray.from_json(o["block_parts"]),
+            _int_field(o, "height", 0, _MAX_HEIGHT),
+            PartSetHeader.from_json(_dict_field(o, "block_parts_header")),
+            _bitarray_field(o, "block_parts"),
         )
 
 
@@ -96,7 +134,7 @@ class ProposalMessage:
 
     @classmethod
     def from_json(cls, o):
-        return cls(Proposal.from_json(o["proposal"]))
+        return cls(Proposal.from_json(_dict_field(o, "proposal")))
 
 
 @register("proposal_pol")
@@ -117,7 +155,11 @@ class ProposalPOLMessage:
 
     @classmethod
     def from_json(cls, o):
-        return cls(o["height"], o["proposal_pol_round"], BitArray.from_json(o["proposal_pol"]))
+        return cls(
+            _int_field(o, "height", 0, _MAX_HEIGHT),
+            _int_field(o, "proposal_pol_round", 0, _MAX_ROUND),
+            _bitarray_field(o, "proposal_pol"),
+        )
 
 
 @register("block_part")
@@ -132,7 +174,11 @@ class BlockPartMessage:
 
     @classmethod
     def from_json(cls, o):
-        return cls(o["height"], o["round"], Part.from_json(o["part"]))
+        return cls(
+            _int_field(o, "height", 0, _MAX_HEIGHT),
+            _int_field(o, "round", 0, _MAX_ROUND),
+            Part.from_json(_dict_field(o, "part")),
+        )
 
 
 @register("vote")
@@ -145,7 +191,7 @@ class VoteMessage:
 
     @classmethod
     def from_json(cls, o):
-        return cls(Vote.from_json(o["vote"]))
+        return cls(Vote.from_json(_dict_field(o, "vote")))
 
 
 @register("has_vote")
@@ -163,7 +209,12 @@ class HasVoteMessage:
 
     @classmethod
     def from_json(cls, o):
-        return cls(o["height"], o["round"], o["type"], o["index"])
+        return cls(
+            _int_field(o, "height", 0, _MAX_HEIGHT),
+            _int_field(o, "round", 0, _MAX_ROUND),
+            _int_field(o, "type", 0, 255),
+            _int_field(o, "index", 0, _MAX_INDEX),
+        )
 
 
 @register("vote_set_maj23")
@@ -186,7 +237,12 @@ class VoteSetMaj23Message:
 
     @classmethod
     def from_json(cls, o):
-        return cls(o["height"], o["round"], o["type"], BlockID.from_json(o["block_id"]))
+        return cls(
+            _int_field(o, "height", 0, _MAX_HEIGHT),
+            _int_field(o, "round", 0, _MAX_ROUND),
+            _int_field(o, "type", 0, 255),
+            BlockID.from_json(_dict_field(o, "block_id")),
+        )
 
 
 @register("vote_set_bits")
@@ -213,8 +269,11 @@ class VoteSetBitsMessage:
     @classmethod
     def from_json(cls, o):
         return cls(
-            o["height"], o["round"], o["type"],
-            BlockID.from_json(o["block_id"]), BitArray.from_json(o["votes"]),
+            _int_field(o, "height", 0, _MAX_HEIGHT),
+            _int_field(o, "round", 0, _MAX_ROUND),
+            _int_field(o, "type", 0, 255),
+            BlockID.from_json(_dict_field(o, "block_id")),
+            _bitarray_field(o, "votes"),
         )
 
 
@@ -228,4 +287,4 @@ class ProposalHeartbeatMessage:
 
     @classmethod
     def from_json(cls, o):
-        return cls(Heartbeat.from_json(o["heartbeat"]))
+        return cls(Heartbeat.from_json(_dict_field(o, "heartbeat")))

@@ -202,6 +202,12 @@ class PeerState:
         with self._mtx:
             prs = self.prs
             psheight, psround, psstep = prs.height, prs.round_, prs.step
+            # stale/duplicate guard (reactor.go:1050-1053): a reordered or
+            # replayed step message must never move peer state backwards —
+            # without this, an attacker replaying an old NewRoundStep wipes
+            # the vote bit-arrays we track for the peer
+            if (msg.height, msg.round_, msg.step) <= (psheight, psround, int(psstep)):
+                return
             ps_catchup_round = prs.catchup_commit_round
             ps_catchup = prs.catchup_commit
 
@@ -346,7 +352,7 @@ class ConsensusReactor(Reactor, BaseService):
             return
         try:
             msg = _dec(msg_bytes)
-        except (ValueError, KeyError, UnicodeDecodeError) as exc:
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError) as exc:
             self.switch.stop_peer_for_error(peer, exc)
             return
         ps: PeerState | None = peer.get(PEER_STATE_KEY)
@@ -389,8 +395,12 @@ class ConsensusReactor(Reactor, BaseService):
                 rs = self.con_s.get_round_state()
                 height = rs.height
                 size = rs.validators.size() if rs.validators else 0
+                # the height-1 array tracks LastCommit votes, whose set can
+                # differ in size from the current one (reactor.go:291-296
+                # uses cs.LastCommit.Size(), not cs.Validators.Size())
+                last_size = rs.last_commit.size() if rs.last_commit else 0
                 ps.ensure_vote_bit_arrays(height, size)
-                ps.ensure_vote_bit_arrays(height - 1, size)
+                ps.ensure_vote_bit_arrays(height - 1, last_size)
                 ps.set_has_vote(
                     msg.vote.height, msg.vote.round_, msg.vote.type_,
                     msg.vote.validator_index,

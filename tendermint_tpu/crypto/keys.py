@@ -39,8 +39,10 @@ class SignatureEd25519:
 
     @classmethod
     def from_json(cls, obj) -> "SignatureEd25519":
-        if obj[0] != TYPE_ED25519:
-            raise ValueError(f"unknown signature type {obj[0]}")
+        if not isinstance(obj, (list, tuple)) or len(obj) != 2 or obj[0] != TYPE_ED25519:
+            raise ValueError(f"unknown signature encoding {obj!r}")
+        if not isinstance(obj[1], str) or len(obj[1]) != 128:
+            raise ValueError("bad signature hex")
         return cls(bytes.fromhex(obj[1]))
 
 

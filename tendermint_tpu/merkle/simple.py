@@ -74,7 +74,13 @@ class SimpleProof:
 
     @classmethod
     def from_json(cls, obj) -> "SimpleProof":
-        return cls([bytes.fromhex(a) for a in obj["aunts"]])
+        aunts = obj.get("aunts") if isinstance(obj, dict) else None
+        # 64 aunts = a 2^64-leaf tree: anything deeper is garbage
+        if not isinstance(aunts, list) or len(aunts) > 64 or any(
+            not isinstance(a, str) or len(a) > 128 for a in aunts
+        ):
+            raise ValueError("bad merkle proof aunts")
+        return cls([bytes.fromhex(a) for a in aunts])
 
 
 def _compute_hash_from_aunts(

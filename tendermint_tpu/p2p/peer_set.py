@@ -10,8 +10,14 @@ class PeerSet:
         self._mtx = threading.Lock()
         self._by_id: dict[str, object] = {}
 
-    def add(self, peer) -> bool:
+    def add(self, peer, cap: int = 0) -> bool:
+        """Register unless duplicate — or, when cap > 0, unless the set is
+        already at cap. The size check must share this lock: admission
+        runs on one thread per inbound connection, and a racy pre-check
+        alone would let a dial burst exceed the cap arbitrarily."""
         with self._mtx:
+            if cap and len(self._by_id) >= cap:
+                return False
             if peer.id() in self._by_id:
                 return False
             self._by_id[peer.id()] = peer

@@ -143,6 +143,18 @@ class Switch(BaseService):
             ).start()
 
     def _accept_peer(self, sock: socket.socket) -> None:
+        # inbound cap (switch.go:462-467): beyond max_num_peers an
+        # attacker could exhaust fds/threads by dialing in a loop
+        max_peers = getattr(self.config, "max_num_peers", 0) if self.config else 0
+        if max_peers and self.peers.size() >= max_peers:
+            self.logger.info(
+                "rejecting inbound peer: at max_num_peers=%d", max_peers
+            )
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
         try:
             self.add_peer_from_stream(SocketStream(sock), outbound=False)
         except Exception as exc:  # noqa: BLE001 — one bad peer can't kill accept
@@ -199,9 +211,18 @@ class Switch(BaseService):
         if reason is not None:
             peer.stream.close()
             raise ConnectionError(f"incompatible peer: {reason}")
-        if not self.peers.add(peer):
+        # inbound connections respect max_num_peers at the registration
+        # point (atomically, inside PeerSet.add) — the accept-loop check is
+        # only a fast path, and many concurrent handshakes may be in
+        # flight past it (switch.go:462-467)
+        cap = 0
+        if not peer.outbound and self.config is not None:
+            cap = getattr(self.config, "max_num_peers", 0)
+        if not self.peers.add(peer, cap=cap):
             peer.stream.close()
-            raise ConnectionError(f"duplicate peer {peer.id()[:12]}")
+            raise ConnectionError(
+                f"duplicate peer or at max_num_peers: {peer.id()[:12]}"
+            )
         try:
             peer.start()
             for reactor in self.reactors.values():

@@ -111,7 +111,10 @@ def commit(ctx, height: int) -> dict:
         raise RPCError("height must be greater than 0")
     if height > store_height:
         raise RPCError("height must be less than or equal to the head")
-    header = ctx.block_store.load_block_meta(height).header
+    meta = ctx.block_store.load_block_meta(height)
+    if meta is None:  # pruned or mid-write height inside the valid range
+        raise RPCError(f"no block meta for height {height}")
+    header = meta.header
     if height == store_height:
         cmt = ctx.block_store.load_seen_commit(height)
         canonical = False

@@ -36,7 +36,12 @@ class PartSetHeader:
 
     @classmethod
     def from_json(cls, obj) -> "PartSetHeader":
-        return cls(obj["total"], bytes.fromhex(obj["hash"]))
+        from tendermint_tpu.codec import jsonval as jv
+
+        return cls(
+            jv.int_field(obj, "total", 0, jv.MAX_INDEX),
+            jv.hex_field(obj, "hash"),
+        )
 
     def __repr__(self):
         return f"PartSetHeader({self.total}:{self.hash.hex()[:12]})"
@@ -81,7 +86,12 @@ class BlockID:
 
     @classmethod
     def from_json(cls, obj) -> "BlockID":
-        return cls(bytes.fromhex(obj["hash"]), PartSetHeader.from_json(obj["parts"]))
+        from tendermint_tpu.codec import jsonval as jv
+
+        return cls(
+            jv.hex_field(obj, "hash"),
+            PartSetHeader.from_json(jv.dict_field(obj, "parts")),
+        )
 
     def __repr__(self):
         return f"BlockID({self.hash.hex()[:12]}:{self.parts_header!r})"

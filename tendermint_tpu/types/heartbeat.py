@@ -47,11 +47,13 @@ class Heartbeat:
 
     @classmethod
     def from_json(cls, obj) -> "Heartbeat":
+        from tendermint_tpu.codec import jsonval as jv
+
         return cls(
-            bytes.fromhex(obj["validator_address"]),
-            obj["validator_index"],
-            obj["height"],
-            obj["round"],
-            obj["sequence"],
-            SignatureEd25519.from_json(obj["signature"]) if obj["signature"] else None,
+            jv.hex_field(obj, "validator_address"),
+            jv.int_field(obj, "validator_index", 0, jv.MAX_INDEX),
+            jv.int_field(obj, "height", 0, jv.MAX_HEIGHT),
+            jv.int_field(obj, "round", 0, jv.MAX_ROUND),
+            jv.int_field(obj, "sequence", 0, jv.MAX_ROUND),
+            SignatureEd25519.from_json(obj["signature"]) if obj.get("signature") else None,
         )

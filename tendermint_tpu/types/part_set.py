@@ -55,8 +55,14 @@ class Part:
 
     @classmethod
     def from_json(cls, obj) -> "Part":
+        from tendermint_tpu.codec import jsonval as jv
+
         return cls(
-            obj["index"], bytes.fromhex(obj["bytes"]), SimpleProof.from_json(obj["proof"])
+            jv.int_field(obj, "index", 0, jv.MAX_INDEX),
+            # parts are 64KB on the wire; 1MB here is protocol slack, the
+            # real cap is the channel's recv capacity
+            jv.hex_field(obj, "bytes", max_bytes=1 << 20),
+            SimpleProof.from_json(jv.dict_field(obj, "proof")),
         )
 
 

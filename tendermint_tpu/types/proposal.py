@@ -84,13 +84,15 @@ class Proposal:
 
     @classmethod
     def from_json(cls, obj) -> "Proposal":
+        from tendermint_tpu.codec import jsonval as jv
+
         return cls(
-            obj["height"],
-            obj["round"],
-            PartSetHeader.from_json(obj["block_parts_header"]),
-            obj["pol_round"],
-            BlockID.from_json(obj["pol_block_id"]),
-            SignatureEd25519.from_json(obj["signature"]) if obj["signature"] else None,
+            jv.int_field(obj, "height", 0, jv.MAX_HEIGHT),
+            jv.int_field(obj, "round", 0, jv.MAX_ROUND),
+            PartSetHeader.from_json(jv.dict_field(obj, "block_parts_header")),
+            jv.int_field(obj, "pol_round", -1, jv.MAX_ROUND),
+            BlockID.from_json(jv.dict_field(obj, "pol_block_id")),
+            SignatureEd25519.from_json(obj["signature"]) if obj.get("signature") else None,
         )
 
     def __repr__(self):

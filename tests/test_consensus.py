@@ -316,3 +316,141 @@ class TestCrashRecovery:
         # consensus resumes and extends the chain
         cs2 = self._run_node(root, app2, state_db, store_db, 1, doc)
         assert cs2.state.last_block_height > committed_height
+
+
+# -- adversarial robustness (peer-facing surfaces) ---------------------------
+
+
+class TestPeerStateRobustness:
+    """The reactor's peer mirror is driven by attacker-controlled
+    messages; stale or replayed ones must never move it backwards
+    (reactor.go:1050-1053)."""
+
+    def _ps(self):
+        from tendermint_tpu.consensus.reactor import PeerState
+
+        return PeerState(peer=object())
+
+    def _nrs(self, h, r, s, last_commit_round=0):
+        from tendermint_tpu.consensus import messages as msgs
+
+        return msgs.NewRoundStepMessage(
+            height=h, round_=r, step=s,
+            seconds_since_start_time=0, last_commit_round=last_commit_round,
+        )
+
+    def test_stale_new_round_step_ignored(self):
+        from tendermint_tpu.libs.bitarray import BitArray
+
+        ps = self._ps()
+        ps.apply_new_round_step(self._nrs(5, 2, 3))
+        ps.ensure_vote_bit_arrays(5, 4)
+        ps.prs.prevotes.set_index(1, True)
+
+        # replayed earlier round: bit arrays must survive
+        ps.apply_new_round_step(self._nrs(5, 1, 6))
+        assert ps.prs.round_ == 2
+        assert ps.prs.prevotes is not None and ps.prs.prevotes.get_index(1)
+
+        # exact duplicate: also a no-op
+        ps.apply_new_round_step(self._nrs(5, 2, 3))
+        assert ps.prs.prevotes is not None
+
+        # genuine progress still applies and resets
+        ps.apply_new_round_step(self._nrs(5, 3, 1))
+        assert ps.prs.round_ == 3
+        assert ps.prs.prevotes is None
+
+    def test_last_commit_bit_array_uses_last_commit_size(self):
+        ps = self._ps()
+        ps.apply_new_round_step(self._nrs(7, 0, 1))
+        # current set has 10 validators, height-6 commit had 4
+        ps.ensure_vote_bit_arrays(7, 10)
+        ps.ensure_vote_bit_arrays(6, 4)
+        assert ps.prs.prevotes.size == 10
+        assert ps.prs.last_commit.size == 4
+
+
+class TestMessageDecodeRobustness:
+    """msg_from_json handles raw attacker JSON: anything off-contract
+    must raise ValueError (-> peer error), never propagate garbage."""
+
+    def test_malformed_envelopes(self):
+        import pytest as _pytest
+
+        from tendermint_tpu.consensus.messages import msg_from_json
+
+        for bad in (
+            None, [], 42, "x",
+            {"type": 7, "data": {}},
+            {"type": "nope", "data": {}},
+            {"type": "vote", "data": []},
+            {"type": "new_round_step"},
+        ):
+            with _pytest.raises(ValueError):
+                msg_from_json(bad)
+
+    def test_scalar_field_bounds(self):
+        import pytest as _pytest
+
+        from tendermint_tpu.consensus.messages import msg_from_json
+
+        good = {
+            "height": 5, "round": 0, "step": 1,
+            "seconds_since_start_time": 0, "last_commit_round": -1,
+        }
+        assert msg_from_json({"type": "new_round_step", "data": good}).height == 5
+        for key, bad in (
+            ("height", -1), ("height", 1 << 70), ("height", "5"),
+            ("height", True), ("round", -2), ("step", 99),
+        ):
+            data = dict(good, **{key: bad})
+            with _pytest.raises(ValueError):
+                msg_from_json({"type": "new_round_step", "data": data})
+
+    def test_bitarray_bounds(self):
+        import pytest as _pytest
+
+        from tendermint_tpu.consensus.messages import msg_from_json
+
+        ok = {
+            "type": "proposal_pol",
+            "data": {"height": 1, "proposal_pol_round": 0,
+                     "proposal_pol": {"bits": 4, "elems": "f"}},
+        }
+        assert msg_from_json(ok).proposal_pol.size == 4
+        for bits in (-1, 1 << 30, "4", None):
+            bad = {
+                "type": "proposal_pol",
+                "data": {"height": 1, "proposal_pol_round": 0,
+                         "proposal_pol": {"bits": bits, "elems": "f"}},
+            }
+            with _pytest.raises(ValueError):
+                msg_from_json(bad)
+
+    def test_nested_vote_garbage_rejected(self):
+        """Off-contract scalars nested inside a Vote must fail at decode
+        (-> peer disconnect), not deep in the consensus loop."""
+        import pytest as _pytest
+
+        from tendermint_tpu.consensus.messages import msg_from_json
+
+        def vote(**over):
+            v = {
+                "validator_address": "aa" * 20, "validator_index": 0,
+                "height": 7, "round": 0, "type": 1,
+                "block_id": {"hash": "", "parts": {"total": 0, "hash": ""}},
+                "signature": None,
+            }
+            v.update(over)
+            return {"type": "vote", "data": {"vote": v}}
+
+        assert msg_from_json(vote()).vote.height == 7
+        for bad in (
+            vote(height="7"), vote(height=True), vote(round=-1),
+            vote(validator_index=1 << 30), vote(validator_address="zz"),
+            vote(block_id={"hash": "x" * 200, "parts": {"total": 0, "hash": ""}}),
+            vote(signature=[1, "ab"]), vote(signature="junk"),
+        ):
+            with _pytest.raises(ValueError):
+                msg_from_json(bad)

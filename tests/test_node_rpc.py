@@ -139,3 +139,24 @@ def test_websocket_subscription(node, client):
 def test_unsafe_routes_gated(node, client):
     with pytest.raises(RPCClientError, match="unknown RPC method"):
         client.unsafe_flush_mempool()
+
+
+def test_commit_missing_meta_is_rpc_error():
+    """A height inside the valid range whose meta is missing (pruned /
+    mid-write) must surface as RPCError, not AttributeError."""
+    import pytest as _pytest
+
+    from tendermint_tpu.rpc.core.handlers import RPCError, commit
+
+    class _Store:
+        def height(self):
+            return 5
+
+        def load_block_meta(self, h):
+            return None
+
+    class _Ctx:
+        block_store = _Store()
+
+    with _pytest.raises(RPCError):
+        commit(_Ctx(), 3)

@@ -375,3 +375,34 @@ def test_fuzzed_stream_delays_but_delivers():
     fa.write(b"through the fuzz")
     assert b.read(100) == b"through the fuzz"
     fa.close()
+
+
+def test_switch_inbound_peer_cap():
+    """Beyond max_num_peers, inbound connections are closed at accept
+    (switch.go:462-467) — outbound/dialed peers are not affected."""
+    import socket as _socket
+
+    from tendermint_tpu.config.config import P2PConfig
+    from tendermint_tpu.p2p.switch import Switch
+
+    sw = Switch(config=P2PConfig(max_num_peers=1))
+
+    class _FakePeer:
+        def id(self):
+            return "aa" * 20
+
+        def key(self):
+            return self.id()
+
+    assert sw.peers.add(_FakePeer())  # at the cap
+    a, b = _socket.socketpair()
+    try:
+        sw._accept_peer(a)
+        b.settimeout(2)
+        assert b.recv(1) == b""  # remote end sees an immediate close
+    finally:
+        for s in (a, b):
+            try:
+                s.close()
+            except OSError:
+                pass
