@@ -46,6 +46,11 @@ class Verifier:
         self._tpu_ok = use_tpu
         self._mtx = threading.Lock()
         self._stats = {"tpu_batches": 0, "tpu_sigs": 0, "cpu_sigs": 0}
+        # verify-ahead results for the live vote path: consensus drains a
+        # run of queued votes, batch-verifies here, then each add_vote's
+        # verify_one pops its primed result (single-use)
+        self._primed: dict[Item, bool] = {}
+        self._primed_cap = 1 << 14
 
     # -- core API ----------------------------------------------------------
 
@@ -118,11 +123,32 @@ class Verifier:
         return lambda: res
 
     def verify_one(self, pubkey: bytes, msg: bytes, sig: bytes) -> bool:
-        """Single-signature path (vote-by-vote arrival): CPU — latency over
-        throughput. Exists so VoteSet can take one pluggable callable."""
+        """Single-signature path (vote-by-vote arrival). A result primed
+        by prime_cache is consumed here without re-verifying; otherwise
+        CPU — latency over throughput. Exists so VoteSet can take one
+        pluggable callable."""
+        with self._mtx:
+            primed = self._primed.pop((pubkey, msg, sig), None)
+        if primed is not None:
+            return primed
         with self._mtx:
             self._stats["cpu_sigs"] += 1
         return ed_cpu.verify(pubkey, msg, sig)
+
+    def prime_cache(self, items: list[Item]) -> None:
+        """Batch-verify now (TPU when wide enough) and stash per-item
+        results for imminent verify_one calls — how a burst of gossiped
+        votes rides the kernel while VoteSet keeps its one-vote-at-a-time
+        accept/reject semantics (SURVEY §7; ref types/vote_set.go:137-175
+        verifies inline per vote). Unconsumed entries age out FIFO."""
+        if not items:
+            return
+        oks = self.verify_batch(items)
+        with self._mtx:
+            for it, ok in zip(items, oks):
+                self._primed[it] = bool(ok)
+            while len(self._primed) > self._primed_cap:
+                self._primed.pop(next(iter(self._primed)))
 
     def stats(self) -> dict:
         with self._mtx:
