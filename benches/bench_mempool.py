@@ -1,0 +1,87 @@
+"""BASELINE config 5: mempool CheckTx burst — 50k txs.
+
+The reference's load shape (`scripts/txs/random.sh` firing random txs at
+broadcast_tx): 50k distinct txs pushed through Mempool.check_tx (cache,
+CList append, app CheckTx via the local ABCI conn, tx WAL), then a
+reap+update commit cycle — the full mempool lifecycle under burst load.
+
+Prints ONE JSON line. Run from the repo root.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_TXS = int(os.environ.get("BENCH_N_TXS", "50000"))
+REAP = int(os.environ.get("BENCH_REAP", "10000"))
+
+
+def main() -> None:
+    from tendermint_tpu.abci.apps.counter import CounterApp
+    from tendermint_tpu.abci.client import LocalClient
+    from tendermint_tpu.config import test_config
+    from tendermint_tpu.mempool import Mempool
+    from tendermint_tpu.mempool.mempool import TxInCacheError
+    from tendermint_tpu.proxy.app_conn import AppConnMempool
+
+    cfg = test_config().mempool
+    cfg.root_dir = tempfile.mkdtemp(prefix="bench-mempool-")
+    app = CounterApp()
+    mp = Mempool(cfg, AppConnMempool(LocalClient(app, threading.RLock())))
+
+    txs = [b"%020d" % i for i in range(N_TXS)]
+
+    # -- burst: 50k CheckTx -----------------------------------------------
+    t0 = time.perf_counter()
+    for tx in txs:
+        mp.check_tx(tx)
+    burst_s = time.perf_counter() - t0
+    assert mp.size() == N_TXS, mp.size()
+
+    # duplicates bounce off the cache without app round-trips
+    t0 = time.perf_counter()
+    dup_hits = 0
+    for tx in txs[:REAP]:
+        try:
+            mp.check_tx(tx)
+        except TxInCacheError:
+            dup_hits += 1
+    dup_s = time.perf_counter() - t0
+    assert dup_hits == REAP
+
+    # -- commit cycle: reap a block's worth, update, recheck the rest -----
+    t0 = time.perf_counter()
+    reaped = mp.reap(REAP)
+    mp.update(1, reaped)
+    cycle_s = time.perf_counter() - t0
+    assert mp.size() == N_TXS - len(reaped)
+
+    print(
+        json.dumps(
+            {
+                "metric": "mempool_checktx_per_sec",
+                "value": round(N_TXS / burst_s, 1),
+                "unit": "txs/s",
+                "vs_baseline": 1.0,  # host-path bench: no reference numbers exist
+                "detail": {
+                    "burst_txs": N_TXS,
+                    "burst_s": round(burst_s, 3),
+                    "dup_reject_per_sec": round(REAP / dup_s, 1),
+                    "reap_update_s": round(cycle_s, 3),
+                    "reaped": len(reaped),
+                    "app": "counter(local)",
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

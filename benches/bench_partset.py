@@ -1,0 +1,108 @@
+"""BASELINE config 3: PartSet Merkle-root + SimpleProof verify.
+
+1 MB block split into 64 KB parts (the reference's defaults,
+types/part_set.go:95-122 + config defaults in BASELINE.md): per-block
+part-set construction — RIPEMD-160 per part + Merkle tree + per-part
+proofs — through the production TPU hashing gateway vs the pure-CPU
+path, with byte-identical headers asserted and every proof verified.
+
+Prints ONE JSON line like bench.py.
+Run from the repo root: python benches/bench_partset.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu.jitcache import enable as _enable_jit_cache
+
+_enable_jit_cache()
+
+BLOCK_MB = int(os.environ.get("BENCH_BLOCK_MB", "1"))
+PART_SIZE = int(os.environ.get("BENCH_PART_SIZE", str(64 * 1024)))
+N_BLOCKS = int(os.environ.get("BENCH_N_BLOCKS", "24"))
+
+
+def main() -> None:
+    import jax
+
+    from tendermint_tpu.ops.gateway import Hasher
+    from tendermint_tpu.types.part_set import PartSet
+
+    blocks = [
+        bytes([(i * 37 + j) & 0xFF for j in range(256)]) * (BLOCK_MB * 4096)
+        for i in range(4)
+    ]  # 4 distinct 1MB payloads, cycled
+    # production hasher: CPU by default (the measured winner for hashing;
+    # see the Hasher docstring), TPU offload kernels measured separately
+    prod = Hasher()
+    tpu = Hasher(min_tpu_batch=1, use_tpu=True)
+
+    # warmup / compile the offload kernel
+    warm = PartSet.from_data(blocks[0], PART_SIZE, hasher=tpu.part_leaf_hashes)
+
+    # -- plain CPU reference (no gateway) ---------------------------------
+    t0 = time.perf_counter()
+    cpu_sets = [
+        PartSet.from_data(blocks[i % 4], PART_SIZE) for i in range(N_BLOCKS)
+    ]
+    cpu_s = time.perf_counter() - t0
+
+    # -- production gateway path ------------------------------------------
+    t0 = time.perf_counter()
+    prod_sets = [
+        PartSet.from_data(blocks[i % 4], PART_SIZE, hasher=prod.part_leaf_hashes)
+        for i in range(N_BLOCKS)
+    ]
+    prod_s = time.perf_counter() - t0
+
+    # -- TPU offload kernel (per-block calls: the production shape) -------
+    t0 = time.perf_counter()
+    tpu_sets = [
+        PartSet.from_data(blocks[i % 4], PART_SIZE, hasher=tpu.part_leaf_hashes)
+        for i in range(N_BLOCKS)
+    ]
+    tpu_s = time.perf_counter() - t0
+
+    # -- parity + proof verification --------------------------------------
+    assert warm.header() == cpu_sets[0].header()
+    for c, p, t in zip(cpu_sets, prod_sets, tpu_sets):
+        assert c.header() == t.header() == p.header(), "part-set header mismatch"
+    ps = tpu_sets[0]
+    root = ps.header().hash
+    for i in range(ps.total):
+        part = ps.get_part(i)
+        assert part.proof.verify(i, ps.total, part.hash(), root), f"proof {i}"
+
+    mb = BLOCK_MB * N_BLOCKS
+    print(
+        json.dumps(
+            {
+                "metric": "partset_merkle_mb_per_sec",
+                "value": round(mb / prod_s, 2),
+                "unit": "MB/s",
+                "vs_baseline": round(cpu_s / prod_s, 2),
+                "detail": {
+                    "block_mb": BLOCK_MB,
+                    "part_kb": PART_SIZE // 1024,
+                    "n_blocks": N_BLOCKS,
+                    "cpu_mb_per_sec": round(mb / cpu_s, 2),
+                    "tpu_offload_mb_per_sec": round(mb / tpu_s, 2),
+                    "policy": "cpu-default (see gateway.Hasher docstring)",
+                    "platform": jax.devices()[0].platform,
+                    "offload_stats": tpu.stats(),
+                    "parity": "ok",
+                    "proofs": "verified",
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,67 @@
+"""Run every BASELINE.md config bench and record results in BENCHES.json.
+
+Configs (BASELINE.md):
+  1 testnet   — 4-validator kvstore net, commit-hash parity
+  2 headline  — VerifyCommit microbench (repo-root bench.py, driver-run)
+  3 partset   — 1MB/64KB PartSet Merkle + proofs
+  4 fastsync  — pipelined catch-up replay, 1000 validators
+  5 mempool   — 50k-tx CheckTx burst
+
+Each bench is its own process (the TPU is exclusive per process).
+Usage: python benches/run_all.py [--skip testnet,...]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BENCHES = {
+    "1_testnet": [sys.executable, "benches/bench_testnet.py"],
+    "2_verify_commit": [sys.executable, "bench.py"],
+    "3_partset": [sys.executable, "benches/bench_partset.py"],
+    "4_fastsync": [sys.executable, "benches/bench_fastsync.py"],
+    "5_mempool": [sys.executable, "benches/bench_mempool.py"],
+}
+
+
+def main() -> int:
+    skip = set()
+    for a in sys.argv[1:]:
+        if a.startswith("--skip"):
+            skip = set(a.split("=", 1)[1].split(","))
+    results: dict = {"recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    failed = False
+    for name, cmd in BENCHES.items():
+        if any(s in name for s in skip):
+            continue
+        print(f"== {name}: {' '.join(cmd[1:])}", file=sys.stderr)
+        t0 = time.time()
+        proc = subprocess.run(
+            cmd, cwd=ROOT, capture_output=True, text=True, timeout=1800
+        )
+        line = next(
+            (l for l in reversed(proc.stdout.splitlines()) if l.startswith("{")), None
+        )
+        if proc.returncode != 0 or line is None:
+            results[name] = {"error": (proc.stderr or proc.stdout)[-2000:]}
+            failed = True
+            print(f"   FAILED ({time.time()-t0:.0f}s)", file=sys.stderr)
+            continue
+        results[name] = json.loads(line)
+        print(f"   {line} ({time.time()-t0:.0f}s)", file=sys.stderr)
+    out = os.path.join(ROOT, "BENCHES.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=2)
+        f.write("\n")
+    print(f"wrote {out}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -188,6 +188,19 @@ class BlockPool(BaseService):
                 second.block if second else None,
             )
 
+    def peek_blocks(self, k: int) -> list:
+        """The contiguous run of downloaded blocks from the pool height,
+        up to k long (stops at the first gap) — the verify pipeline's
+        lookahead window."""
+        with self._mtx:
+            out = []
+            for h in range(self.height, self.height + k):
+                req = self.requesters.get(h)
+                if req is None or req.block is None:
+                    break
+                out.append(req.block)
+            return out
+
     def pop_request(self) -> None:
         with self._mtx:
             self.requesters.pop(self.height, None)

@@ -57,9 +57,13 @@ class BlockchainReactor(Reactor, BaseService):
         self.batch_verifier = batch_verifier
         self.async_batch_verifier = async_batch_verifier
         self.part_hasher = part_hasher
-        # single-slot lookahead: (block_hash, PartSet) built while the
-        # previous block's signature batch ran on the device
-        self._parts_ahead: tuple[bytes, object] | None = None
+        # speculative verify pipeline (see _dispatch_speculative): device
+        # batches in flight keyed by block hash -> (valset_hash, finish),
+        # plus the part sets hashed ahead for those blocks
+        self.pipeline_depth = 4
+        self.group_sig_target = 1024
+        self._inflight: dict[bytes, tuple[bytes, object]] = {}
+        self._parts_cache: dict[bytes, object] = {}
         self.pool = BlockPool(
             store.height() + 1,
             request_fn=self._send_block_request,
@@ -208,35 +212,78 @@ class BlockchainReactor(Reactor, BaseService):
             hasher=self.part_hasher,
         )
 
+    def _dispatch_speculative(self, window) -> None:
+        """Enqueue device verification for every downloaded block in the
+        window that isn't in flight yet. Dispatches are SPECULATIVE: they
+        use today's validator set, and each in-flight entry records that
+        set's hash — if applying an earlier block changes the set, the
+        head consume path sees the mismatch and re-verifies synchronously
+        (validator sets change rarely, so speculation almost always
+        lands). Keeping several batches in flight is what hides the
+        device/tunnel round-trip that a 1-deep pipeline pays per block."""
+        vhash = self.state.validators.hash()
+        entries, hashes = [], []
+        for blk, nxt in zip(window[:-1], window[1:]):
+            bh = blk.hash()
+            if bh in self._inflight:
+                continue
+            parts = self._parts_cache.get(bh)
+            if parts is None:
+                parts = self._parts_cache[bh] = self._make_parts(blk)
+            entries.append(
+                (BlockID(bh, parts.header()), blk.header.height, nxt.last_commit)
+            )
+            hashes.append(bh)
+        # Group commits into shared device calls up to ~group_sig_target
+        # signatures: chains with small validator sets (a few sigs per
+        # commit) would otherwise verify on CPU or underfill the kernel,
+        # while large commits already fill a call each — and keeping
+        # calls bounded lets consecutive dispatches overlap instead of
+        # serializing one giant transfer.
+        i = 0
+        while i < len(entries):
+            j, sigs = i, 0
+            while j < len(entries) and sigs < self.group_sig_target:
+                sigs += entries[j][2].size()
+                j += 1
+            # a structurally bad commit gets a finisher that re-raises at
+            # consume time (validator_set.verify_commits_async), so it
+            # cannot poison the rest of its group's dispatch
+            finishes = self.state.validators.verify_commits_async(
+                self.state.chain_id, entries[i:j], self.async_batch_verifier
+            )
+            for bh, finish in zip(hashes[i:j], finishes):
+                self._inflight[bh] = (vhash, finish)
+            i = j
+
     def _try_sync(self) -> bool:
         """Verify+apply one block; True if a block was consumed.
 
-        Pipelined when an async verifier is wired: block N's signature
-        batch runs on the device while the host hashes block N+1's part
-        set (which the next call consumes from the lookahead slot)."""
-        first, second = self.pool.peek_two_blocks()
-        if first is None or second is None:
-            return False
-        # rebuild the part set: the header's PartsHeader committed to it
-        if self._parts_ahead is not None and self._parts_ahead[0] == first.hash():
-            first_parts = self._parts_ahead[1]
+        Pipelined when an async verifier is wired: up to PIPELINE_DEPTH
+        blocks' signature batches run on the device concurrently with the
+        host hashing part sets and applying the head block."""
+        if self.async_batch_verifier is not None:
+            window = self.pool.peek_blocks(self.pipeline_depth + 1)
         else:
+            window = [b for b in self.pool.peek_two_blocks() if b is not None]
+        if len(window) < 2:
+            return False
+        first, second = window[0], window[1]
+        if self.async_batch_verifier is not None:
+            self._dispatch_speculative(window)
+        bh = first.hash()
+        # rebuild the part set: the header's PartsHeader committed to it
+        first_parts = self._parts_cache.pop(bh, None)
+        if first_parts is None:
             first_parts = self._make_parts(first)
-        self._parts_ahead = None
-        first_id = BlockID(first.hash(), first_parts.header())
+        first_id = BlockID(bh, first_parts.header())
         try:
-            if self.async_batch_verifier is not None:
-                finish = self.state.validators.verify_commit_async(
-                    self.state.chain_id,
-                    first_id,
-                    first.header.height,
-                    second.last_commit,
-                    self.async_batch_verifier,
-                )
-                # overlap device execution with the next block's hashing
-                self._parts_ahead = (second.hash(), self._make_parts(second))
-                finish()
+            entry = self._inflight.pop(bh, None)
+            if entry is not None and entry[0] == self.state.validators.hash():
+                entry[1]()  # raises exactly as verify_commit would
             else:
+                # no async verifier, or speculation used a stale validator
+                # set: verify synchronously against the current one
                 self.state.validators.verify_commit(
                     self.state.chain_id,
                     first_id,
@@ -246,7 +293,10 @@ class BlockchainReactor(Reactor, BaseService):
                 )
         except Exception as exc:  # noqa: BLE001 — bad block/commit
             self.logger.info("invalid block %d during fast sync: %s", first.header.height, exc)
-            self._parts_ahead = None
+            # drop all speculation: refetched blocks get fresh hashes, and
+            # second's (possibly forged) commit seeded later dispatches
+            self._inflight.clear()
+            self._parts_cache.clear()
             bad = self.pool.redo_request(first.header.height)
             # second's commit could also be forged; refetch it too
             self.pool.redo_request(second.header.height)

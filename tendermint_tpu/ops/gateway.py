@@ -224,11 +224,25 @@ class ShardedVerifier(Verifier):
 
 
 class Hasher:
-    """Batched hashing gateway for the PartSet/tx-tree hot paths."""
+    """Batched hashing gateway for the PartSet/tx-tree hot paths.
+
+    Policy (measured, v5e behind a tunnel, benches/bench_partset.py):
+    hashing is Merkle-Damgard-serial integer work — the opposite shape of
+    the MXU/VPU sweet spot — and CPU OpenSSL sustains ~190 MB/s/core
+    while the device kernel pays per-call dispatch + host->device bytes.
+    Measured ratios (CPU/TPU): 16x64KB parts 0.01, 256x64KB 0.07,
+    16384x128B leaves 0.16 — CPU wins every production shape. So unlike
+    the signature Verifier (11x on TPU), the hashing default is CPU;
+    set TENDERMINT_TPU_HASHES=1 (or use_tpu=True) to route wide batches
+    to the device kernels, e.g. on hosts where CPU cores, not chips, are
+    the scarce resource."""
 
     def __init__(self, min_tpu_batch: int = 16, use_tpu: bool | None = None):
         if use_tpu is None:
-            use_tpu = os.environ.get("TENDERMINT_TPU_DISABLE", "") == ""
+            use_tpu = (
+                os.environ.get("TENDERMINT_TPU_HASHES", "") == "1"
+                and os.environ.get("TENDERMINT_TPU_DISABLE", "") == ""
+            )
         self.min_tpu_batch = min_tpu_batch
         self._tpu_ok = use_tpu
         self._mtx = threading.Lock()

@@ -30,6 +30,7 @@ class ValidatorSet:
         self.validators: list[Validator] = vals
         self.proposer: Validator | None = None
         self._total_voting_power = 0
+        self._hash: bytes | None = None
         if validators:
             self.increment_accum(1)
 
@@ -95,6 +96,7 @@ class ValidatorSet:
     def _invalidate(self) -> None:
         self.proposer = None
         self._total_voting_power = 0
+        self._hash = None
 
     def add(self, val: Validator) -> bool:
         val = val.copy()
@@ -130,10 +132,14 @@ class ValidatorSet:
 
     def hash(self) -> bytes:
         """Merkle root of validator identity hashes
-        (types/validator_set.go:140-148)."""
+        (types/validator_set.go:140-148). Memoized: the fast-sync
+        speculation check reads it per block, and the O(N) tree over a
+        large set would otherwise rival the verify work it guards."""
         if not self.validators:
             return b""
-        return simple_hash_from_hashes([v.hash() for v in self.validators])
+        if self._hash is None:
+            self._hash = simple_hash_from_hashes([v.hash() for v in self.validators])
+        return self._hash
 
     # -- commit verification (TPU-batched hot path) ------------------------
 
@@ -187,6 +193,46 @@ class ValidatorSet:
 
         return finish
 
+    def verify_commits_async(self, chain_id: str, entries, async_batch_verifier):
+        """Grouped form of verify_commit_async: several commits' signature
+        batches concatenated into ONE device dispatch (a 1000-validator
+        commit underfills the kernel; four of them hit the efficient
+        bucket). entries = [(block_id, height, commit)]; returns one
+        zero-arg finisher per entry, each raising CommitError exactly as
+        verify_commit would for its block. Fast sync's speculative
+        pipeline is the caller (blockchain/reactor._dispatch_speculative)."""
+        spans, all_items = [], []
+        for block_id, height, commit in entries:
+            try:
+                items = self._commit_structural_check(chain_id, height, commit)
+            except CommitError as exc:
+                # a structurally bad commit must not poison its group: its
+                # finisher re-raises at consume time, where the caller's
+                # normal bad-block path adjudicates it
+                spans.append((block_id, exc, 0, 0))
+                continue
+            spans.append((block_id, items, len(all_items), len(all_items) + len(items)))
+            all_items.extend(
+                (val.pub_key.raw, sb, sig.raw) for _, _, val, sb, sig in items
+            )
+        resolve = async_batch_verifier(all_items)
+        memo: dict = {}
+
+        def resolved():
+            if "oks" not in memo:
+                memo["oks"] = resolve()
+            return memo["oks"]
+
+        def make_finish(block_id, items, lo, hi):
+            def finish() -> None:
+                if isinstance(items, CommitError):
+                    raise items
+                self._commit_tally(block_id, items, resolved()[lo:hi])
+
+            return finish
+
+        return [make_finish(*span) for span in spans]
+
     def _commit_structural_check(self, chain_id: str, height: int, commit):
         """Everything verify_commit checks before signatures; returns the
         signature work items (idx, precommit, validator, sign_bytes, sig)."""
@@ -199,6 +245,11 @@ class ValidatorSet:
 
         round_ = commit.round_()
         items = []
+        # sign bytes exclude the validator identity (canonical_json), so
+        # every precommit for the same (H,R,type,block) shares ONE byte
+        # string — memoizing turns N canonical serializations per commit
+        # into one, which dominated the fast-sync host time at N=1000
+        sb_cache: dict = {}
         for idx, precommit in enumerate(commit.precommits):
             if precommit is None:
                 continue  # validator skipped: fine
@@ -212,9 +263,13 @@ class ValidatorSet:
             assert val is not None
             if precommit.signature is None:
                 raise CommitError(f"missing signature at index {idx}")
-            items.append(
-                (idx, precommit, val, precommit.sign_bytes(chain_id), precommit.signature)
-            )
+            # keyed on the frozen BlockID itself: injective (unlike
+            # .key()'s unprefixed concatenation) and cheaper to build
+            sb_key = (precommit.height, precommit.round_, precommit.block_id)
+            sb = sb_cache.get(sb_key)
+            if sb is None:
+                sb = sb_cache[sb_key] = precommit.sign_bytes(chain_id)
+            items.append((idx, precommit, val, sb, precommit.signature))
         return items
 
     def _commit_tally(self, block_id: BlockID, items, oks) -> None:

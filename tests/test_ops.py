@@ -268,7 +268,9 @@ class TestGateway:
         assert not v.verify_one(pub, b"other", sig)
 
     def test_hasher_fallback_parity(self):
-        h_tpu = gateway.Hasher(min_tpu_batch=1)
+        # use_tpu=True explicitly: the Hasher default is CPU-only policy,
+        # which would make this kernel-parity check compare CPU to CPU
+        h_tpu = gateway.Hasher(min_tpu_batch=1, use_tpu=True)
         h_cpu = gateway.Hasher(min_tpu_batch=10**9)
         chunks = [b"c%d" % i * 50 for i in range(8)]
         assert h_tpu.part_leaf_hashes(chunks) == h_cpu.part_leaf_hashes(chunks)

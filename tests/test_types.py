@@ -528,3 +528,35 @@ class TestSignBytesFormat:
             b'{"chain_id":"test","vote":{"block_id":{"parts":{"hash":"","total":0}},'
             b'"height":1,"round":0,"type":1}}'
         )
+
+
+class TestVerifyCommitsGrouped:
+    def _mk(self, vs, privs, height, block_id, n_sign=None):
+        voteset = VoteSet("test-chain", height, 0, VOTE_TYPE_PRECOMMIT, vs)
+        for p in privs[: n_sign if n_sign is not None else len(privs)]:
+            voteset.add_vote(
+                signed_vote(p, vs, height, 0, VOTE_TYPE_PRECOMMIT, block_id)
+            )
+        return voteset.make_commit()
+
+    def test_grouped_async_and_poisoned_entry(self):
+        """verify_commits_async: one shared dispatch, per-entry finishers;
+        a structurally bad commit raises from ITS finisher only."""
+        from tendermint_tpu.ops.gateway import Verifier
+
+        vs, privs = make_val_set(4, power=1)
+        v = Verifier(min_tpu_batch=1, use_tpu=True)
+        good1 = self._mk(vs, privs, 1, BLOCK_ID)
+        bad = self._mk(vs, privs, 2, BLOCK_ID)  # wrong height vs entry
+        good2 = self._mk(vs, privs, 3, BLOCK_ID)
+        fins = vs.verify_commits_async(
+            "test-chain",
+            [(BLOCK_ID, 1, good1), (BLOCK_ID, 99, bad), (BLOCK_ID, 3, good2)],
+            v.verify_batch_async,
+        )
+        assert len(fins) == 3
+        fins[0]()  # no raise
+        with pytest.raises(CommitError, match="height"):
+            fins[1]()
+        fins[2]()  # the bad entry did not poison this one
+        assert v.stats()["tpu_sigs"] == 8  # both good commits, one batch set
