@@ -397,38 +397,44 @@ class ABCIServer(BaseService):
         self._server.server_close()
 
     def _dispatch(self, req: dict) -> dict:
-        t = req["type"]
-        app = self.app
         with self._app_mtx:
-            if t == "echo":
-                return {"value": req.get("msg", "")}
-            if t == "flush":
-                return {"value": None}
-            if t == "info":
-                return {"value": app.info().to_json()}
-            if t == "set_option":
-                return {"value": app.set_option(req["key"], req["value"])}
-            if t == "query":
-                return {
-                    "value": app.query(
-                        bytes.fromhex(req.get("data", "")),
-                        req.get("path", ""),
-                        req.get("height", 0),
-                        req.get("prove", False),
-                    ).to_json()
-                }
-            if t == "check_tx":
-                return {"value": app.check_tx(bytes.fromhex(req["tx"])).to_json(), "_tx": req["tx"]}
-            if t == "deliver_tx":
-                return {"value": app.deliver_tx(bytes.fromhex(req["tx"])).to_json(), "_tx": req["tx"]}
-            if t == "init_chain":
-                app.init_chain([ABCIValidator.from_json(v) for v in req.get("validators", [])])
-                return {"value": None}
-            if t == "begin_block":
-                app.begin_block(bytes.fromhex(req["hash"]), Header.from_json(req["header"]))
-                return {"value": None}
-            if t == "end_block":
-                return {"value": app.end_block(req["height"]).to_json()}
-            if t == "commit":
-                return {"value": app.commit().to_json()}
-        return {"value": None, "error": f"unknown request {t}"}
+            return dispatch_request(self.app, req)
+
+
+def dispatch_request(app: Application, req: dict) -> dict:
+    """One ABCI request (the JSON wire dicts SocketClient/GRPCClient
+    build) against an Application. Caller holds the app mutex. Shared by
+    the socket server and the gRPC server (abci/grpc.py)."""
+    t = req["type"]
+    if t == "echo":
+        return {"value": req.get("msg", "")}
+    if t == "flush":
+        return {"value": None}
+    if t == "info":
+        return {"value": app.info().to_json()}
+    if t == "set_option":
+        return {"value": app.set_option(req["key"], req["value"])}
+    if t == "query":
+        return {
+            "value": app.query(
+                bytes.fromhex(req.get("data", "")),
+                req.get("path", ""),
+                req.get("height", 0),
+                req.get("prove", False),
+            ).to_json()
+        }
+    if t == "check_tx":
+        return {"value": app.check_tx(bytes.fromhex(req["tx"])).to_json(), "_tx": req["tx"]}
+    if t == "deliver_tx":
+        return {"value": app.deliver_tx(bytes.fromhex(req["tx"])).to_json(), "_tx": req["tx"]}
+    if t == "init_chain":
+        app.init_chain([ABCIValidator.from_json(v) for v in req.get("validators", [])])
+        return {"value": None}
+    if t == "begin_block":
+        app.begin_block(bytes.fromhex(req["hash"]), Header.from_json(req["header"]))
+        return {"value": None}
+    if t == "end_block":
+        return {"value": app.end_block(req["height"]).to_json()}
+    if t == "commit":
+        return {"value": app.commit().to_json()}
+    return {"value": None, "error": f"unknown request {t}"}

@@ -74,6 +74,8 @@ def cmd_node(args) -> int:
         cfg.p2p.laddr = args.p2p_laddr
     if args.rpc_laddr:
         cfg.rpc.laddr = args.rpc_laddr
+    if args.grpc_laddr:
+        cfg.rpc.grpc_laddr = args.grpc_laddr
     if args.seeds:
         cfg.p2p.seeds = args.seeds
     if args.pex:
@@ -210,6 +212,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--fast_sync", action="store_true", default=None)
     sp.add_argument("--p2p.laddr", dest="p2p_laddr", default=None)
     sp.add_argument("--rpc.laddr", dest="rpc_laddr", default=None)
+    sp.add_argument("--rpc.grpc_laddr", dest="grpc_laddr", default=None)
     sp.add_argument("--seeds", default=None, help="comma-separated host:port")
     sp.add_argument("--pex", action="store_true")
     sp.add_argument("--log_level", default="info")

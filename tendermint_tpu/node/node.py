@@ -53,7 +53,9 @@ def default_new_node(config) -> "Node":
     return Node(
         config,
         priv_validator,
-        default_client_creator(config.base.proxy_app, config.base.db_dir()),
+        default_client_creator(
+            config.base.proxy_app, config.base.db_dir(), transport=config.base.abci
+        ),
     )
 
 
@@ -185,6 +187,7 @@ class Node(BaseService):
         self.state = state
         self.listener: Listener | None = None
         self.rpc_server = None
+        self.grpc_server = None
 
     # -- lifecycle (node.go:310-352) --------------------------------------
 
@@ -217,8 +220,12 @@ class Node(BaseService):
 
         if self.config.rpc.laddr:
             self._start_rpc()
+        if self.config.rpc.grpc_laddr:
+            self._start_grpc()
 
     def on_stop(self) -> None:
+        if self.grpc_server is not None:
+            self.grpc_server.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()
         self.sw.stop()
@@ -226,11 +233,10 @@ class Node(BaseService):
         self.proxy_app.stop()
         self.evsw.stop()
 
-    def _start_rpc(self) -> None:
+    def _rpc_context(self):
         from tendermint_tpu.rpc.core.pipe import RPCContext
-        from tendermint_tpu.rpc.server import RPCServer
 
-        ctx = RPCContext(
+        return RPCContext(
             event_switch=self.evsw,
             block_store=self.block_store,
             consensus_state=self.consensus_state,
@@ -242,10 +248,26 @@ class Node(BaseService):
             tx_indexer=self.tx_indexer,
             node=self,
         )
+
+    def _start_rpc(self) -> None:
+        from tendermint_tpu.rpc.server import RPCServer
+
         self.rpc_server = RPCServer(
-            _parse_laddr(self.config.rpc.laddr), ctx, unsafe=self.config.rpc.unsafe
+            _parse_laddr(self.config.rpc.laddr),
+            self._rpc_context(),
+            unsafe=self.config.rpc.unsafe,
         )
         self.rpc_server.start()
+
+    def _start_grpc(self) -> None:
+        """BroadcastAPI port (rpc/grpc/api.go:14; node wiring
+        node.go:341-345)."""
+        from tendermint_tpu.rpc.grpc import GRPCBroadcastServer
+
+        self.grpc_server = GRPCBroadcastServer(
+            _parse_laddr(self.config.rpc.grpc_laddr), self._rpc_context()
+        )
+        self.grpc_server.start()
 
     # -- introspection ------------------------------------------------------
 

@@ -25,17 +25,26 @@ class LocalClientCreator(ClientCreator):
 
 
 class RemoteClientCreator(ClientCreator):
-    def __init__(self, addr: str, must_connect: bool = True):
+    """Remote app: `transport` picks the wire — "socket" (pipelined
+    JSON-lines, the fast default) or "grpc" (proxy/client.go:40-58)."""
+
+    def __init__(self, addr: str, must_connect: bool = True, transport: str = "socket"):
         self.addr = addr
         self.must_connect = must_connect
+        self.transport = transport
 
     def new_abci_client(self) -> ABCIClient:
+        if self.transport == "grpc":
+            from tendermint_tpu.abci.grpc import GRPCClient
+
+            return GRPCClient(self.addr)
         return SocketClient(self.addr)
 
 
-def default_client_creator(addr: str, db_dir: str = ".") -> ClientCreator:
+def default_client_creator(addr: str, db_dir: str = ".", transport: str = "socket") -> ClientCreator:
     """Name-or-address dispatch (proxy/client.go:64-76): known app names
-    create in-process apps; anything else is a TCP address."""
+    create in-process apps; anything else is a TCP address reached over
+    `transport` (the config's `abci: socket | grpc`)."""
     from tendermint_tpu.abci.apps import CounterApp, KVStoreApp, NilApp, PersistentKVStoreApp
 
     if addr in ("kvstore", "dummy"):
@@ -48,4 +57,4 @@ def default_client_creator(addr: str, db_dir: str = ".") -> ClientCreator:
         return LocalClientCreator(CounterApp(serial=True))
     if addr == "nilapp":
         return LocalClientCreator(NilApp())
-    return RemoteClientCreator(addr)
+    return RemoteClientCreator(addr, transport=transport)
