@@ -187,6 +187,22 @@ def cmd_version(args) -> int:
     return 0
 
 
+def cmd_probe_upnp(args) -> int:
+    """Probe the local network for UPnP port-mapping support
+    (cmd/tendermint/main.go:29, p2p/upnp/probe.go)."""
+    import json as _json
+
+    from tendermint_tpu.p2p import upnp
+
+    try:
+        caps = upnp.probe()
+        print(_json.dumps({"port_mapping": caps.port_mapping, "hairpin": caps.hairpin}))
+        return 0
+    except Exception as exc:  # noqa: BLE001 — a probe never tracebacks
+        print(_json.dumps({"error": str(exc)}))
+        return 1
+
+
 # -- parser -------------------------------------------------------------------
 
 
@@ -244,6 +260,9 @@ def build_parser() -> argparse.ArgumentParser:
         fn=lambda a: cmd_replay(a, console=True)
     )
     sub.add_parser("version", help="print the version").set_defaults(fn=cmd_version)
+    sub.add_parser(
+        "probe_upnp", help="probe the network for UPnP port-mapping support"
+    ).set_defaults(fn=cmd_probe_upnp)
     return p
 
 

@@ -3,10 +3,11 @@ the go-crypto equivalent (reference usage: types/validator.go:75-86,
 types/priv_validator.go).
 
 Wire shape kept from go-crypto: a key/signature serializes as a 1-byte type
-tag followed by the raw bytes; an address is ripemd160(tag || raw_pubkey).
-Ed25519 is the validator key type (type byte 0x01); Secp256k1 (0x02) is
-reserved and unimplemented here, gated the way the reference gates unused
-key types.
+tag followed by the raw bytes. Ed25519 (type byte 0x01) is the primary
+validator key type with TPU-batched verification; Secp256k1 (0x02) is the
+account-style second key type — bitcoin-shaped addresses
+(ripemd160(sha256(compressed point))) and DER ECDSA signatures, verified
+on CPU (see crypto/secp256k1.py for why it stays off the device).
 """
 
 from __future__ import annotations
@@ -114,6 +115,115 @@ class PrivKeyEd25519:
         return cls(bytes.fromhex(obj[1]))
 
 
+@dataclass(frozen=True)
+class SignatureSecp256k1:
+    raw: bytes  # DER, variable length (~70-72 bytes)
+
+    TYPE = TYPE_SECP256K1
+
+    def __post_init__(self):
+        if not 8 <= len(self.raw) <= 80:
+            raise ValueError("implausible secp256k1 DER signature length")
+
+    def bytes_(self) -> bytes:
+        return bytes([self.TYPE]) + self.raw
+
+    def to_json(self):
+        return [self.TYPE, self.raw.hex().upper()]
+
+    @classmethod
+    def from_json(cls, obj) -> "SignatureSecp256k1":
+        if not isinstance(obj, (list, tuple)) or len(obj) != 2 or obj[0] != TYPE_SECP256K1:
+            raise ValueError(f"unknown signature encoding {obj!r}")
+        if not isinstance(obj[1], str) or len(obj[1]) > 160:
+            raise ValueError("bad signature hex")
+        return cls(bytes.fromhex(obj[1]))
+
+
+@dataclass(frozen=True)
+class PubKeySecp256k1:
+    raw: bytes  # 33-byte compressed SEC1 point
+
+    TYPE = TYPE_SECP256K1
+
+    def __post_init__(self):
+        if len(self.raw) != 33:
+            raise ValueError("secp256k1 pubkey must be 33 bytes (compressed)")
+
+    def address(self) -> bytes:
+        """Bitcoin-shaped: ripemd160(sha256(compressed point)) — the
+        go-crypto PubKeySecp256k1.Address derivation
+        (types/validator.go:75-86 consumes it opaquely)."""
+        import hashlib
+
+        from tendermint_tpu.crypto.hashing import ripemd160 as _r160
+
+        return _r160(hashlib.sha256(self.raw).digest())
+
+    def bytes_(self) -> bytes:
+        return bytes([self.TYPE]) + self.raw
+
+    def verify_bytes(self, msg: bytes, sig) -> bool:
+        from tendermint_tpu.crypto import secp256k1
+
+        if not isinstance(sig, SignatureSecp256k1):
+            return False
+        return secp256k1.verify(self.raw, msg, sig.raw)
+
+    def to_json(self):
+        return [self.TYPE, self.raw.hex().upper()]
+
+    @classmethod
+    def from_json(cls, obj) -> "PubKeySecp256k1":
+        if obj[0] != TYPE_SECP256K1:
+            raise ValueError(f"unknown pubkey type {obj[0]}")
+        return cls(bytes.fromhex(obj[1]))
+
+    def __hash__(self):
+        return hash(self.raw)
+
+
+@dataclass(frozen=True)
+class PrivKeySecp256k1:
+    raw: bytes  # 32-byte big-endian scalar
+
+    TYPE = TYPE_SECP256K1
+
+    def __post_init__(self):
+        if len(self.raw) != 32:
+            raise ValueError("secp256k1 privkey must be 32 bytes")
+
+    def pub_key(self) -> PubKeySecp256k1:
+        from tendermint_tpu.crypto import secp256k1
+
+        return PubKeySecp256k1(secp256k1.public_key(self.raw))
+
+    def sign(self, msg: bytes) -> SignatureSecp256k1:
+        from tendermint_tpu.crypto import secp256k1
+
+        return SignatureSecp256k1(secp256k1.sign(self.raw, msg))
+
+    def bytes_(self) -> bytes:
+        return bytes([self.TYPE]) + self.raw
+
+    def to_json(self):
+        return [self.TYPE, self.raw.hex().upper()]
+
+    @classmethod
+    def from_json(cls, obj) -> "PrivKeySecp256k1":
+        if obj[0] != TYPE_SECP256K1:
+            raise ValueError(f"unknown privkey type {obj[0]}")
+        return cls(bytes.fromhex(obj[1]))
+
+
+def gen_priv_key_secp256k1(seed: bytes | None = None) -> PrivKeySecp256k1:
+    from tendermint_tpu.crypto import secp256k1
+
+    if seed is None:
+        return PrivKeySecp256k1(secp256k1.gen_secret())
+    return PrivKeySecp256k1(secp256k1.secret_from_seed(seed))
+
+
 def gen_priv_key_ed25519(seed: bytes | None = None) -> PrivKeyEd25519:
     """Random key, or a key derived from secret material. The secret is
     ALWAYS sha256-hashed regardless of its length (go-crypto
@@ -127,6 +237,45 @@ def gen_priv_key_ed25519(seed: bytes | None = None) -> PrivKeyEd25519:
 
 
 def pub_key_from_json(obj):
+    if not isinstance(obj, (list, tuple)) or len(obj) != 2:
+        raise ValueError(f"unknown pubkey encoding {obj!r}")
     if obj[0] == TYPE_ED25519:
         return PubKeyEd25519.from_json(obj)
+    if obj[0] == TYPE_SECP256K1:
+        return PubKeySecp256k1.from_json(obj)
     raise ValueError(f"unknown pubkey type {obj[0]}")
+
+
+def priv_key_from_json(obj):
+    if not isinstance(obj, (list, tuple)) or len(obj) != 2:
+        raise ValueError(f"unknown privkey encoding {obj!r}")
+    if obj[0] == TYPE_ED25519:
+        return PrivKeyEd25519.from_json(obj)
+    if obj[0] == TYPE_SECP256K1:
+        return PrivKeySecp256k1.from_json(obj)
+    raise ValueError(f"unknown privkey type {obj[0]}")
+
+
+def signature_from_json(obj):
+    if not isinstance(obj, (list, tuple)) or len(obj) != 2:
+        raise ValueError(f"unknown signature encoding {obj!r}")
+    if obj[0] == TYPE_ED25519:
+        return SignatureEd25519.from_json(obj)
+    if obj[0] == TYPE_SECP256K1:
+        return SignatureSecp256k1.from_json(obj)
+    raise ValueError(f"unknown signature type {obj[0]}")
+
+
+def verify_any(pubkey_bytes: bytes, msg: bytes, sig_bytes: bytes) -> bool:
+    """Raw-bytes verification dispatching on key shape (32 = ed25519 seed
+    point, 33 = compressed secp256k1). The CPU half of the gateway: batch
+    items carry raw bytes, not typed objects."""
+    if len(pubkey_bytes) == 32:
+        from tendermint_tpu.crypto import ed25519
+
+        return ed25519.verify(pubkey_bytes, msg, sig_bytes)
+    if len(pubkey_bytes) == 33:
+        from tendermint_tpu.crypto import secp256k1
+
+        return secp256k1.verify(pubkey_bytes, msg, sig_bytes)
+    return False

@@ -1,0 +1,90 @@
+"""secp256k1 ECDSA keys (go-crypto's second key type; reference usage
+types/validator.go:75-86 — any crypto.PubKey can be a validator key).
+
+Backed by the `cryptography` package (OpenSSL). Wire shapes:
+- private key: the 32-byte big-endian scalar;
+- public key: 33-byte compressed SEC1 point;
+- signature: ASN.1/DER ECDSA over SHA-256 of the message (variable
+  length, ~70-72 bytes), low-s normalized so a third party cannot
+  malleate a stored signature into a "different" valid one.
+
+secp256k1 stays a CPU key type: ECDSA's per-signature modular inversion
+and point recovery don't map onto the MXU the way the ed25519 batch
+equation does, and validator sets are expected to be ed25519 (the
+reference ships secp256k1 primarily for account keys). The gateway
+partitions batches by key type and routes these to this module.
+"""
+
+from __future__ import annotations
+
+import os
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature,
+    encode_dss_signature,
+)
+
+_CURVE = ec.SECP256K1()
+# group order n (SEC2): signatures are normalized to s <= n//2
+_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+
+def gen_secret() -> bytes:
+    """A uniformly random 32-byte scalar in [1, n-1]."""
+    while True:
+        d = int.from_bytes(os.urandom(32), "big")
+        if 1 <= d < _N:
+            return d.to_bytes(32, "big")
+
+
+def secret_from_seed(seed: bytes) -> bytes:
+    """Deterministic scalar from secret material (sha256-folded like
+    gen_priv_key_ed25519; re-hash on the negligible out-of-range case)."""
+    import hashlib
+
+    d = seed
+    while True:
+        d = hashlib.sha256(d).digest()
+        v = int.from_bytes(d, "big")
+        if 1 <= v < _N:
+            return d
+
+
+def _priv(secret32: bytes) -> ec.EllipticCurvePrivateKey:
+    return ec.derive_private_key(int.from_bytes(secret32, "big"), _CURVE)
+
+
+def public_key(secret32: bytes) -> bytes:
+    """33-byte compressed SEC1 public point."""
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        PublicFormat,
+    )
+
+    return _priv(secret32).public_key().public_bytes(
+        Encoding.X962, PublicFormat.CompressedPoint
+    )
+
+
+def sign(secret32: bytes, msg: bytes) -> bytes:
+    """DER ECDSA-SHA256 signature, low-s normalized."""
+    der = _priv(secret32).sign(msg, ec.ECDSA(hashes.SHA256()))
+    r, s = decode_dss_signature(der)
+    if s > _N // 2:
+        s = _N - s
+    return encode_dss_signature(r, s)
+
+
+def verify(pub33: bytes, msg: bytes, sig_der: bytes) -> bool:
+    try:
+        pub = ec.EllipticCurvePublicKey.from_encoded_point(_CURVE, pub33)
+        r, s = decode_dss_signature(sig_der)
+        if not (1 <= r < _N and 1 <= s <= _N // 2):
+            return False  # reject high-s (malleability) and degenerate sigs
+        pub.verify(encode_dss_signature(r, s), msg, ec.ECDSA(hashes.SHA256()))
+        return True
+    except (InvalidSignature, ValueError):
+        return False

@@ -196,7 +196,10 @@ class Node(BaseService):
 
         # p2p listener
         if self.config.p2p.laddr:
-            self.listener = Listener(_parse_laddr(self.config.p2p.laddr))
+            self.listener = Listener(
+                _parse_laddr(self.config.p2p.laddr),
+                skip_upnp=self.config.p2p.skip_upnp,
+            )
             self.sw.add_listener(self.listener)
 
         info = NodeInfo(

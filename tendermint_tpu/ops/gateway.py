@@ -26,14 +26,31 @@ import threading
 import numpy as np
 
 from tendermint_tpu.crypto import ed25519 as ed_cpu
+from tendermint_tpu.crypto.keys import verify_any
 
 logger = logging.getLogger("ops.gateway")
 
-Item = tuple[bytes, bytes, bytes]  # (pubkey32, message, signature64)
+Item = tuple[bytes, bytes, bytes]  # (pubkey, message, signature)
 
 
 def _cpu_verify_batch(items: list[Item]) -> list[bool]:
-    return [ed_cpu.verify(pk, msg, sig) for pk, msg, sig in items]
+    return [verify_any(pk, msg, sig) for pk, msg, sig in items]
+
+
+def _split_by_key_type(items: list[Item]):
+    """(ed25519 items, their positions, other items, their positions).
+    The kernel is ed25519-only; secp256k1 (33-byte pubkeys) and anything
+    malformed verify on CPU (crypto/secp256k1.py explains why ECDSA
+    stays off the device)."""
+    ed_items, ed_pos, other_items, other_pos = [], [], [], []
+    for i, it in enumerate(items):
+        if len(it[0]) == 32 and len(it[2]) == 64:
+            ed_items.append(it)
+            ed_pos.append(i)
+        else:
+            other_items.append(it)
+            other_pos.append(i)
+    return ed_items, ed_pos, other_items, other_pos
 
 
 class Verifier:
@@ -58,6 +75,22 @@ class Verifier:
         n = len(items)
         if n == 0:
             return []
+        ed_items, ed_pos, other_items, other_pos = _split_by_key_type(items)
+        if other_items and ed_items:
+            # mixed key types: kernel for the ed25519 lanes, CPU for the
+            # rest, results re-interleaved in order
+            out: list = [None] * n
+            for p, ok in zip(ed_pos, self.verify_batch(ed_items)):
+                out[p] = ok
+            for p, ok in zip(other_pos, _cpu_verify_batch(other_items)):
+                out[p] = ok
+            with self._mtx:
+                self._stats["cpu_sigs"] += len(other_items)
+            return out
+        if other_items:  # nothing for the kernel at all
+            with self._mtx:
+                self._stats["cpu_sigs"] += n
+            return _cpu_verify_batch(items)
         if self._tpu_ok and n >= self.min_tpu_batch:
             try:
                 # fp32 radix-2^8 conv kernel: the production path on every
@@ -87,6 +120,21 @@ class Verifier:
         n = len(items)
         if n == 0:
             return lambda: []
+        ed_items, ed_pos, other_items, other_pos = _split_by_key_type(items)
+        if other_items:
+            inner = self.verify_batch_async(ed_items) if ed_items else (lambda: [])
+            with self._mtx:
+                self._stats["cpu_sigs"] += len(other_items)
+
+            def resolve_mixed():
+                out: list = [None] * n
+                for p, ok in zip(ed_pos, inner()):
+                    out[p] = bool(ok)
+                for p, ok in zip(other_pos, _cpu_verify_batch(other_items)):
+                    out[p] = ok
+                return out
+
+            return resolve_mixed
         if self._tpu_ok and n >= self.min_tpu_batch:
             try:
                 from tendermint_tpu.ops import ed25519_f32 as ops_ed
@@ -133,7 +181,7 @@ class Verifier:
             return primed
         with self._mtx:
             self._stats["cpu_sigs"] += 1
-        return ed_cpu.verify(pubkey, msg, sig)
+        return verify_any(pubkey, msg, sig)
 
     def prime_cache(self, items: list[Item]) -> None:
         """Batch-verify now (TPU when wide enough) and stash per-item
@@ -192,6 +240,10 @@ class ShardedVerifier(Verifier):
         n = len(items)
         if n == 0:
             return []
+        if any(len(it[0]) != 32 or len(it[2]) != 64 for it in items):
+            # mixed key types: the base partitions and re-enters here with
+            # the pure-ed25519 lanes; secp256k1 verifies on CPU
+            return super().verify_batch(items)
         if not self._tpu_ok or n < self.min_tpu_batch:
             return super().verify_batch(items)
         try:

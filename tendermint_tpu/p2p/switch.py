@@ -66,6 +66,9 @@ class Switch(BaseService):
         self.filter_conn_by_addr = None  # callables raising on rejection
         self.filter_conn_by_pubkey = None
         self._reconnecting: set[str] = set()
+        from tendermint_tpu.p2p.ip_range_counter import IPRangeCounter
+
+        self.ip_ranges = IPRangeCounter()
         self._mtx = threading.Lock()
 
     # -- registry (before start) ------------------------------------------
@@ -155,10 +158,27 @@ class Switch(BaseService):
             except OSError:
                 pass
             return
+        # per-IP-range cap (ip_range_counter): counted pre-handshake so a
+        # single subnet can't flood the handshake threads either
+        ip = ""
         try:
-            self.add_peer_from_stream(SocketStream(sock), outbound=False)
+            ip = sock.getpeername()[0]
+        except OSError:
+            pass
+        if ip and not self.ip_ranges.try_add(ip):
+            self.logger.info("rejecting inbound peer %s: IP range at limit", ip)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        stream = SocketStream(sock)
+        stream.counted_ip = ip
+        try:
+            self.add_peer_from_stream(stream, outbound=False)
         except Exception as exc:  # noqa: BLE001 — one bad peer can't kill accept
             self.logger.info("inbound peer rejected: %s", exc)
+            self._uncount_stream(stream)
             try:
                 sock.close()
             except OSError:
@@ -291,7 +311,19 @@ class Switch(BaseService):
 
     # -- removal / errors ---------------------------------------------------
 
+    def _uncount_stream(self, stream) -> None:
+        """Release an inbound stream's IP-range count exactly once: the
+        error path in _accept_peer and peer removal can race (a started
+        peer may die while add_peer is still unwinding), and a double
+        decrement would steal counts from other live peers."""
+        with self._mtx:
+            ip = getattr(stream, "counted_ip", "")
+            stream.counted_ip = ""
+        if ip:
+            self.ip_ranges.remove(ip)
+
     def _stop_and_remove(self, peer: Peer, reason) -> None:
+        self._uncount_stream(peer.stream)
         self.peers.remove(peer)
         peer.stop()
         for reactor in self.reactors.values():

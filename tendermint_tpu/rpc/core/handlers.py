@@ -318,6 +318,49 @@ def unsafe_flush_mempool(ctx) -> dict:
     return {}
 
 
+# -- profiler API (rpc/core/routes.go:42-45): the pprof equivalents are
+# cProfile for CPU and tracemalloc for heap ----------------------------------
+
+_profiler_state: dict = {"profiler": None}
+
+
+def unsafe_start_cpu_profiler(ctx, filename) -> dict:
+    import cProfile
+
+    if _profiler_state["profiler"] is not None:
+        raise RPCError("cpu profiler already running")
+    prof = cProfile.Profile()
+    prof.enable()
+    _profiler_state["profiler"] = (prof, str(filename))
+    return {}
+
+
+def unsafe_stop_cpu_profiler(ctx) -> dict:
+    entry = _profiler_state["profiler"]
+    if entry is None:
+        raise RPCError("cpu profiler not running")
+    prof, filename = entry
+    prof.disable()
+    prof.dump_stats(filename)
+    _profiler_state["profiler"] = None
+    return {"log": f"profile written to {filename}"}
+
+
+def unsafe_write_heap_profile(ctx, filename) -> dict:
+    import tracemalloc
+
+    started_here = not tracemalloc.is_tracing()
+    if started_here:
+        # no baseline was running: a point-in-time snapshot still captures
+        # allocations made from here on; start tracing for next time
+        tracemalloc.start()
+    snap = tracemalloc.take_snapshot()
+    with open(str(filename), "w") as f:
+        for stat in snap.statistics("lineno")[:200]:
+            f.write(f"{stat}\n")
+    return {"log": f"heap profile written to {filename}"}
+
+
 ROUTES_TABLE = {
     # info API
     "status": (status, []),
@@ -343,4 +386,8 @@ ROUTES_TABLE = {
 UNSAFE_ROUTES_TABLE = {
     "unsafe_dial_seeds": (unsafe_dial_seeds, ["seeds"]),
     "unsafe_flush_mempool": (unsafe_flush_mempool, []),
+    # profiler API (rpc/core/routes.go:42-45)
+    "unsafe_start_cpu_profiler": (unsafe_start_cpu_profiler, ["filename"]),
+    "unsafe_stop_cpu_profiler": (unsafe_stop_cpu_profiler, []),
+    "unsafe_write_heap_profile": (unsafe_write_heap_profile, ["filename"]),
 }

@@ -5,7 +5,7 @@ from __future__ import annotations
 import json
 from dataclasses import dataclass, field
 
-from tendermint_tpu.crypto.keys import PubKeyEd25519
+from tendermint_tpu.crypto.keys import PubKeyEd25519, pub_key_from_json
 from tendermint_tpu.types.params import ConsensusParams
 
 
@@ -20,7 +20,7 @@ class GenesisValidator:
 
     @classmethod
     def from_json(cls, obj) -> "GenesisValidator":
-        return cls(PubKeyEd25519.from_json(obj["pub_key"]), obj["power"], obj.get("name", ""))
+        return cls(pub_key_from_json(obj["pub_key"]), obj["power"], obj.get("name", ""))
 
 
 @dataclass

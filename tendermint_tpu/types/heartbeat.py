@@ -7,7 +7,7 @@ from __future__ import annotations
 from dataclasses import dataclass, replace
 
 from tendermint_tpu.codec.canonical import canonical_dumps
-from tendermint_tpu.crypto.keys import SignatureEd25519
+from tendermint_tpu.crypto.keys import SignatureEd25519, signature_from_json
 
 
 @dataclass(frozen=True)
@@ -55,5 +55,5 @@ class Heartbeat:
             jv.int_field(obj, "height", 0, jv.MAX_HEIGHT),
             jv.int_field(obj, "round", 0, jv.MAX_ROUND),
             jv.int_field(obj, "sequence", 0, jv.MAX_ROUND),
-            SignatureEd25519.from_json(obj["signature"]) if obj.get("signature") else None,
+            signature_from_json(obj["signature"]) if obj.get("signature") else None,
         )

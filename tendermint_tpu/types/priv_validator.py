@@ -21,6 +21,8 @@ from tendermint_tpu.crypto.keys import (
     PubKeyEd25519,
     SignatureEd25519,
     gen_priv_key_ed25519,
+    priv_key_from_json,
+    signature_from_json,
 )
 from tendermint_tpu.types.heartbeat import Heartbeat
 from tendermint_tpu.types.proposal import Proposal
@@ -87,12 +89,12 @@ class PrivValidatorFS(PrivValidator):
     def load(cls, file_path: str) -> "PrivValidatorFS":
         with open(file_path) as f:
             obj = json.load(f)
-        pv = cls(PrivKeyEd25519.from_json(obj["priv_key"]), file_path)
+        pv = cls(priv_key_from_json(obj["priv_key"]), file_path)
         pv.last_height = obj.get("last_height", 0)
         pv.last_round = obj.get("last_round", 0)
         pv.last_step = obj.get("last_step", STEP_NONE)
         if obj.get("last_signature"):
-            pv.last_signature = SignatureEd25519.from_json(obj["last_signature"])
+            pv.last_signature = signature_from_json(obj["last_signature"])
         if obj.get("last_signbytes"):
             pv.last_sign_bytes = bytes.fromhex(obj["last_signbytes"])
         return pv

@@ -8,7 +8,11 @@ from dataclasses import dataclass, replace
 
 from tendermint_tpu.codec.binary import Decoder, Encoder
 from tendermint_tpu.codec.canonical import canonical_dumps
-from tendermint_tpu.crypto.keys import SignatureEd25519
+from tendermint_tpu.crypto.keys import (
+    SignatureEd25519,
+    SignatureSecp256k1,
+    signature_from_json,
+)
 from tendermint_tpu.types.block_id import BlockID, PartSetHeader
 
 
@@ -45,8 +49,11 @@ class Proposal:
         self.pol_block_id.encode(e)
         if self.signature is None:
             e.write_u8(0)
+        elif self.signature.TYPE == SignatureEd25519.TYPE:
+            e.write_raw(self.signature.bytes_())  # fixed 64-byte body
         else:
-            e.write_raw(self.signature.bytes_())
+            e.write_u8(self.signature.TYPE)
+            e.write_bytes(self.signature.raw)  # variable DER: length-prefixed
 
     def to_bytes(self) -> bytes:
         e = Encoder()
@@ -64,6 +71,8 @@ class Proposal:
         sig = None
         if sig_type == SignatureEd25519.TYPE:
             sig = SignatureEd25519(d._take(64))
+        elif sig_type == SignatureSecp256k1.TYPE:
+            sig = SignatureSecp256k1(d.read_bytes())
         elif sig_type != 0:
             raise ValueError(f"unknown signature type {sig_type}")
         return cls(height, rnd, psh, pol_round, pol_bid, sig)
@@ -92,7 +101,7 @@ class Proposal:
             PartSetHeader.from_json(jv.dict_field(obj, "block_parts_header")),
             jv.int_field(obj, "pol_round", -1, jv.MAX_ROUND),
             BlockID.from_json(jv.dict_field(obj, "pol_block_id")),
-            SignatureEd25519.from_json(obj["signature"]) if obj.get("signature") else None,
+            signature_from_json(obj["signature"]) if obj.get("signature") else None,
         )
 
     def __repr__(self):

@@ -7,7 +7,7 @@ from dataclasses import dataclass, field
 
 from tendermint_tpu.codec.binary import Encoder
 from tendermint_tpu.crypto.hashing import ripemd160
-from tendermint_tpu.crypto.keys import PubKeyEd25519
+from tendermint_tpu.crypto.keys import PubKeyEd25519, pub_key_from_json
 
 
 @dataclass
@@ -56,7 +56,7 @@ class Validator:
     def from_json(cls, obj) -> "Validator":
         return cls(
             bytes.fromhex(obj["address"]),
-            PubKeyEd25519.from_json(obj["pub_key"]),
+            pub_key_from_json(obj["pub_key"]),
             obj["voting_power"],
             obj.get("accum", 0),
         )

@@ -12,7 +12,7 @@ from dataclasses import dataclass, replace
 
 from tendermint_tpu.codec.binary import Decoder, Encoder
 from tendermint_tpu.codec.canonical import canonical_dumps
-from tendermint_tpu.crypto.keys import SignatureEd25519
+from tendermint_tpu.crypto.keys import SignatureEd25519, SignatureSecp256k1, signature_from_json
 from tendermint_tpu.types.block_id import BlockID
 
 VOTE_TYPE_PREVOTE = 0x01
@@ -87,8 +87,11 @@ class Vote:
         self.block_id.encode(e)
         if self.signature is None:
             e.write_u8(0)
+        elif self.signature.TYPE == SignatureEd25519.TYPE:
+            e.write_raw(self.signature.bytes_())  # fixed 64-byte body
         else:
-            e.write_raw(self.signature.bytes_())
+            e.write_u8(self.signature.TYPE)
+            e.write_bytes(self.signature.raw)  # variable DER: length-prefixed
 
     def to_bytes(self) -> bytes:
         e = Encoder()
@@ -107,6 +110,8 @@ class Vote:
         sig = None
         if sig_type == SignatureEd25519.TYPE:
             sig = SignatureEd25519(d._take(64))
+        elif sig_type == SignatureSecp256k1.TYPE:
+            sig = SignatureSecp256k1(d.read_bytes())
         elif sig_type != 0:
             raise ValueError(f"unknown signature type {sig_type}")
         return cls(addr, idx, height, rnd, typ, bid, sig)
@@ -137,7 +142,7 @@ class Vote:
             jv.int_field(obj, "round", 0, jv.MAX_ROUND),
             jv.int_field(obj, "type", 0, 255),
             BlockID.from_json(jv.dict_field(obj, "block_id")),
-            SignatureEd25519.from_json(obj["signature"]) if obj.get("signature") else None,
+            signature_from_json(obj["signature"]) if obj.get("signature") else None,
         )
 
     def __repr__(self):

@@ -491,19 +491,23 @@ def test_live_vote_path_batches_on_gateway():
     cs.start()
     try:
         n = len(votes)
-        stat_total = lambda: (
-            verifier.stats()["tpu_sigs"] + verifier.stats()["cpu_sigs"]
+
+        def added():
+            prevotes = cs.rs.votes.prevotes(0)
+            if prevotes is None:
+                return 0
+            return sum(
+                1 for s in stubs
+                if s.index != prop_idx and prevotes.get_by_index(s.index) is not None
+            )
+
+        # wait for APPLICATION, not just verification: priming counts the
+        # stats before the receive routine has tallied every vote
+        assert wait_until(lambda: added() == n, timeout=120), (
+            f"only {added()}/{n} votes added; stats {verifier.stats()}"
         )
-        assert wait_until(lambda: stat_total() >= n, timeout=120), verifier.stats()
         st = verifier.stats()
         # the burst must have landed on the batched path, not vote-by-vote
         assert st["tpu_batches"] >= 1 and st["tpu_sigs"] >= 32, st
-        # and the votes are actually in the set
-        prevotes = cs.rs.votes.prevotes(0)
-        added = sum(
-            1 for s in stubs
-            if s.index != prop_idx and prevotes.get_by_index(s.index) is not None
-        )
-        assert added == n, f"only {added}/{n} votes added"
     finally:
         cs.stop()

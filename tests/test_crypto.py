@@ -282,3 +282,121 @@ class TestMerkle:
         a = simple_hash_from_map({"x": b"1", "y": b"2", "z": b"3"})
         b = simple_hash_from_map({"z": b"3", "x": b"1", "y": b"2"})
         assert a == b and len(a) == 20
+
+
+class TestSecp256k1:
+    """go-crypto's second key type (ref types/validator.go:75-86 consumes
+    any crypto.PubKey): compressed points, DER low-s ECDSA, bitcoin-shaped
+    addresses, CPU-verified via the gateway's key-type partition."""
+
+    def test_sign_verify_roundtrip(self):
+        from tendermint_tpu.crypto.keys import gen_priv_key_secp256k1
+
+        pk = gen_priv_key_secp256k1(b"secp-test-seed")
+        pub = pk.pub_key()
+        assert len(pub.raw) == 33 and pub.raw[0] in (2, 3)
+        assert len(pub.address()) == 20
+        sig = pk.sign(b"hello")
+        assert pub.verify_bytes(b"hello", sig)
+        assert not pub.verify_bytes(b"hell0", sig)
+        # deterministic key from seed
+        assert gen_priv_key_secp256k1(b"secp-test-seed").raw == pk.raw
+
+    def test_low_s_and_tamper_rejection(self):
+        from tendermint_tpu.crypto import secp256k1
+        from tendermint_tpu.crypto.keys import gen_priv_key_secp256k1
+        from cryptography.hazmat.primitives.asymmetric.utils import (
+            decode_dss_signature,
+            encode_dss_signature,
+        )
+
+        pk = gen_priv_key_secp256k1(b"low-s")
+        sig = pk.sign(b"msg")
+        r, s = decode_dss_signature(sig.raw)
+        assert s <= secp256k1._N // 2
+        # the high-s twin verifies under naive ECDSA but must be rejected
+        high = encode_dss_signature(r, secp256k1._N - s)
+        assert not secp256k1.verify(pk.pub_key().raw, b"msg", high)
+
+    def test_json_roundtrip_and_dispatch(self):
+        from tendermint_tpu.crypto.keys import (
+            gen_priv_key_secp256k1,
+            priv_key_from_json,
+            pub_key_from_json,
+            signature_from_json,
+        )
+
+        pk = gen_priv_key_secp256k1(b"json")
+        assert priv_key_from_json(pk.to_json()) == pk
+        assert pub_key_from_json(pk.pub_key().to_json()) == pk.pub_key()
+        sig = pk.sign(b"x")
+        assert signature_from_json(sig.to_json()) == sig
+
+    def test_gateway_mixed_batch(self):
+        from tendermint_tpu.crypto.keys import (
+            gen_priv_key_ed25519,
+            gen_priv_key_secp256k1,
+        )
+        from tendermint_tpu.ops.gateway import Verifier
+
+        eds = [gen_priv_key_ed25519(b"me%d" % i) for i in range(6)]
+        secs = [gen_priv_key_secp256k1(b"ms%d" % i) for i in range(3)]
+        items, want = [], []
+        for i, k in enumerate(eds):
+            msg = b"edmsg%d" % i
+            sig = k.sign(msg).raw
+            if i == 2:
+                sig = sig[:5] + bytes([sig[5] ^ 1]) + sig[6:]
+            items.append((k.pub_key().raw, msg, sig))
+            want.append(i != 2)
+        for i, k in enumerate(secs):
+            msg = b"smsg%d" % i
+            sig = k.sign(msg).raw
+            ok = i != 1
+            if not ok:
+                msg = b"tampered"
+                items.append((k.pub_key().raw, b"smsg1", k.sign(msg).raw))
+            else:
+                items.append((k.pub_key().raw, msg, sig))
+            want.append(ok)
+        # interleave deterministically
+        order = [0, 6, 1, 7, 2, 8, 3, 4, 5]
+        mixed = [items[i] for i in order]
+        expect = [want[i] for i in order]
+        v = Verifier(min_tpu_batch=1, use_tpu=True)
+        assert v.verify_batch(mixed) == expect
+        assert v.verify_batch_async(mixed)() == expect
+        st = v.stats()
+        assert st["tpu_sigs"] > 0 and st["cpu_sigs"] > 0
+
+    def test_secp_validator_in_commit(self):
+        """A mixed ed25519/secp256k1 validator set verifies a commit
+        through the batch path with identical semantics."""
+        from tendermint_tpu.crypto.keys import (
+            gen_priv_key_ed25519,
+            gen_priv_key_secp256k1,
+        )
+        from tendermint_tpu.ops.gateway import Verifier
+        from tendermint_tpu.types import BlockID, PrivValidatorFS, Vote
+        from tendermint_tpu.types.block_id import PartSetHeader
+        from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+        from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT
+        from tendermint_tpu.types.vote_set import VoteSet
+
+        privs = [gen_priv_key_ed25519(b"mixed%d" % i) for i in range(3)] + [
+            gen_priv_key_secp256k1(b"mixed3")
+        ]
+        vs = ValidatorSet([Validator.new(p.pub_key(), 1) for p in privs])
+        by_addr = {p.pub_key().address(): p for p in privs}
+        block_id = BlockID(b"\x42" * 20, PartSetHeader(1, b"\x43" * 20))
+        voteset = VoteSet("test-chain", 5, 0, VOTE_TYPE_PRECOMMIT, vs)
+        for i, val in enumerate(vs.validators):
+            p = by_addr[val.address]
+            vote = Vote(val.address, i, 5, 0, VOTE_TYPE_PRECOMMIT, block_id)
+            voteset.add_vote(vote.with_signature(p.sign(vote.sign_bytes("test-chain"))))
+        commit = voteset.make_commit()
+        v = Verifier(min_tpu_batch=1, use_tpu=True)
+        vs.verify_commit(
+            "test-chain", block_id, 5, commit, batch_verifier=v.commit_batch_verifier()
+        )  # no raise
+        assert v.stats()["cpu_sigs"] >= 1  # the secp lane went to CPU

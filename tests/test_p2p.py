@@ -406,3 +406,32 @@ def test_switch_inbound_peer_cap():
                 s.close()
             except OSError:
                 pass
+
+
+def test_switch_ip_range_cap():
+    """Inbound peers beyond the per-IP-range limit are closed at accept
+    (ip_range_counter wiring)."""
+    import socket as _socket
+
+    from tendermint_tpu.p2p.ip_range_counter import IPRangeCounter
+    from tendermint_tpu.p2p.switch import Switch
+
+    sw = Switch()
+    sw.ip_ranges = IPRangeCounter(limits=(1, 1, 1))
+    assert sw.ip_ranges.try_add("127.0.0.1")  # range now full
+
+    lst = _socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    cli = _socket.create_connection(lst.getsockname())
+    srv, _ = lst.accept()
+    try:
+        sw._accept_peer(srv)
+        cli.settimeout(2)
+        assert cli.recv(1) == b""  # closed without handshake
+    finally:
+        for s in (cli, srv, lst):
+            try:
+                s.close()
+            except OSError:
+                pass
