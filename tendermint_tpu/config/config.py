@@ -33,7 +33,13 @@ class BaseConfig:
     fast_sync: bool = True
     filter_peers: bool = False
     tx_index: str = "kv"  # kv | null
-    db_backend: str = "memdb"  # memdb | filedb
+    # filedb (crash-safe journal, the LevelDB-default equivalent) so a
+    # restarted node resumes its chain; memdb is for tests (the kill_all
+    # localnet scenario catches a non-persistent default). NOTE: homes
+    # initialized before this default changed carry an explicit
+    # `db_backend = "memdb"` in config.toml and must edit it by hand —
+    # the loader honors whatever the file says.
+    db_backend: str = "filedb"  # filedb | memdb
     db_path: str = "data"
 
     def genesis_file(self) -> str:

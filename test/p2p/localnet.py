@@ -1,0 +1,150 @@
+"""Multi-process testnet harness (reference: test/p2p/* runs the same
+scenarios in docker containers; this tier runs them as real node
+PROCESSES over real TCP — same isolation properties that matter for the
+scenarios: separate interpreters, separate homes/DBs/WALs, kill -9
+crash semantics, reconnection over sockets).
+
+Used by scenarios.py (basic, atomic_broadcast, fast_sync, kill_all) and
+the pytest wrapper tests/test_localnet.py. Where docker IS available,
+test/p2p/Dockerfile + run_docker.sh wrap the same scenarios in
+containers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class Node:
+    def __init__(self, home: str, index: int, p2p_port: int, rpc_port: int):
+        self.home = home
+        self.index = index
+        self.p2p_port = p2p_port
+        self.rpc_port = rpc_port
+        self.proc: subprocess.Popen | None = None
+
+    def start(self, seeds: str = "", extra: list[str] | None = None) -> None:
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.setdefault("TENDERMINT_TPU_DISABLE", "1")
+        env["PYTHONPATH"] = REPO
+        cmd = [
+            sys.executable, "-m", "tendermint_tpu.cli",
+            "--home", self.home, "node",
+            "--proxy_app", "kvstore",
+            "--p2p.laddr", f"tcp://127.0.0.1:{self.p2p_port}",
+            "--rpc.laddr", f"tcp://127.0.0.1:{self.rpc_port}",
+            "--log_level", "warning",
+        ]
+        if seeds:
+            cmd += ["--seeds", seeds]
+        cmd += extra or []
+        self.proc = subprocess.Popen(
+            cmd,
+            cwd=REPO,
+            env=env,
+            stdout=open(os.path.join(self.home, "node.log"), "ab"),
+            stderr=subprocess.STDOUT,
+        )
+
+    def rpc(self, method: str, params: dict | None = None, timeout: float = 30):
+        body = json.dumps(
+            {"jsonrpc": "2.0", "id": "ln", "method": method, "params": params or {}}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.rpc_port}/", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            out = json.loads(resp.read())
+        if out.get("error"):
+            raise RuntimeError(f"node{self.index} {method}: {out['error']}")
+        return out["result"]
+
+    def height(self) -> int:
+        try:
+            return int(self.rpc("status")["latest_block_height"])
+        except Exception:  # noqa: BLE001 — down/starting counts as 0
+            return -1
+
+    def kill(self, sig=signal.SIGKILL) -> None:
+        if self.proc is None:
+            return
+        try:
+            self.proc.send_signal(sig)
+            self.proc.wait(timeout=10)
+        except Exception:  # noqa: BLE001 — a wedged shutdown escalates:
+            # dropping the handle would orphan a process on bound ports
+            try:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+        self.proc = None
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class Localnet:
+    def __init__(self, n: int, root: str, base_port: int = 46900):
+        self.root = root
+        self.nodes: list[Node] = []
+        # shared genesis via the CLI's own testnet command
+        subprocess.run(
+            [
+                sys.executable, "-m", "tendermint_tpu.cli", "testnet",
+                "--n", str(n), "--dir", root, "--chain-id", "localnet",
+            ],
+            cwd=REPO,
+            env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+                 "TENDERMINT_TPU_DISABLE": "1"},
+            check=True,
+            capture_output=True,
+        )
+        for i in range(n):
+            self.nodes.append(
+                Node(os.path.join(root, f"mach{i}"), i, base_port + 2 * i, base_port + 2 * i + 1)
+            )
+
+    def seeds_for(self, index: int) -> str:
+        return ",".join(
+            f"127.0.0.1:{nd.p2p_port}" for nd in self.nodes if nd.index != index
+        )
+
+    def start_all(self) -> None:
+        for nd in self.nodes:
+            nd.start(seeds=self.seeds_for(nd.index))
+
+    def stop_all(self) -> None:
+        for nd in self.nodes:
+            nd.kill(signal.SIGTERM)
+
+    def wait_height(self, h: int, timeout: float = 120, nodes=None) -> bool:
+        nodes = nodes if nodes is not None else self.nodes
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(nd.height() >= h for nd in nodes):
+                return True
+            time.sleep(0.5)
+        return False
+
+    def heights(self) -> list[int]:
+        return [nd.height() for nd in self.nodes]
+
+    def block_hash(self, index: int, height: int) -> str:
+        meta = self.nodes[index].rpc("block", {"height": height})["block_meta"]
+        return meta["block_id"]["hash"]
+
+    def assert_chains_agree(self, upto: int) -> None:
+        for h in range(1, upto + 1):
+            hashes = {self.block_hash(i, h) for i in range(len(self.nodes))}
+            assert len(hashes) == 1, f"nodes disagree at height {h}: {hashes}"

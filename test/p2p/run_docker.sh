@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# N-container testnet running the same scenarios as scenarios.py
+# (reference: test/p2p/test.sh). Requires docker; the process-based tier
+# (python test/p2p/scenarios.py) covers environments without it.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+N=${N:-4}
+NET=tendermint-tpu-net
+docker build -t tendermint-tpu -f test/p2p/Dockerfile .
+docker network create "$NET" 2>/dev/null || true
+rm -rf /tmp/tm-docker-testnet
+PYTHONPATH=. python -m tendermint_tpu.cli testnet --n "$N" --dir /tmp/tm-docker-testnet --chain-id dockernet
+SEEDS=$(for i in $(seq 0 $((N-1))); do printf "node%d:46656," "$i"; done | sed 's/,$//')
+for i in $(seq 0 $((N-1))); do
+  docker run -d --name "node$i" --network "$NET" \
+    -v "/tmp/tm-docker-testnet/mach$i:/home" \
+    tendermint-tpu --home /home node --proxy_app kvstore \
+    --p2p.laddr tcp://0.0.0.0:46656 --rpc.laddr tcp://0.0.0.0:46657 \
+    --seeds "$SEEDS"
+done
+echo "testnet up: docker logs node0 ... node$((N-1))"

@@ -1,0 +1,31 @@
+"""Multi-process integration tier (reference test/p2p/* scenarios over
+real node processes + TCP; see test/p2p/README.md). Slow-marked: each
+scenario boots a 4-process testnet."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "test", "p2p"))
+
+from localnet import Localnet  # noqa: E402
+from scenarios import SCENARIOS  # noqa: E402
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scenario(name, tmp_path_factory):
+    # tmp_path_factory roots get pruned across runs — raw mkdtemp homes
+    # would accumulate filedb journals in the system temp dir forever
+    net = Localnet(
+        4,
+        str(tmp_path_factory.mktemp(f"localnet-{name}")),
+        base_port=47900 + 20 * sorted(SCENARIOS).index(name),
+    )
+    try:
+        SCENARIOS[name](net)
+    finally:
+        net.stop_all()
