@@ -46,20 +46,28 @@ def main() -> None:
     # warmup / compile the offload kernel
     warm = PartSet.from_data(blocks[0], PART_SIZE, hasher=tpu.part_leaf_hashes)
 
-    # -- plain CPU reference (no gateway) ---------------------------------
-    t0 = time.perf_counter()
-    cpu_sets = [
-        PartSet.from_data(blocks[i % 4], PART_SIZE) for i in range(N_BLOCKS)
-    ]
-    cpu_s = time.perf_counter() - t0
+    # -- plain CPU reference vs production gateway path --------------------
+    # best-of-3, alternating order, so run-order noise can't put the
+    # production wrapper artificially above/below the plain path
+    cpu_s = prod_s = float("inf")
+    cpu_sets = prod_sets = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        sets = [
+            PartSet.from_data(blocks[i % 4], PART_SIZE) for i in range(N_BLOCKS)
+        ]
+        if (dt := time.perf_counter() - t0) < cpu_s:
+            cpu_s, cpu_sets = dt, sets
 
-    # -- production gateway path ------------------------------------------
-    t0 = time.perf_counter()
-    prod_sets = [
-        PartSet.from_data(blocks[i % 4], PART_SIZE, hasher=prod.part_leaf_hashes)
-        for i in range(N_BLOCKS)
-    ]
-    prod_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        sets = [
+            PartSet.from_data(
+                blocks[i % 4], PART_SIZE, hasher=prod.part_leaf_hashes
+            )
+            for i in range(N_BLOCKS)
+        ]
+        if (dt := time.perf_counter() - t0) < prod_s:
+            prod_s, prod_sets = dt, sets
 
     # -- TPU offload kernel (per-block calls: the production shape) -------
     t0 = time.perf_counter()

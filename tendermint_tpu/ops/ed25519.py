@@ -1,5 +1,13 @@
 """Batched Ed25519 signature verification for TPU (pure jnp, int32 lanes).
 
+STATUS: tested math-reference implementation and selectable backend.
+The production default is ops/ed25519_f32.py (94.4k sigs/s vs this
+kernel's 50.0k at batch 8192 on a v5e — see ops/gateway.py KERNELS);
+select this one with TENDERMINT_TPU_KERNEL=int32. It stays in-tree as
+the independently-derived oracle the rigorous RFC 8032 / malformed-input
+tests cross-check (tests/test_ops.py), and its limb codecs
+(int_to_limbs_np, scalar_bits_np) are shared by the pallas kernel.
+
 This kernel replaces the reference's sequential per-vote/per-commit Ed25519
 verify loops (types/vote_set.go:175, types/validator_set.go:247-250) with a
 wide SIMD batch: every lane verifies one signature, all lanes share the

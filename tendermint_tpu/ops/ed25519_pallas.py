@@ -1,5 +1,13 @@
 """Pallas TPU kernel for batched Ed25519 verification.
 
+STATUS: bake-off alternative, selectable with TENDERMINT_TPU_KERNEL=pallas.
+Lost the production bake-off to ops/ed25519_f32.py (32.6k vs 94.4k sigs/s
+at batch 8192 on a v5e — see ops/gateway.py KERNELS): the f32 kernel's
+conv-lowered field multiplies ride the MXU while this ladder is VPU-bound
+int32 work, and VMEM residency alone doesn't close that gap. Kept as the
+VMEM-resident reference point for future pallas work and as a second
+device implementation the tests cross-check.
+
 The XLA-composed variant (ops/ed25519.py) bottoms out at ~350ms/batch on a
 v5e because the limb accumulator updates materialize through HBM between
 HLO ops. This kernel runs the ENTIRE double-scalar ladder inside one

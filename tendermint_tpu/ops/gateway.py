@@ -37,6 +37,40 @@ def _cpu_verify_batch(items: list[Item]) -> list[bool]:
     return [verify_any(pk, msg, sig) for pk, msg, sig in items]
 
 
+# Every batch kernel exposes verify_batch(items) -> np.ndarray[bool] with
+# identical accept/reject semantics (cross-checked lane-for-lane by
+# tests/test_ops*.py). The default is the measured winner; the others stay
+# selectable so the bake-off is reproducible and any backend regression
+# has an immediate fallback. v5e, batch 8192:
+#   f32    94.4k sigs/s  fp32 radix-2^8 depthwise-conv field mults (MXU)
+#   int32  50.0k sigs/s  int32 radix-2^15 jnp limb vectors (VPU)
+#   pallas 32.6k sigs/s  single-pallas_call Straus ladder, VMEM-resident
+KERNELS = {
+    "f32": "tendermint_tpu.ops.ed25519_f32",
+    "int32": "tendermint_tpu.ops.ed25519",
+    "pallas": "tendermint_tpu.ops.ed25519_pallas",
+}
+
+
+def kernel_name() -> str:
+    """Validated TENDERMINT_TPU_KERNEL (default "f32"). Raises on unknown
+    names; Verifier.__init__ calls this so a typo'd env var fails at
+    startup rather than silently latching the CPU fallback."""
+    name = os.environ.get("TENDERMINT_TPU_KERNEL", "f32")
+    if name not in KERNELS:
+        raise ValueError(
+            f"TENDERMINT_TPU_KERNEL={name!r}: expected one of {sorted(KERNELS)}"
+        )
+    return name
+
+
+def kernel_module():
+    """The verify kernel the gateway runs, per TENDERMINT_TPU_KERNEL."""
+    import importlib
+
+    return importlib.import_module(KERNELS[kernel_name()])
+
+
 def _split_by_key_type(items: list[Item]):
     """(ed25519 items, their positions, other items, their positions).
     The kernel is ed25519-only; secp256k1 (33-byte pubkeys) and anything
@@ -59,6 +93,8 @@ class Verifier:
     def __init__(self, min_tpu_batch: int = 32, use_tpu: bool | None = None):
         if use_tpu is None:
             use_tpu = os.environ.get("TENDERMINT_TPU_DISABLE", "") == ""
+        if use_tpu:
+            kernel_name()  # typo'd TENDERMINT_TPU_KERNEL fails at startup
         self.min_tpu_batch = min_tpu_batch
         self._tpu_ok = use_tpu
         self._mtx = threading.Lock()
@@ -93,11 +129,7 @@ class Verifier:
             return _cpu_verify_batch(items)
         if self._tpu_ok and n >= self.min_tpu_batch:
             try:
-                # fp32 radix-2^8 conv kernel: the production path on every
-                # backend. Measured on a v5e at batch 8192: 94.4k sigs/s
-                # vs 50.0k (int32 radix-2^15 jnp) vs 32.6k (pallas ladder)
-                # vs 3.9k (CPU loop) — see ops/ed25519_f32.py docstring.
-                from tendermint_tpu.ops import ed25519_f32 as ops_ed
+                ops_ed = kernel_module()  # f32 unless the operator overrode
 
                 out = ops_ed.verify_batch(items)
                 with self._mtx:
@@ -137,7 +169,12 @@ class Verifier:
             return resolve_mixed
         if self._tpu_ok and n >= self.min_tpu_batch:
             try:
-                from tendermint_tpu.ops import ed25519_f32 as ops_ed
+                ops_ed = kernel_module()
+                if not hasattr(ops_ed, "verify_batch_async"):
+                    # only the default kernel pipelines; the bake-off
+                    # kernels verify synchronously under the same contract
+                    res_now = self.verify_batch(items)
+                    return lambda: res_now
 
                 kernel_resolve = ops_ed.verify_batch_async(items)
                 with self._mtx:
@@ -221,6 +258,15 @@ class ShardedVerifier(Verifier):
 
     def __init__(self, mesh, min_tpu_batch: int = 32):
         super().__init__(min_tpu_batch=min_tpu_batch, use_tpu=True)
+        if (kn := kernel_name()) != "f32":
+            # the sharded wide-batch path jits ed25519_f32._verify_impl
+            # directly; honoring a different backend here would silently
+            # report f32 numbers under the other kernel's name
+            raise ValueError(
+                f"ShardedVerifier only supports the f32 kernel; "
+                f"TENDERMINT_TPU_KERNEL={kn!r} — use the base Verifier to "
+                f"run a bake-off backend"
+            )
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as PS
 

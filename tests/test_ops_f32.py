@@ -285,3 +285,77 @@ class TestGatewayAsync:
         assert v._tpu_ok is False               # permanent fallback latched
         stats = v.stats()
         assert stats["cpu_sigs"] == 4 and stats["tpu_sigs"] == 0
+
+
+class TestKernelRegistry:
+    """TENDERMINT_TPU_KERNEL selects the verify backend (gateway.KERNELS)."""
+
+    def test_default_is_f32(self, monkeypatch):
+        from tendermint_tpu.ops import gateway as gw
+
+        monkeypatch.delenv("TENDERMINT_TPU_KERNEL", raising=False)
+        assert gw.kernel_module().__name__ == "tendermint_tpu.ops.ed25519_f32"
+
+    @pytest.mark.parametrize(
+        "name,module",
+        [
+            ("f32", "tendermint_tpu.ops.ed25519_f32"),
+            ("int32", "tendermint_tpu.ops.ed25519"),
+            ("pallas", "tendermint_tpu.ops.ed25519_pallas"),
+        ],
+    )
+    def test_selects_each_backend(self, monkeypatch, name, module):
+        from tendermint_tpu.ops import gateway as gw
+
+        monkeypatch.setenv("TENDERMINT_TPU_KERNEL", name)
+        assert gw.kernel_module().__name__ == module
+
+    def test_unknown_name_fails_loudly(self, monkeypatch):
+        from tendermint_tpu.ops import gateway as gw
+
+        monkeypatch.setenv("TENDERMINT_TPU_KERNEL", "cuda")
+        with pytest.raises(ValueError, match="cuda"):
+            gw.kernel_module()
+
+    def test_async_without_pipelining_kernel_resolves_sync(self, monkeypatch):
+        """Backends without verify_batch_async still honor the async API."""
+        from tendermint_tpu.ops import gateway as gw
+
+        v = gw.Verifier(min_tpu_batch=1)
+        seed = b"\x53" * 32
+        items = [
+            (ed.public_key(seed), b"s%d" % i, ed.sign(seed, b"s%d" % i))
+            for i in range(4)
+        ]
+
+        class SyncOnly:
+            @staticmethod
+            def verify_batch(its):
+                return np.array([True] * len(its))
+
+        monkeypatch.setattr(gw, "kernel_module", lambda: SyncOnly)
+        resolve = v.verify_batch_async(items)
+        assert resolve() == [True] * 4
+        assert v.stats()["tpu_batches"] == 1
+
+    def test_typo_fails_at_startup(self, monkeypatch):
+        """A typo'd kernel name must fail at Verifier construction, not
+        silently latch the CPU fallback at the first batch."""
+        from tendermint_tpu.ops import gateway as gw
+
+        monkeypatch.setenv("TENDERMINT_TPU_KERNEL", "fp32")
+        with pytest.raises(ValueError, match="fp32"):
+            gw.Verifier(use_tpu=True)
+        # with the TPU disabled outright the env var is irrelevant
+        gw.Verifier(use_tpu=False)
+
+    def test_sharded_rejects_non_f32(self, monkeypatch):
+        import jax
+        from jax.sharding import Mesh
+
+        from tendermint_tpu.ops import gateway as gw
+
+        monkeypatch.setenv("TENDERMINT_TPU_KERNEL", "pallas")
+        mesh = Mesh(np.array(jax.devices()[:1]), ("batch",))
+        with pytest.raises(ValueError, match="pallas"):
+            gw.ShardedVerifier(mesh)
