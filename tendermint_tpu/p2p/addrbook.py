@@ -26,6 +26,11 @@ BUCKET_SIZE = 64
 NEW_BUCKETS_PER_ADDRESS = 4
 OLD_BUCKETS_PER_GROUP = 4  # informational; enforcement is per-address here
 DEFAULT_SAVE_INTERVAL = 120.0
+# is_bad() thresholds (addrbook.go isBad/expireNew criteria)
+MAX_FAILURES = 3  # never-succeeded attempts before an address is bad
+STALE_AFTER = 30 * 24 * 3600.0  # not heard from in 30 days
+RECENT_ATTEMPT = 60.0  # just-tried addresses aren't judged yet
+NEED_ADDRESS_THRESHOLD = 1000  # below this the book wants more (PEX asks)
 
 
 class KnownAddress:
@@ -33,6 +38,7 @@ class KnownAddress:
         self.addr = addr
         self.src = src
         self.attempts = 0
+        self.added = time.time()
         self.last_attempt = 0.0
         self.last_success = 0.0
         self.bucket_type = "new"
@@ -41,11 +47,27 @@ class KnownAddress:
     def is_old(self) -> bool:
         return self.bucket_type == "old"
 
+    def is_bad(self, now: float | None = None) -> bool:
+        """Eviction/skip criteria (addrbook.go isBad): an address is bad if
+        it keeps failing without ever having worked, or nothing has been
+        heard from it in STALE_AFTER. Old (proven) addresses and ones tried
+        within the last minute are never judged bad."""
+        if self.is_old():
+            return False
+        now = time.time() if now is None else now
+        if self.last_attempt and now - self.last_attempt < RECENT_ATTEMPT:
+            return False
+        if self.attempts >= MAX_FAILURES and not self.last_success:
+            return True
+        last_seen = max(self.added, self.last_attempt, self.last_success)
+        return now - last_seen > STALE_AFTER
+
     def to_json(self) -> dict:
         return {
             "addr": str(self.addr),
             "src": str(self.src),
             "attempts": self.attempts,
+            "added": self.added,
             "last_attempt": self.last_attempt,
             "last_success": self.last_success,
             "bucket_type": self.bucket_type,
@@ -55,6 +77,7 @@ class KnownAddress:
     def from_json(cls, o: dict) -> "KnownAddress":
         ka = cls(NetAddress.from_string(o["addr"]), NetAddress.from_string(o["src"]))
         ka.attempts = o.get("attempts", 0)
+        ka.added = o.get("added", ka.added)
         ka.last_attempt = o.get("last_attempt", 0.0)
         ka.last_success = o.get("last_success", 0.0)
         ka.bucket_type = o.get("bucket_type", "new")
@@ -150,8 +173,12 @@ class AddrBook(BaseService):
         return False
 
     def _expire_one(self, bucket: dict[str, KnownAddress]) -> None:
-        """Evict the stalest new-bucket entry."""
-        victim_key = min(
+        """Evict from a full new bucket: a bad entry if any (addrbook.go
+        expireNew), else the stalest."""
+        now = time.time()
+        victim_key = next(
+            (k for k, ka in bucket.items() if ka.is_bad(now)), None
+        ) or min(
             bucket, key=lambda k: (bucket[k].last_success, -bucket[k].attempts)
         )
         victim = bucket.pop(victim_key)
@@ -175,6 +202,11 @@ class AddrBook(BaseService):
             if ka:
                 ka.attempts += 1
                 ka.last_attempt = time.time()
+
+    def mark_bad(self, addr: NetAddress) -> None:
+        """Drop a misbehaving peer's address (addrbook.go MarkBad — which
+        the reference also implements as removal)."""
+        self.remove_address(addr)
 
     def mark_good(self, addr: NetAddress) -> None:
         """Promote new -> old on successful connection (addrbook.go:393)."""
@@ -214,6 +246,11 @@ class AddrBook(BaseService):
         with self._mtx:
             return len(self._addrs)
 
+    def need_more_addrs(self) -> bool:
+        """Should PEX keep soliciting addresses? (addrbook.go
+        NeedMoreAddrs: size < 1000)."""
+        return self.size() < NEED_ADDRESS_THRESHOLD
+
     def our_addresses(self) -> set[str]:
         return getattr(self, "_ours", set())
 
@@ -225,8 +262,15 @@ class AddrBook(BaseService):
         with self._mtx:
             if not self._addrs:
                 return None
+            now = time.time()
             olds = [ka for ka in self._addrs.values() if ka.is_old()]
-            news = [ka for ka in self._addrs.values() if not ka.is_old()]
+            news_all = [ka for ka in self._addrs.values() if not ka.is_old()]
+            # prefer not-bad new addresses, but never strand the node: if
+            # everything new looks bad (e.g. after an outage burned 3
+            # attempts on every address) fall back to retrying them — the
+            # reference uses isBad only for bucket eviction for the same
+            # reason (addrbook.go expireNew vs PickAddress)
+            news = [ka for ka in news_all if not ka.is_bad(now)] or news_all
             pool = news if (self._rng.random() * 100 < new_bias_pct or not olds) else olds
             if not pool:
                 pool = olds or news

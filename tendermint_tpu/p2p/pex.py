@@ -51,6 +51,8 @@ class PEXReactor(Reactor, BaseService):
                 if peer.outbound:
                     # we dialed them: address verified good
                     self.book.mark_good(addr)
+                    if self.book.need_more_addrs():
+                        self._request_addrs(peer)
                 else:
                     self.book.add_address(addr, addr)
                     # learn more from inbound peers
@@ -64,6 +66,20 @@ class PEXReactor(Reactor, BaseService):
 
     def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
         if self._flood_check(peer):
+            # evict the flooder's address — but only one provably theirs:
+            # listen_addr is self-reported in the handshake, so anyone
+            # could otherwise claim a victim's address and have us evict
+            # a proven-good entry. Require the claimed IP to match the
+            # socket's actual remote IP.
+            info = peer.node_info
+            if info and info.listen_addr:
+                try:
+                    claimed = NetAddress.from_string(info.listen_addr)
+                    sock_ip = str(peer.stream.remote_addr()).rsplit(":", 1)[0]
+                    if claimed.ip == sock_ip:
+                        self.book.mark_bad(claimed)
+                except (ValueError, AttributeError):
+                    pass
             self.switch.stop_peer_for_error(peer, "pex flood")
             return
         try:

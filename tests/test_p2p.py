@@ -435,3 +435,115 @@ def test_switch_ip_range_cap():
                 s.close()
             except OSError:
                 pass
+
+
+def test_addrbook_is_bad_and_eviction():
+    import time as _time
+
+    from tendermint_tpu.p2p import addrbook as ab
+
+    book = AddrBook("", routability_strict=False)
+    src = NetAddress("127.0.0.1", 1)
+    addr = NetAddress("127.0.0.1", 2000)
+    book.add_address(addr, src)
+    ka = book._addrs[str(addr)]
+
+    # fresh address: not bad
+    assert not ka.is_bad()
+    # repeated failures without a success -> bad (once past the
+    # recent-attempt grace window)
+    for _ in range(ab.MAX_FAILURES):
+        book.mark_attempt(addr)
+    assert not ka.is_bad()  # just tried: within RECENT_ATTEMPT grace
+    ka.last_attempt -= ab.RECENT_ATTEMPT + 1
+    assert ka.is_bad()
+    # a success clears badness; old addresses are never bad
+    book.mark_good(addr)
+    assert ka.is_old() and not ka.is_bad()
+    # staleness: not heard from in STALE_AFTER
+    ka2 = ab.KnownAddress(NetAddress("127.0.0.1", 2001), src)
+    ka2.added = _time.time() - ab.STALE_AFTER - 1
+    assert ka2.is_bad()
+
+    # mark_bad removes outright (ref MarkBad)
+    book.mark_bad(addr)
+    assert str(addr) not in book._addrs
+
+
+def test_addrbook_pick_skips_bad():
+    from tendermint_tpu.p2p import addrbook as ab
+
+    book = AddrBook("", routability_strict=False)
+    src = NetAddress("127.0.0.1", 1)
+    good = NetAddress("127.0.0.1", 3000)
+    bad = NetAddress("127.0.0.1", 3001)
+    book.add_address(good, src)
+    book.add_address(bad, src)
+    kb = book._addrs[str(bad)]
+    kb.attempts = ab.MAX_FAILURES
+    kb.last_attempt = 1.0  # long ago, never succeeded -> bad
+    for _ in range(50):
+        picked = book.pick_address(new_bias_pct=100)
+        assert str(picked) == str(good)
+
+
+def test_addrbook_need_more_addrs():
+    from tendermint_tpu.p2p import addrbook as ab
+
+    book = AddrBook("", routability_strict=False)
+    assert book.need_more_addrs()
+    assert ab.NEED_ADDRESS_THRESHOLD == 1000
+
+
+def test_addrbook_pick_recovers_when_all_bad():
+    """After an outage burns attempts on every address, pick_address must
+    fall back to retrying them, never strand the node (code-review r3)."""
+    from tendermint_tpu.p2p import addrbook as ab
+
+    book = AddrBook("", routability_strict=False)
+    src = NetAddress("127.0.0.1", 1)
+    for port in (4000, 4001):
+        a = NetAddress("127.0.0.1", port)
+        book.add_address(a, src)
+        ka = book._addrs[str(a)]
+        ka.attempts = ab.MAX_FAILURES
+        ka.last_attempt = 1.0  # never succeeded, long ago -> is_bad
+    assert book.pick_address() is not None
+
+
+def test_pex_flood_eviction_requires_ip_match():
+    """A flooder claiming a victim's listen_addr must not evict it from
+    the book; only an address matching the socket IP is marked bad."""
+    from tendermint_tpu.p2p.pex import PEXReactor
+
+    book = AddrBook("", routability_strict=False)
+    victim = NetAddress("127.0.0.1", 5555)
+    book.add_address(victim, victim)
+
+    class FakeStream:
+        @staticmethod
+        def remote_addr():
+            return "10.9.9.9:1234"  # attacker's real socket IP
+
+    class FakePeer:
+        node_info = type(
+            "NI", (), {"listen_addr": "127.0.0.1:5555"}
+        )()  # claims the victim's address
+        stream = FakeStream()
+
+        @staticmethod
+        def id():
+            return "attacker"
+
+    class FakeSwitch:
+        stopped = []
+
+        def stop_peer_for_error(self, peer, reason):
+            self.stopped.append((peer, reason))
+
+    pex = PEXReactor(book, ensure_peers_period=3600)
+    pex.switch = FakeSwitch()
+    pex._msg_counts["attacker"] = [time.monotonic()] * 1001  # over limit
+    pex.receive(0x00, FakePeer(), b"{}")
+    assert str(victim) in book._addrs  # victim survives
+    assert pex.switch.stopped  # flooder still disconnected
