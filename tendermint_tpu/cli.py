@@ -81,6 +81,16 @@ def cmd_node(args) -> int:
     if args.pex:
         cfg.p2p.pex_reactor = True
 
+    # TENDERMINT_RACECHECK=1 == running the reference under `go test -race`:
+    # every lock the node builds joins a process-wide order graph, reported
+    # at shutdown (libs/racecheck.py). Install BEFORE node construction so
+    # the reactors' locks are in scope.
+    race_mon = None
+    if os.environ.get("TENDERMINT_RACECHECK", "") == "1":
+        from tendermint_tpu.libs import racecheck
+
+        race_mon = racecheck.install()
+
     from tendermint_tpu.node import default_new_node
 
     node = default_new_node(cfg)
@@ -95,6 +105,8 @@ def cmd_node(args) -> int:
             time.sleep(0.5)
     finally:
         node.stop()
+        if race_mon is not None:
+            print(race_mon.report())
     return 0
 
 
