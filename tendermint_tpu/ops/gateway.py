@@ -41,22 +41,47 @@ def _cpu_verify_batch(items: list[Item]) -> list[bool]:
 # identical accept/reject semantics (cross-checked lane-for-lane by
 # tests/test_ops*.py). The default is the measured winner; the others stay
 # selectable so the bake-off is reproducible and any backend regression
-# has an immediate fallback. v5e, batch 8192:
-#   f32    94.4k sigs/s  fp32 radix-2^8 depthwise-conv field mults (MXU)
+# has an immediate fallback. v5e, batch 8192, sustained device rate
+# (pipelined, aggregate fetch):
+#   f32p  119.7k sigs/s  pallas fp32 radix-2^8, VMEM-resident ladder
+#   f32    92.2k sigs/s  fp32 radix-2^8 depthwise-conv field mults
 #   int32  50.0k sigs/s  int32 radix-2^15 jnp limb vectors (VPU)
-#   pallas 32.6k sigs/s  single-pallas_call Straus ladder, VMEM-resident
+#   pallas 32.6k sigs/s  int32 radix-2^15 single-pallas_call ladder
 KERNELS = {
+    "f32p": "tendermint_tpu.ops.ed25519_f32p",
     "f32": "tendermint_tpu.ops.ed25519_f32",
     "int32": "tendermint_tpu.ops.ed25519",
     "pallas": "tendermint_tpu.ops.ed25519_pallas",
 }
 
 
+def on_tpu() -> bool:
+    """Is the default jax backend real TPU hardware ("tpu", or "axon" for
+    a tunneled chip)? The ONE platform probe — the kernel default, the
+    pallas interpret-mode switch, and the TPU-gated tests all call this,
+    so a new platform string only needs adding here."""
+    try:
+        import jax
+
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
 def kernel_name() -> str:
-    """Validated TENDERMINT_TPU_KERNEL (default "f32"). Raises on unknown
-    names; Verifier.__init__ calls this so a typo'd env var fails at
-    startup rather than silently latching the CPU fallback."""
-    name = os.environ.get("TENDERMINT_TPU_KERNEL", "f32")
+    """Validated TENDERMINT_TPU_KERNEL. Raises on unknown names;
+    Verifier.__init__ calls this so a typo'd env var fails at startup
+    rather than silently latching the CPU fallback.
+
+    Default is platform-aware: "f32p" (the pallas ladder, the measured
+    winner) on real TPU hardware, "f32" elsewhere — the pallas kernel
+    only runs in slow interpret mode on CPU backends, while the
+    conv-composed f32 kernel compiles natively everywhere. Resolving the
+    platform needs an initialized jax backend, so the default branch is
+    evaluated lazily here, not at import."""
+    name = os.environ.get("TENDERMINT_TPU_KERNEL", "")
+    if not name:
+        return "f32p" if on_tpu() else "f32"
     if name not in KERNELS:
         raise ValueError(
             f"TENDERMINT_TPU_KERNEL={name!r}: expected one of {sorted(KERNELS)}"
@@ -105,6 +130,11 @@ class Verifier:
         self._primed: dict[Item, bool] = {}
         self._primed_cap = 1 << 14
 
+    def _kernel_module(self):
+        """The batch kernel this verifier dispatches to. Overridable so
+        ShardedVerifier can pin f32 for BOTH the sync and async paths."""
+        return kernel_module()
+
     # -- core API ----------------------------------------------------------
 
     def verify_batch(self, items: list[Item]) -> list[bool]:
@@ -129,7 +159,7 @@ class Verifier:
             return _cpu_verify_batch(items)
         if self._tpu_ok and n >= self.min_tpu_batch:
             try:
-                ops_ed = kernel_module()  # f32 unless the operator overrode
+                ops_ed = self._kernel_module()
 
                 out = ops_ed.verify_batch(items)
                 with self._mtx:
@@ -169,7 +199,7 @@ class Verifier:
             return resolve_mixed
         if self._tpu_ok and n >= self.min_tpu_batch:
             try:
-                ops_ed = kernel_module()
+                ops_ed = self._kernel_module()
                 if not hasattr(ops_ed, "verify_batch_async"):
                     # only the default kernel pipelines; the bake-off
                     # kernels verify synchronously under the same contract
@@ -258,10 +288,13 @@ class ShardedVerifier(Verifier):
 
     def __init__(self, mesh, min_tpu_batch: int = 32):
         super().__init__(min_tpu_batch=min_tpu_batch, use_tpu=True)
-        if (kn := kernel_name()) != "f32":
+        if (kn := os.environ.get("TENDERMINT_TPU_KERNEL", "f32")) != "f32":
             # the sharded wide-batch path jits ed25519_f32._verify_impl
-            # directly; honoring a different backend here would silently
-            # report f32 numbers under the other kernel's name
+            # directly (pjit over the conv formulation; the pallas grid
+            # doesn't shard across a mesh), so honoring a different
+            # backend here would silently report f32 numbers under the
+            # other kernel's name. Only an EXPLICIT override is an error —
+            # the platform-aware default doesn't apply to this class.
             raise ValueError(
                 f"ShardedVerifier only supports the f32 kernel; "
                 f"TENDERMINT_TPU_KERNEL={kn!r} — use the base Verifier to "
@@ -281,6 +314,14 @@ class ShardedVerifier(Verifier):
             in_shardings=(batch_last, batch_last, batch_last, vec, batch_last, batch_last),
             out_shardings=vec,
         )
+
+    def _kernel_module(self):
+        # pin f32 for the inherited sync/async fallback paths too — the
+        # platform default must never swap this class onto the unsharded
+        # pallas kernel
+        import importlib
+
+        return importlib.import_module(KERNELS["f32"])
 
     def verify_batch(self, items: list[Item]) -> list[bool]:
         n = len(items)

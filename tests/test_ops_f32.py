@@ -290,15 +290,21 @@ class TestGatewayAsync:
 class TestKernelRegistry:
     """TENDERMINT_TPU_KERNEL selects the verify backend (gateway.KERNELS)."""
 
-    def test_default_is_f32(self, monkeypatch):
+    def test_default_is_platform_aware(self, monkeypatch):
         from tendermint_tpu.ops import gateway as gw
 
         monkeypatch.delenv("TENDERMINT_TPU_KERNEL", raising=False)
-        assert gw.kernel_module().__name__ == "tendermint_tpu.ops.ed25519_f32"
+        want = (
+            "tendermint_tpu.ops.ed25519_f32p"
+            if gw.on_tpu()
+            else "tendermint_tpu.ops.ed25519_f32"
+        )
+        assert gw.kernel_module().__name__ == want
 
     @pytest.mark.parametrize(
         "name,module",
         [
+            ("f32p", "tendermint_tpu.ops.ed25519_f32p"),
             ("f32", "tendermint_tpu.ops.ed25519_f32"),
             ("int32", "tendermint_tpu.ops.ed25519"),
             ("pallas", "tendermint_tpu.ops.ed25519_pallas"),
@@ -359,3 +365,65 @@ class TestKernelRegistry:
         mesh = Mesh(np.array(jax.devices()[:1]), ("batch",))
         with pytest.raises(ValueError, match="pallas"):
             gw.ShardedVerifier(mesh)
+
+
+class TestPallasF32Kernel:
+    """ops/ed25519_f32p — the pallas fp32 ladder (TPU-only: interpret
+    mode on CPU is impractically slow for the 127-step unrolled kernel)."""
+
+    @pytest.mark.tpu
+    @pytest.mark.skipif(
+        not __import__(
+            "tendermint_tpu.ops.gateway", fromlist=["on_tpu"]
+        ).on_tpu(),
+        reason="pallas f32 kernel needs TPU hardware",
+    )
+    def test_parity_with_f32_including_malformed(self):
+        from tendermint_tpu.ops import ed25519_f32p as f32p
+
+        seeds = [bytes([i + 1]) * 32 for i in range(8)]
+        items = []
+        expected = []
+        for i in range(64):
+            s = seeds[i % 8]
+            pk = ed.public_key(s)
+            msg = b"p%d" % i
+            sig = ed.sign(s, msg)
+            ok = True
+            if i % 5 == 1:
+                sig = sig[:20] + bytes([sig[20] ^ 1]) + sig[21:]
+                ok = False
+            elif i % 5 == 2:
+                # high-s: add L to the scalar half
+                s_int = int.from_bytes(sig[32:], "little") + ed.L
+                if s_int < 1 << 256:
+                    sig = sig[:32] + s_int.to_bytes(32, "little")
+                    ok = False
+            elif i % 5 == 3:
+                pk = b"\xff" * 32  # invalid pubkey
+                ok = False
+            items.append((pk, msg, sig))
+            expected.append(ok)
+        got = f32p.verify_batch(items)
+        exp = np.array(expected)
+        ref = np.asarray(f32.verify_batch(items))
+        assert (got == exp).all()
+        assert (got == ref).all()
+
+    def test_registry_includes_f32p(self):
+        from tendermint_tpu.ops import gateway as gw
+
+        assert gw.KERNELS["f32p"] == "tendermint_tpu.ops.ed25519_f32p"
+
+    def test_sharded_pins_f32_for_all_paths(self, monkeypatch):
+        """Platform default must never swap ShardedVerifier onto the
+        unsharded pallas kernel (sync OR async paths)."""
+        import jax
+        from jax.sharding import Mesh
+
+        from tendermint_tpu.ops import gateway as gw
+
+        monkeypatch.delenv("TENDERMINT_TPU_KERNEL", raising=False)
+        mesh = Mesh(np.array(jax.devices()[:1]), ("batch",))
+        sv = gw.ShardedVerifier(mesh)
+        assert sv._kernel_module().__name__ == "tendermint_tpu.ops.ed25519_f32"

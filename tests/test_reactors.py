@@ -280,12 +280,12 @@ def test_fast_sync_rides_the_tpu_gateway():
     for sw in switches:
         sw.start()
     try:
-        assert wait_until(lambda: node_a.store.height() >= 4, timeout=60)
+        assert wait_until(lambda: node_a.store.height() >= 4, timeout=120)
         node_a.cs.stop()
         target = node_a.store.height()
         connect2_switches(switches, 0, 1)
         assert wait_until(
-            lambda: node_b.store.height() >= target, timeout=60
+            lambda: node_b.store.height() >= target, timeout=120
         ), f"B at {node_b.store.height()}, A at {target}"
         for h in range(1, target + 1):
             assert node_b.store.load_block(h).hash() == node_a.store.load_block(h).hash()
