@@ -249,6 +249,7 @@ class Node(BaseService):
             genesis_doc=self.genesis_doc,
             priv_validator=self.priv_validator,
             tx_indexer=self.tx_indexer,
+            state=self.state,
             node=self,
         )
 

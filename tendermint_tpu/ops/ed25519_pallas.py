@@ -281,10 +281,9 @@ def _get_verify(tb: int, interpret: bool):
 
 
 def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
+    from tendermint_tpu.ops.gateway import on_tpu
+
+    return on_tpu()
 
 
 S_TILE = 8  # (8, 128) = one full int32 vreg per limb row

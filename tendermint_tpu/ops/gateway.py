@@ -288,7 +288,7 @@ class ShardedVerifier(Verifier):
 
     def __init__(self, mesh, min_tpu_batch: int = 32):
         super().__init__(min_tpu_batch=min_tpu_batch, use_tpu=True)
-        if (kn := os.environ.get("TENDERMINT_TPU_KERNEL", "f32")) != "f32":
+        if (kn := os.environ.get("TENDERMINT_TPU_KERNEL") or "f32") != "f32":
             # the sharded wide-batch path jits ed25519_f32._verify_impl
             # directly (pjit over the conv formulation; the pallas grid
             # doesn't shard across a mesh), so honoring a different

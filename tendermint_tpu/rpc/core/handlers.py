@@ -128,7 +128,21 @@ def commit(ctx, height: int) -> dict:
     }
 
 
-def validators(ctx) -> dict:
+def validators(ctx, height: int = 0) -> dict:
+    """Current validator set, or — with `height` — the historical set
+    that signed at that height (per-height history via the state's
+    last-changed pointers; state/state.go:162-194). The historical form
+    is what a light client pairs with /commit to verify old headers
+    (docs/specification/light-client-protocol.md)."""
+    height = int(height)
+    if height > 0:
+        if ctx.state is None:
+            raise RPCError("historical validator sets unavailable")
+        try:
+            vs = ctx.state.load_validators(height)
+        except Exception as exc:
+            raise RPCError(f"no validator set for height {height}: {exc}")
+        return {"block_height": height, "validators": vs.to_json()}
     rs = ctx.consensus_state.get_round_state()
     return {
         "block_height": rs.height - 1,
@@ -369,7 +383,7 @@ ROUTES_TABLE = {
     "blockchain": (blockchain_info, ["min_height", "max_height"]),
     "block": (block, ["height"]),
     "commit": (commit, ["height"]),
-    "validators": (validators, []),
+    "validators": (validators, ["height"]),
     "dump_consensus_state": (dump_consensus_state, []),
     "tx": (tx, ["hash", "prove"]),
     "unconfirmed_txs": (unconfirmed_txs, []),

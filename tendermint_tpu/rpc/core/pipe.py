@@ -21,4 +21,5 @@ class RPCContext:
     genesis_doc: Any = None
     priv_validator: Any = None
     tx_indexer: Any = None
+    state: Any = None  # for historical validator-set lookups
     node: Any = None

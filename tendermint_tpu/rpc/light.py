@@ -1,0 +1,177 @@
+"""Light client: verify headers and data through the RPC surface alone.
+
+Working implementation of docs/specification/light-client-protocol.md
+(the reference ships only the spec, light-client-protocol.rst; here the
+verifier is code, and its batch-verify hook means even a light client's
+commit checks can ride the TPU gateway).
+
+Trust model: start from a trusted validator set (genesis or out-of-band);
+`verify_header(h)` accepts a header only if that set still holds +2/3 of
+the commit; `advance()` walks trust forward height-by-height (sequential
+verification — no skipping/bisection, matching the reference line).
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.types.block import Commit, Header
+from tendermint_tpu.types.validator_set import CommitError, ValidatorSet
+
+
+class LightClientError(Exception):
+    pass
+
+
+class LightClient:
+    """`client` is any RPC client exposing .commit/.validators/.tx
+    (rpc/client.py HTTPClient, LocalClient, or a test stub)."""
+
+    def __init__(self, client, chain_id: str, trusted_validators: ValidatorSet,
+                 trusted_height: int = 0, batch_verifier=None):
+        self.client = client
+        self.chain_id = chain_id
+        self.validators = trusted_validators
+        self.height = trusted_height
+        self.batch_verifier = batch_verifier
+
+    @classmethod
+    def from_genesis(cls, client, **kw) -> "LightClient":
+        """Bootstrap trust from the node's /genesis (trust-on-first-use;
+        for stronger setups pass an out-of-band validator set instead)."""
+        from tendermint_tpu.types.genesis import GenesisDoc
+        from tendermint_tpu.types.validator import Validator
+
+        doc = GenesisDoc.from_json(client.genesis()["genesis"])
+        vs = ValidatorSet(
+            [Validator.new(v.pub_key, v.power) for v in doc.validators]
+        )
+        return cls(client, doc.chain_id, vs, trusted_height=0, **kw)
+
+    # -- header verification ------------------------------------------------
+
+    def verify_header(self, height: int, _res: dict | None = None) -> Header:
+        """Fetch (header, commit) at `height` and verify +2/3 of the
+        TRUSTED set signed it. Returns the verified header; raises
+        LightClientError otherwise. Does not advance trust. `_res` lets
+        advance() share one /commit fetch instead of issuing two."""
+        res = _res if _res is not None else self.client.commit(height=int(height))
+        if not res.get("commit"):
+            raise LightClientError(f"no commit for height {height}")
+        header = Header.from_json(res["header"])
+        commit = Commit.from_json(res["commit"])
+        if header.chain_id != self.chain_id:
+            raise LightClientError(
+                f"chain id {header.chain_id!r} != trusted {self.chain_id!r}"
+            )
+        if header.height != height:
+            raise LightClientError("header height mismatch")
+        # the commit must be over THIS header: BlockID.hash == header hash
+        if commit.block_id.hash != header.hash():
+            raise LightClientError("commit is not over the fetched header")
+        # the signing set must be the one we trust: +2/3 check below uses
+        # self.validators, and the header must commit to the same set
+        if header.validators_hash != self.validators.hash():
+            raise LightClientError(
+                "validator set changed; advance() trust to this height first"
+            )
+        try:
+            self.validators.verify_commit(
+                self.chain_id, commit.block_id, height, commit,
+                batch_verifier=self.batch_verifier,
+            )
+        except CommitError as exc:
+            raise LightClientError(f"commit verification failed: {exc}")
+        return header
+
+    def advance(self, to_height: int) -> None:
+        """Walk trust forward to `to_height`, verifying every header with
+        the then-trusted set.
+
+        Validator-set changes: this header format carries no
+        next_validators_hash, so a claimed new set can't be linked
+        cryptographically through the previous header alone — a node
+        could serve a forged set vouched for only by itself. The sound
+        sequential rule used here: adopt a new set at height h only if
+        (a) it matches header h's validators_hash, (b) +2/3 of the NEW
+        set signed commit(h), (c) header h chains to the verified header
+        h-1 (last_block_id), and (d) the valid precommits in commit(h)
+        cast by validators PRESENT IN THE OLD TRUSTED SET carry > 2/3 of
+        the old set's power — i.e. the set we already trust still
+        controls the chain across the transition. An attacker without
+        2/3 of the trusted keys cannot fabricate (d)."""
+        h = max(self.height, 1)
+        prev_header: Header | None = None
+        while h <= to_height:
+            res = self.client.commit(height=h)
+            header = Header.from_json(res["header"])
+            if header.validators_hash != self.validators.hash():
+                claimed = ValidatorSet.from_json(
+                    self.client.validators(height=h)["validators"]
+                )
+                if claimed.hash() != header.validators_hash:
+                    raise LightClientError(
+                        f"claimed validator set at {h} does not match header"
+                    )
+                if prev_header is not None and (
+                    header.last_block_id.hash != prev_header.hash()
+                ):
+                    raise LightClientError(
+                        f"header {h} does not chain to verified header {h - 1}"
+                    )
+                commit = Commit.from_json(res["commit"])
+                self._check_old_set_overlap(h, commit, claimed)
+                self.validators = claimed
+            prev_header = self.verify_header(h, _res=res)
+            self.height = h
+            h += 1
+
+    def _check_old_set_overlap(
+        self, height: int, commit: Commit, new_set: ValidatorSet
+    ) -> None:
+        """Condition (d) of advance(): > 2/3 of the OLD trusted set's
+        power signed commit(height), counting each precommit under the
+        NEW set's index order but crediting the OLD set's power."""
+        old = self.validators
+        signed_old_power = 0
+        for idx, pre in enumerate(commit.precommits):
+            if pre is None:
+                continue
+            _, val = new_set.get_by_index(idx)
+            if val is None:
+                continue
+            _, old_val = old.get_by_address(val.address)
+            if old_val is None:
+                continue
+            if old_val.pub_key.verify_bytes(
+                pre.sign_bytes(self.chain_id), pre.signature
+            ):
+                signed_old_power += old_val.voting_power
+        if signed_old_power * 3 <= old.total_voting_power() * 2:
+            raise LightClientError(
+                f"validator change at {height}: trusted set signed only "
+                f"{signed_old_power}/{old.total_voting_power()} power"
+            )
+
+    # -- data verification --------------------------------------------------
+
+    def verify_tx(self, tx_hash: bytes, header: Header) -> dict:
+        """Fetch a tx with proof and check inclusion against a VERIFIED
+        header's data_hash (types/tx.py TxProof)."""
+        from tendermint_tpu.types.tx import TxProof
+
+        from tendermint_tpu.types.tx import tx_hash as _tx_hash
+
+        res = self.client.tx(hash=tx_hash.hex(), prove=True)
+        if not res.get("proof"):
+            raise LightClientError("node returned no proof")
+        proof = TxProof.from_json(res["proof"])
+        err = proof.validate(header.data_hash)
+        if err is not None:
+            raise LightClientError(f"tx inclusion proof failed: {err}")
+        # the proof must be for the REQUESTED tx, and the response's tx
+        # bytes must be the proven ones — otherwise a node could prove
+        # some other committed tx while returning arbitrary payload
+        if _tx_hash(proof.data) != tx_hash:
+            raise LightClientError("proof is for a different tx")
+        if bytes.fromhex(res["tx"]) != bytes(proof.data):
+            raise LightClientError("response tx does not match proven tx")
+        return res

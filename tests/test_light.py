@@ -1,0 +1,145 @@
+"""Light-client trust-transition unit tests (rpc/light.py). The live-node
+integration path is tests/test_node_rpc.py::test_light_client_*; here a
+stub client serves crafted chain data so the validator-change rule —
+adopt a new set only when the OLD trusted set still signed > 2/3 of its
+power on the transition commit — can be tested for both the accept and
+the forged-set-attack cases (code-review r3 finding)."""
+
+from __future__ import annotations
+
+import pytest
+
+from tendermint_tpu.crypto.keys import gen_priv_key_ed25519
+from tendermint_tpu.rpc.light import LightClient, LightClientError
+from tendermint_tpu.types import PrivValidatorFS
+from tendermint_tpu.types.block import Header
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT, Vote
+
+CHAIN = "light-test-chain"
+
+
+def _pv():
+    return PrivValidatorFS(gen_priv_key_ed25519(), None)
+
+
+def _commit_for(header: Header, vset: ValidatorSet, privs: dict):
+    """Sign a +2/3 commit over `header` by every validator of `vset`
+    that has a priv key in `privs` (address -> pv)."""
+    from tendermint_tpu.types.block import Commit
+
+    block_id = BlockID(header.hash(), PartSetHeader(1, b"\x01" * 20))
+    precommits: list = [None] * vset.size()
+    for i in range(vset.size()):
+        addr, val = vset.get_by_index(i)
+        pv = privs.get(addr)
+        if pv is None:
+            continue
+        vote = Vote(
+            validator_address=addr,
+            validator_index=i,
+            height=header.height,
+            round_=0,
+            type_=VOTE_TYPE_PRECOMMIT,
+            block_id=block_id,
+        )
+        precommits[i] = pv.sign_vote(CHAIN, vote)
+    return Commit(block_id, precommits)
+
+
+def _header(height: int, vset: ValidatorSet, last_block_id=None) -> Header:
+    return Header(
+        chain_id=CHAIN,
+        height=height,
+        time_ns=height * 1000,
+        num_txs=0,
+        last_block_id=last_block_id or BlockID(),
+        last_commit_hash=b"\x02" * 20,
+        data_hash=b"\x03" * 20,
+        validators_hash=vset.hash(),
+        app_hash=b"",
+    )
+
+
+class StubClient:
+    def __init__(self):
+        self.commits: dict = {}  # height -> {"header":..., "commit":...}
+        self.valsets: dict = {}  # height -> ValidatorSet
+
+    def add_height(self, header, commit, vset):
+        self.commits[header.height] = {
+            "header": header.to_json(),
+            "commit": commit.to_json(),
+        }
+        self.valsets[header.height] = vset
+
+    def commit(self, height):
+        return self.commits[height]
+
+    def validators(self, height=0):
+        return {
+            "block_height": height,
+            "validators": self.valsets[height].to_json(),
+        }
+
+
+def _chain_with_change(old_signs_transition: bool):
+    """Heights 1-2 under set {v1}; at height 3 the set becomes {v1, v2}
+    (or {attacker} when old_signs_transition is False)."""
+    pv1, pv2 = _pv(), _pv()
+    v1 = Validator.new(pv1.get_pub_key(), 2)
+    v2 = Validator.new(pv2.get_pub_key(), 1)
+    old_set = ValidatorSet([v1.copy()])
+    privs = {pv1.get_address(): pv1, pv2.get_address(): pv2}
+
+    stub = StubClient()
+    prev_id = None
+    for h in (1, 2):
+        hd = _header(h, old_set, prev_id)
+        stub.add_height(hd, _commit_for(hd, old_set, privs), old_set)
+        prev_id = BlockID(hd.hash(), PartSetHeader(1, b"\x01" * 20))
+
+    if old_signs_transition:
+        new_set = ValidatorSet([v1.copy(), v2.copy()])
+    else:
+        atk = _pv()
+        privs[atk.get_address()] = atk
+        new_set = ValidatorSet([Validator.new(atk.get_pub_key(), 5)])
+    hd3 = _header(3, new_set, prev_id)
+    stub.add_height(hd3, _commit_for(hd3, new_set, privs), new_set)
+    return stub, old_set
+
+
+def test_advance_accepts_overlapping_validator_change():
+    stub, old_set = _chain_with_change(old_signs_transition=True)
+    lc = LightClient(stub, CHAIN, old_set.copy())
+    lc.advance(3)
+    assert lc.height == 3
+    assert lc.validators.size() == 2
+
+
+def test_advance_rejects_forged_validator_set():
+    """A self-consistent forged set + commit (signed only by attacker
+    keys) must NOT be adopted: the trusted set signed none of its
+    power on the transition."""
+    stub, old_set = _chain_with_change(old_signs_transition=False)
+    lc = LightClient(stub, CHAIN, old_set.copy())
+    with pytest.raises(LightClientError, match="trusted set signed only"):
+        lc.advance(3)
+
+
+def test_advance_rejects_unchained_header():
+    """A validator change whose header does not chain to the verified
+    previous header is rejected (the chain-link check runs before any
+    commit verification)."""
+    stub, old_set = _chain_with_change(old_signs_transition=True)
+    hj = dict(stub.commits[3]["header"])
+    hj["last_block_id"] = BlockID(
+        b"\xee" * 20, PartSetHeader(1, b"\x01" * 20)
+    ).to_json()
+    stub.commits[3] = {"header": hj, "commit": stub.commits[3]["commit"]}
+    lc = LightClient(stub, CHAIN, old_set.copy())
+    with pytest.raises(LightClientError, match="does not chain"):
+        lc.advance(3)

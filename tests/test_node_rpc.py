@@ -93,6 +93,16 @@ def test_block_and_blockchain_and_commit(node, client):
 def test_validators_and_genesis_and_net_info(node, client):
     vals = client.validators()
     assert len(vals["validators"]["validators"]) == 1
+    # historical form: the set that signed height 1 (light-client pairing
+    # with /commit — docs/specification/light-client-protocol.md)
+    assert wait_until(lambda: node.block_store.height() >= 1)
+    hist = client.validators(height=1)
+    assert hist["block_height"] == 1
+    assert len(hist["validators"]["validators"]) == 1
+    import pytest as _pytest
+
+    with _pytest.raises(Exception):
+        client.validators(height=10_000)
     gen = client.genesis()
     assert gen["genesis"]["chain_id"] == node.genesis_doc.chain_id
     ni = client.net_info()
@@ -160,3 +170,45 @@ def test_commit_missing_meta_is_rpc_error():
 
     with _pytest.raises(RPCError):
         commit(_Ctx(), 3)
+
+
+def test_light_client_verifies_headers_and_txs(node, client):
+    """rpc/light.py against a live node: bootstrap trust from genesis,
+    advance through real heights, verify a header + tx inclusion proof,
+    and reject tampering (docs/specification/light-client-protocol.md)."""
+    from tendermint_tpu.rpc.light import LightClient, LightClientError
+    from tendermint_tpu.types.tx import tx_hash
+
+    # commit a tx so there's something to prove
+    tx = b"light-key=light-value"
+    res = client.broadcast_tx_commit(tx=tx.hex())
+    tx_height = res["height"]
+    assert wait_until(lambda: node.block_store.height() >= tx_height + 1)
+
+    lc = LightClient.from_genesis(client)
+    lc.advance(tx_height)
+    assert lc.height == tx_height
+    header = lc.verify_header(tx_height)
+    assert header.height == tx_height
+
+    # the tx's inclusion proof checks out against the verified header
+    verified = lc.verify_tx(tx_hash(tx), header)
+    assert bytes.fromhex(verified["tx"]) == tx
+
+    # tampering: a wrong chain id must fail
+    bad = LightClient.from_genesis(client)
+    bad.chain_id = "not-the-chain"
+    with pytest.raises(LightClientError):
+        bad.verify_header(1)
+
+    # tampering: a forged validator set must fail
+    from tendermint_tpu.crypto.keys import gen_priv_key_ed25519
+    from tendermint_tpu.types.validator import Validator
+    from tendermint_tpu.types.validator_set import ValidatorSet
+
+    forged = LightClient.from_genesis(client)
+    forged.validators = ValidatorSet(
+        [Validator.new(gen_priv_key_ed25519().pub_key(), 1)]
+    )
+    with pytest.raises(LightClientError):
+        forged.verify_header(1)
