@@ -80,6 +80,8 @@ def cmd_node(args) -> int:
         cfg.p2p.seeds = args.seeds
     if args.pex:
         cfg.p2p.pex_reactor = True
+    if args.addr_book_strict is not None:
+        cfg.p2p.addr_book_strict = args.addr_book_strict == "true"
 
     # TENDERMINT_RACECHECK=1 == running the reference under `go test -race`:
     # every lock the node builds joins a process-wide order graph, reported
@@ -243,6 +245,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--rpc.grpc_laddr", dest="grpc_laddr", default=None)
     sp.add_argument("--seeds", default=None, help="comma-separated host:port")
     sp.add_argument("--pex", action="store_true")
+    sp.add_argument(
+        "--p2p.addr_book_strict",
+        dest="addr_book_strict",
+        default=None,
+        choices=["true", "false"],
+        help="only store globally-routable peer addresses (turn off for "
+        "loopback testnets; p2p/addrbook.py routability)",
+    )
     sp.add_argument("--log_level", default="info")
     sp.add_argument("--db_backend", default=None, help="memdb | filedb")
     sp.set_defaults(fn=cmd_node)

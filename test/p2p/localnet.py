@@ -4,7 +4,8 @@ PROCESSES over real TCP — same isolation properties that matter for the
 scenarios: separate interpreters, separate homes/DBs/WALs, kill -9
 crash semantics, reconnection over sockets).
 
-Used by scenarios.py (basic, atomic_broadcast, fast_sync, kill_all) and
+Used by scenarios.py (basic, atomic_broadcast, fast_sync, kill_all,
+seeds, pex) and
 the pytest wrapper tests/test_localnet.py. Where docker IS available,
 test/p2p/Dockerfile + run_docker.sh wrap the same scenarios in
 containers.

@@ -1,5 +1,5 @@
 """The multi-machine scenarios (reference: test/p2p/{basic,
-atomic_broadcast,fast_sync,kill_all}/test.sh), runnable against a
+atomic_broadcast,fast_sync,kill_all,pex,seeds}), runnable against a
 process-based Localnet — or, via run_docker.sh, against containers.
 
 Each scenario takes a started-or-startable Localnet and raises
@@ -84,11 +84,52 @@ def kill_all(net: Localnet) -> None:
     net.assert_chains_agree(pre + 3)
 
 
+def seeds(net: Localnet) -> None:
+    """Star bootstrap: every node dials ONLY node 0 as its seed; gossip
+    relays through the hub and the whole net still commits identical
+    chains (test/p2p/seeds.sh)."""
+    hub = net.nodes[0]
+    hub.start()
+    for nd in net.nodes[1:]:
+        nd.start(seeds=f"127.0.0.1:{hub.p2p_port}")
+    assert net.wait_height(3, timeout=180), f"star net stuck: {net.heights()}"
+    net.assert_chains_agree(3)
+
+
+def pex(net: Localnet) -> None:
+    """Peer discovery: same star seeding, but with the PEX reactor on —
+    nodes must LEARN the other peers through the hub and form a denser
+    mesh (> 1 peer each), and the chain advances
+    (test/p2p/pex/test.sh)."""
+    hub = net.nodes[0]
+    pex_args = ["--pex", "--p2p.addr_book_strict", "false"]
+    hub.start(extra=pex_args)
+    for nd in net.nodes[1:]:
+        nd.start(seeds=f"127.0.0.1:{hub.p2p_port}", extra=pex_args)
+    assert net.wait_height(2, timeout=180), f"pex net stuck: {net.heights()}"
+    deadline = time.monotonic() + 120
+    dense = set()
+    while time.monotonic() < deadline and len(dense) < len(net.nodes) - 1:
+        for nd in net.nodes[1:]:
+            try:
+                if len(nd.rpc("net_info")["peers"]) > 1:
+                    dense.add(nd.index)
+            except Exception:  # noqa: BLE001 — still starting
+                pass
+        time.sleep(1)
+    assert len(dense) >= len(net.nodes) - 1, (
+        f"pex never densified the mesh: {sorted(dense)} of "
+        f"{[nd.index for nd in net.nodes[1:]]}"
+    )
+
+
 SCENARIOS = {
     "basic": basic,
     "atomic_broadcast": atomic_broadcast,
     "fast_sync": fast_sync,
     "kill_all": kill_all,
+    "seeds": seeds,
+    "pex": pex,
 }
 
 
