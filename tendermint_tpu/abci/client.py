@@ -37,13 +37,18 @@ class ReqRes:
     def __init__(self, req_type: str):
         self.req_type = req_type
         self.response = None
-        self._done = threading.Event()
+        self._done = False
+        # Event allocated only when someone actually blocks in wait():
+        # the local client completes synchronously and the async mempool
+        # path is callback-driven, so a CheckTx burst was paying one
+        # Condition construction per tx for an Event nothing waited on
+        self._done_evt: threading.Event | None = None
         self._cb: Callable | None = None
         self._mtx = threading.Lock()
 
     def set_callback(self, cb: Callable) -> None:
         with self._mtx:
-            if self._done.is_set():
+            if self._done:
                 cb(self.response)
                 return
             self._cb = cb
@@ -51,13 +56,25 @@ class ReqRes:
     def complete(self, response) -> None:
         with self._mtx:
             self.response = response
-            self._done.set()
+            self._done = True
+            if self._done_evt is not None:
+                self._done_evt.set()
             cb = self._cb
         if cb:
             cb(response)
 
+    def done(self) -> bool:
+        with self._mtx:
+            return self._done
+
     def wait(self, timeout: float | None = None):
-        self._done.wait(timeout)
+        with self._mtx:
+            if self._done:
+                return self.response
+            if self._done_evt is None:
+                self._done_evt = threading.Event()
+            evt = self._done_evt
+        evt.wait(timeout)
         return self.response
 
 
@@ -300,7 +317,7 @@ class SocketClient(ABCIClient):
         res = rr.wait(timeout)
         if self._err:
             raise self._err
-        if res is None and not rr._done.is_set():
+        if res is None and not rr.done():
             raise TimeoutError(f"abci {req['type']} timed out after {timeout}s")
         return res
 

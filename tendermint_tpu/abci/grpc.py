@@ -227,7 +227,7 @@ class GRPCClient(ABCIClient):
         rr = ReqRes("flush")
         self._q.put((rr, {"type": "flush"}))
         rr.wait(self._timeout)
-        if not rr._done.is_set():
+        if not rr.done():
             raise TimeoutError(f"abci flush timed out after {self._timeout}s")
         if self._err:
             raise self._err

@@ -241,3 +241,21 @@ class TestAutofile:
         g2.flush()
         assert g2.read_all_lines() == ["first", "second"]
         g2.close()
+
+
+def test_reqres_done_and_timeout_path():
+    """ReqRes after the lazy-Event rewrite: done() is the public probe
+    (code-review r3: SocketClient's timeout path uses it), wait() before
+    and after completion, and callback-after-done fires immediately."""
+    from tendermint_tpu.abci.client import ReqRes
+
+    rr = ReqRes("echo")
+    assert not rr.done()
+    assert rr.wait(timeout=0.01) is None  # timeout: not done, no crash
+    assert not rr.done()
+    rr.complete({"ok": True})
+    assert rr.done()
+    assert rr.wait() == {"ok": True}
+    got = []
+    rr.set_callback(got.append)  # already done -> fires inline
+    assert got == [{"ok": True}]
