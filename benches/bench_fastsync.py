@@ -81,13 +81,17 @@ def main() -> None:
     verifier = Verifier(min_tpu_batch=32)
     hasher = Hasher()  # production policy: CPU hashing
 
-    # warmup/compile the verify kernel at the GROUP bucket (the shape the
-    # measured pipeline dispatches), not the single-commit bucket
-    GROUP_TARGET = int(os.environ.get("BENCH_GROUP_SIG_TARGET", "1024"))
-    per_group = max(1, GROUP_TARGET // N_VALS)
-    warm = [(bid, i + 1, c) for i, (bid, c) in enumerate(commits[:per_group])]
-    for fin in vs.verify_commits_async(CHAIN_ID, warm, verifier.verify_batch_async):
-        fin()
+    # group with the reactor's OWN rule so the bench measures exactly the
+    # dispatch shapes _dispatch_speculative produces, and warm every
+    # distinct group size (the tail group hits a smaller kernel bucket)
+    from tendermint_tpu.blockchain.reactor import group_spans
+
+    GROUP_TARGET = int(os.environ.get("BENCH_GROUP_SIG_TARGET", "4096"))
+    spans = group_spans([N_VALS] * N_BLOCKS, GROUP_TARGET)
+    for size in {j - i for i, j in spans}:
+        warm = [(bid, i + 1, c) for i, (bid, c) in enumerate(commits[:size])]
+        for fin in vs.verify_commits_async(CHAIN_ID, warm, verifier.verify_batch_async):
+            fin()
 
     # -- CPU reference: sequential verify + hash, block by block ----------
     t0 = time.perf_counter()
@@ -100,15 +104,15 @@ def main() -> None:
     # (blockchain/reactor._dispatch_speculative): commits grouped into
     # device calls of ~GROUP_TARGET signatures, several calls in flight,
     # resolved while the host hashes part sets --------------------------
-    DEPTH = int(os.environ.get("BENCH_PIPELINE_DEPTH", "4"))
+    DEPTH = int(os.environ.get("BENCH_PIPELINE_DEPTH", "8"))
     PASSES = int(os.environ.get("BENCH_PASSES", "2"))  # best-of: the chip
     # sits behind a shared tunnel, so single passes see contention noise
     tpu_s = float("inf")
     for _ in range(PASSES):
         t0 = time.perf_counter()
         pending: list = []
-        for g in range(0, N_BLOCKS, per_group):
-            group = commits[g : g + per_group]
+        for g, g_end in spans:
+            group = commits[g:g_end]
             pending.extend(
                 vs.verify_commits_async(
                     CHAIN_ID,
@@ -116,7 +120,7 @@ def main() -> None:
                     verifier.verify_batch_async,
                 )
             )
-            for payload in payloads[g : g + per_group]:
+            for payload in payloads[g:g_end]:
                 PartSet.from_data(payload, PART_SIZE, hasher=hasher.part_leaf_hashes)
             while len(pending) > DEPTH:
                 pending.pop(0)()

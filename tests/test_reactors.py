@@ -329,3 +329,18 @@ def test_mempool_reactor_gossips_txs():
         assert n2.mempool.reap(10) == [tx]
     finally:
         stop_net([n1, n2], switches)
+
+
+def test_speculative_group_spans_never_overshoot():
+    """Grouping must stop BEFORE exceeding group_sig_target so dispatches
+    stay in the intended power-of-two kernel bucket (code-review r3)."""
+    from tendermint_tpu.blockchain.reactor import group_spans
+
+    # 1000-validator commits, target 4096: groups of 4, never 5
+    assert group_spans([1000] * 9, 4096) == [(0, 4), (4, 8), (8, 9)]
+    # one commit larger than the target still goes alone
+    assert group_spans([5000, 100, 100], 4096) == [(0, 1), (1, 3)]
+    # small commits pack tightly up to the boundary
+    assert group_spans([1024] * 4, 4096) == [(0, 4)]
+    assert group_spans([1025] * 4, 4096) == [(0, 3), (3, 4)]
+    assert group_spans([], 4096) == []
