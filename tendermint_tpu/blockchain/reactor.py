@@ -217,6 +217,7 @@ class BlockchainReactor(Reactor, BaseService):
                 if self.pool.is_caught_up():
                     self.logger.info("caught up; switching to consensus")
                     self.pool.stop()
+                    self.fast_sync = False  # /metrics fastsync_active
                     con_r = self.switch.reactor("CONSENSUS")
                     if con_r is not None and hasattr(con_r, "switch_to_consensus"):
                         con_r.switch_to_consensus(self.state)

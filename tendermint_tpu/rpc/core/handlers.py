@@ -327,6 +327,40 @@ def unsafe_dial_seeds(ctx, seeds) -> dict:
     return {"log": "dialing seeds in rounds"}
 
 
+def metrics(ctx) -> dict:
+    """Flat numeric snapshot of node health — consensus position, mempool
+    depth, peer counts, fast-sync progress, and the TPU gateway counters
+    (tpu_sigs moving is how an operator confirms the device path is live).
+    Beyond-reference observability: the reference declares a go-metrics
+    dep it never wires (SURVEY.md §5); here the node exports one."""
+    out: dict = {}
+    rs = ctx.consensus_state.get_round_state()
+    out["consensus_height"] = rs.height
+    out["consensus_round"] = rs.round_
+    out["consensus_step"] = int(rs.step)
+    out["blockstore_height"] = ctx.block_store.height()
+    out["mempool_size"] = ctx.mempool.size()
+    outbound, inbound, dialing = ctx.switch.num_peers()
+    out["p2p_peers_outbound"] = outbound
+    out["p2p_peers_inbound"] = inbound
+    out["p2p_peers_dialing"] = dialing
+    node = ctx.node
+    bc = getattr(node, "blockchain_reactor", None)
+    if bc is not None:
+        out["fastsync_active"] = int(bool(bc.fast_sync))
+        out["fastsync_blocks_synced"] = bc.blocks_synced
+        out["fastsync_rate_blocks_per_sec"] = round(bc.sync_rate, 3)
+    verifier = getattr(node, "verifier", None)
+    if verifier is not None:
+        for k, v in verifier.stats().items():
+            out[f"gateway_verify_{k}"] = v
+    hasher = getattr(node, "hasher", None)
+    if hasher is not None:
+        for k, v in hasher.stats().items():
+            out[f"gateway_hash_{k}"] = v
+    return out
+
+
 def unsafe_flush_mempool(ctx) -> dict:
     ctx.mempool.flush()
     return {}
@@ -385,6 +419,7 @@ ROUTES_TABLE = {
     "commit": (commit, ["height"]),
     "validators": (validators, ["height"]),
     "dump_consensus_state": (dump_consensus_state, []),
+    "metrics": (metrics, []),
     "tx": (tx, ["hash", "prove"]),
     "unconfirmed_txs": (unconfirmed_txs, []),
     "num_unconfirmed_txs": (num_unconfirmed_txs, []),

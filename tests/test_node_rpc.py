@@ -212,3 +212,14 @@ def test_light_client_verifies_headers_and_txs(node, client):
     )
     with pytest.raises(LightClientError):
         forged.verify_header(1)
+
+
+def test_metrics_endpoint(node, client):
+    m = client.metrics()
+    assert m["consensus_height"] >= 1
+    assert m["blockstore_height"] >= 1
+    assert m["mempool_size"] >= 0
+    assert "p2p_peers_outbound" in m and "p2p_peers_inbound" in m
+    assert "gateway_verify_tpu_sigs" in m
+    assert "gateway_hash_cpu_leaves" in m
+    assert all(isinstance(v, (int, float)) for v in m.values()), m

@@ -284,9 +284,19 @@ def test_fast_sync_rides_the_tpu_gateway():
         node_a.cs.stop()
         target = node_a.store.height()
         connect2_switches(switches, 0, 1)
-        assert wait_until(
-            lambda: node_b.store.height() >= target, timeout=120
-        ), f"B at {node_b.store.height()}, A at {target}"
+        if not wait_until(lambda: node_b.store.height() >= target, timeout=120):
+            # stall diagnostics: the flake signature is B stuck at 0 under
+            # heavy parallel load — record enough to tell "never connected"
+            # from "connected but no requests" from "requests but no blocks"
+            bc_b = switches[1].reactors.get("BLOCKCHAIN")
+            raise AssertionError(
+                f"B at {node_b.store.height()}, A at {target}; "
+                f"peers A={switches[0].peers.size()} B={switches[1].peers.size()}; "
+                f"B pool height={bc_b.pool.height} "
+                f"requesters={len(bc_b.pool.requesters)} "
+                f"max_peer_height={bc_b.pool.max_peer_height}; "
+                f"B synced={bc_b.blocks_synced}"
+            )
         for h in range(1, target + 1):
             assert node_b.store.load_block(h).hash() == node_a.store.load_block(h).hash()
         vstats, hstats = verifier.stats(), hasher.stats()
@@ -344,3 +354,46 @@ def test_speculative_group_spans_never_overshoot():
     assert group_spans([1024] * 4, 4096) == [(0, 4)]
     assert group_spans([1025] * 4, 4096) == [(0, 3), (3, 4)]
     assert group_spans([], 4096) == []
+
+
+def test_fastsync_flag_clears_on_switchover():
+    """/metrics fastsync_active must go 0 once the node switches to
+    consensus (code-review r3: the constructor flag was never cleared)."""
+    from tendermint_tpu.blockchain.reactor import BlockchainReactor
+
+    doc, pvs = make_genesis(1)
+    node = make_node(doc, pvs[0])
+    bc = BlockchainReactor(
+        node.state.copy(), node.cs.proxy_app_conn, node.store, fast_sync=True,
+        status_update_interval=0.05,
+    )
+
+    class _FakePool:
+        def is_running(self):
+            return True
+
+        def is_caught_up(self):
+            return True
+
+        def stop(self):
+            pass
+
+        def peek_blocks(self, n):
+            return []
+
+        def peek_two_blocks(self):
+            return (None, None)
+
+    class _FakeSwitch:
+        def reactor(self, name):
+            return None
+
+        def broadcast(self, *a, **k):
+            return []
+
+    bc.pool = _FakePool()
+    bc.switch = _FakeSwitch()
+    bc._started = True  # the routine guards on is_running()
+    assert bc.fast_sync is True
+    bc._pool_routine()  # caught up immediately -> switchover path
+    assert bc.fast_sync is False
