@@ -66,33 +66,6 @@ def _make_items(n: int, salt: int = 0):
     return items
 
 
-def _probe_device(timeout_s: float = 90.0) -> str | None:
-    """Touch the accelerator with a bounded wait. The axon tunnel can
-    wedge such that jax.devices()/the first op BLOCKS forever (observed
-    after a benchmark process was killed mid-device-op); a hung bench
-    records nothing, which is strictly worse than an honest CPU line.
-    Returns the platform name, or None if the device never answered."""
-    import threading
-
-    result: list = []
-
-    def probe():
-        try:
-            import jax
-            import jax.numpy as jnp
-
-            d = jax.devices()[0]
-            jnp.zeros((8, 128)).sum().block_until_ready()
-            result.append(d.platform)
-        except Exception:  # noqa: BLE001 — unreachable counts as absent
-            pass
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    return result[0] if result else None
-
-
 def main() -> None:
     import queue as _q
     import threading as _t
@@ -103,7 +76,9 @@ def main() -> None:
     if os.environ.get("TENDERMINT_TPU_DISABLE", "") == "1":
         platform = "cpu (TENDERMINT_TPU_DISABLE)"  # don't dial the device
     else:
-        platform = _probe_device()
+        from tendermint_tpu.jitcache import probe_device
+
+        platform = probe_device()
         if platform is None:
             # the gateway would dial the same dead tunnel; pin CPU so the
             # run below measures the honest fallback instead of hanging

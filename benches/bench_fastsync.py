@@ -24,6 +24,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tendermint_tpu.jitcache import enable as _enable_jit_cache
+from tendermint_tpu.jitcache import platform_label
 
 _enable_jit_cache()
 
@@ -72,8 +73,6 @@ def build_chain():
 
 
 def main() -> None:
-    import jax
-
     from tendermint_tpu.ops.gateway import Hasher, Verifier
     from tendermint_tpu.types.part_set import PartSet
 
@@ -142,7 +141,7 @@ def main() -> None:
                     "cpu_blocks_per_sec": round(N_BLOCKS / cpu_s, 2),
                     "tpu_sigs_per_sec": round(total_sigs / tpu_s, 1),
                     "cpu_sigs_per_sec": round(total_sigs / cpu_s, 1),
-                    "platform": jax.devices()[0].platform,
+                    "platform": platform_label(),
                     "gateway_stats": verifier.stats(),
                 },
             }

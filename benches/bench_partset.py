@@ -20,6 +20,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tendermint_tpu.jitcache import enable as _enable_jit_cache
+from tendermint_tpu.jitcache import platform_label
 
 _enable_jit_cache()
 
@@ -29,8 +30,6 @@ N_BLOCKS = int(os.environ.get("BENCH_N_BLOCKS", "24"))
 
 
 def main() -> None:
-    import jax
-
     from tendermint_tpu.ops.gateway import Hasher
     from tendermint_tpu.types.part_set import PartSet
 
@@ -41,7 +40,10 @@ def main() -> None:
     # production hasher: CPU by default (the measured winner for hashing;
     # see the Hasher docstring), TPU offload kernels measured separately
     prod = Hasher()
-    tpu = Hasher(min_tpu_batch=1, use_tpu=True)
+    # offload measurement dials the device; honor an explicit disable
+    # (run_all pins it when the tunnel is unreachable)
+    offload = os.environ.get("TENDERMINT_TPU_DISABLE", "") != "1"
+    tpu = Hasher(min_tpu_batch=1, use_tpu=offload)
 
     # warmup / compile the offload kernel
     warm = PartSet.from_data(blocks[0], PART_SIZE, hasher=tpu.part_leaf_hashes)
@@ -102,7 +104,7 @@ def main() -> None:
                     "cpu_mb_per_sec": round(mb / cpu_s, 2),
                     "tpu_offload_mb_per_sec": round(mb / tpu_s, 2),
                     "policy": "cpu-default (see gateway.Hasher docstring)",
-                    "platform": jax.devices()[0].platform,
+                    "platform": platform_label(),
                     "offload_stats": tpu.stats(),
                     "parity": "ok",
                     "proofs": "verified",

@@ -36,6 +36,34 @@ def main() -> int:
         if a.startswith("--skip"):
             skip = set(a.split("=", 1)[1].split(","))
     results: dict = {"recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    # one probe up front: a wedged tunnel would otherwise stall EVERY
+    # device-dialing sub-bench for its full 30-min timeout (jitcache.probe_device
+    # docstring has the failure mode)
+    env = dict(os.environ)
+    if env.get("TENDERMINT_TPU_DISABLE", "") != "1":
+        # probe in a THROWAWAY subprocess: probing in-process would
+        # initialize this parent's jax backend and hold the exclusive
+        # device, starving every sub-bench (each bench is its own
+        # process precisely because the TPU is exclusive per process)
+        probe = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import sys; sys.path.insert(0, %r); "
+                "from tendermint_tpu.jitcache import probe_device; "
+                "sys.exit(0 if probe_device() else 3)" % ROOT,
+            ],
+            cwd=ROOT,
+            timeout=180,
+        )
+        if probe.returncode != 0:
+            print(
+                "run_all: accelerator unreachable; all benches measure "
+                "the CPU fallback",
+                file=sys.stderr,
+            )
+            env["TENDERMINT_TPU_DISABLE"] = "1"
+            results["device"] = "unreachable; CPU fallback"
     failed = False
     for name, cmd in BENCHES.items():
         if any(s in name for s in skip):
@@ -43,7 +71,7 @@ def main() -> int:
         print(f"== {name}: {' '.join(cmd[1:])}", file=sys.stderr)
         t0 = time.time()
         proc = subprocess.run(
-            cmd, cwd=ROOT, capture_output=True, text=True, timeout=1800
+            cmd, cwd=ROOT, capture_output=True, text=True, timeout=1800, env=env
         )
         line = next(
             (l for l in reversed(proc.stdout.splitlines()) if l.startswith("{")), None

@@ -24,3 +24,41 @@ def enable(cache_dir: str | None = None) -> None:
     jax.config.update("jax_compilation_cache_dir", cache_dir or _DEFAULT_DIR)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+
+def probe_device(timeout_s: float = 90.0) -> str | None:
+    """Touch the accelerator with a bounded wait; the platform name, or
+    None if the device never answered. jax.devices()/the first device op
+    can block FOREVER on a wedged axon tunnel (observed after a process
+    died mid-device-op), so the dial runs in a daemon thread. NOTE:
+    probing initializes this process's jax backend — on exclusive-device
+    platforms a parent that probes then holds the device; orchestrators
+    spawning per-bench subprocesses must probe in a throwaway subprocess
+    (benches/run_all.py does)."""
+    import threading
+
+    out: list = []
+
+    def probe():
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            d = jax.devices()[0]
+            jnp.zeros((8, 128)).sum().block_until_ready()
+            out.append(d.platform)
+        except Exception:  # noqa: BLE001 — unreachable counts as absent
+            pass
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return out[0] if out else None
+
+
+def platform_label(probe_timeout: float = 30.0) -> str:
+    """Backend platform name for bench output, WITHOUT risking a hang; an
+    explicit TENDERMINT_TPU_DISABLE skips the dial entirely."""
+    if os.environ.get("TENDERMINT_TPU_DISABLE", "") == "1":
+        return "cpu (TENDERMINT_TPU_DISABLE)"
+    return probe_device(probe_timeout) or "unknown (device unreachable)"

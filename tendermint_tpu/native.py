@@ -91,6 +91,20 @@ def available() -> bool:
     return get_lib() is not None
 
 
+def ready() -> bool:
+    """available() WITHOUT triggering a build: True only when the library
+    is already loaded or the prebuilt .so is current. Hot paths (the
+    gateway's CPU verify fallback) call this so the first wide batch can
+    never block consensus behind a 300s compiler run; anything that wants
+    the build to happen calls available() at startup instead."""
+    with _lib_mtx:
+        if _lib is not None:
+            return True
+        if _load_failed:
+            return False
+    return os.path.exists(_LIB_PATH) and not _sources_newer_than_lib()
+
+
 def _as_u8p(arr: np.ndarray):
     return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 

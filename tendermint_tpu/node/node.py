@@ -82,6 +82,16 @@ class Node(BaseService):
         self.verifier = gateway.default_verifier()
         self.hasher = gateway.default_hasher()
         tx_types.set_batch_tx_root(self.hasher.tx_merkle_root)
+        # warm the native marshal/verify library off the hot path: the
+        # gateway's CPU fallback only uses it when ready() (never builds
+        # inline), so trigger the build/load here in the background
+        import threading as _threading
+
+        from tendermint_tpu import native as _native
+
+        _threading.Thread(
+            target=_native.available, daemon=True, name="native.warm"
+        ).start()
 
         # -- tx index (node.go:164-176) -----------------------------------
         if config.base.tx_index == "kv":

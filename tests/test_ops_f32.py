@@ -427,3 +427,38 @@ class TestPallasF32Kernel:
         mesh = Mesh(np.array(jax.devices()[:1]), ("batch",))
         sv = gw.ShardedVerifier(mesh)
         assert sv._kernel_module().__name__ == "tendermint_tpu.ops.ed25519_f32"
+
+
+class TestCpuFallbackNative:
+    """gateway._cpu_verify_batch rides the native C++ batch verifier for
+    wide ed25519 batches; semantics must be identical to the per-item
+    python loop on every edge case."""
+
+    def test_parity_with_per_item_loop(self):
+        from tendermint_tpu import native
+        from tendermint_tpu.crypto.keys import verify_any
+        from tendermint_tpu.ops.gateway import _cpu_verify_batch
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        items = _mixed_items()
+        # pad to cross the >=16 wide-batch threshold
+        seeds = [bytes([i + 50]) * 32 for i in range(12)]
+        items += [
+            (ed.public_key(s), b"pad-%d" % i, ed.sign(s, b"pad-%d" % i))
+            for i, s in enumerate(seeds)
+        ]
+        got = _cpu_verify_batch(items)
+        exp = [verify_any(p, m, s) for p, m, s in items]
+        assert got == exp
+
+    def test_small_and_mixed_batches_stay_per_item(self):
+        from tendermint_tpu.ops.gateway import _cpu_verify_batch
+
+        seed = b"\x41" * 32
+        small = [(ed.public_key(seed), b"s", ed.sign(seed, b"s"))]
+        assert _cpu_verify_batch(small) == [True]
+        # a secp-length key in the batch keeps the whole batch per-item
+        mixed = small * 16 + [(b"\x02" * 33, b"m", b"\x00" * 64)]
+        res = _cpu_verify_batch(mixed)
+        assert res[:16] == [True] * 16 and res[16] is False
