@@ -45,18 +45,23 @@ def main() -> int:
         # initialize this parent's jax backend and hold the exclusive
         # device, starving every sub-bench (each bench is its own
         # process precisely because the TPU is exclusive per process)
-        probe = subprocess.run(
-            [
-                sys.executable,
-                "-c",
-                "import sys; sys.path.insert(0, %r); "
-                "from tendermint_tpu.jitcache import probe_device; "
-                "sys.exit(0 if probe_device() else 3)" % ROOT,
-            ],
-            cwd=ROOT,
-            timeout=180,
-        )
-        if probe.returncode != 0:
+        try:
+            probe_rc = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import sys; sys.path.insert(0, %r); "
+                    "from tendermint_tpu.jitcache import probe_device; "
+                    "sys.exit(0 if probe_device() else 3)" % ROOT,
+                ],
+                cwd=ROOT,
+                timeout=180,
+            ).returncode
+        except subprocess.TimeoutExpired:
+            # the child found the device dead but jax's atexit teardown
+            # hung on it — exactly the wedge the probe exists to detect
+            probe_rc = 3
+        if probe_rc != 0:
             print(
                 "run_all: accelerator unreachable; all benches measure "
                 "the CPU fallback",
@@ -70,9 +75,15 @@ def main() -> int:
             continue
         print(f"== {name}: {' '.join(cmd[1:])}", file=sys.stderr)
         t0 = time.time()
-        proc = subprocess.run(
-            cmd, cwd=ROOT, capture_output=True, text=True, timeout=1800, env=env
-        )
+        try:
+            proc = subprocess.run(
+                cmd, cwd=ROOT, capture_output=True, text=True, timeout=1800, env=env
+            )
+        except subprocess.TimeoutExpired as exc:
+            results[name] = {"error": f"timeout after {exc.timeout}s"}
+            failed = True
+            print(f"   TIMEOUT ({time.time()-t0:.0f}s)", file=sys.stderr)
+            continue
         line = next(
             (l for l in reversed(proc.stdout.splitlines()) if l.startswith("{")), None
         )

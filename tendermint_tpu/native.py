@@ -97,11 +97,18 @@ def ready() -> bool:
     gateway's CPU verify fallback) call this so the first wide batch can
     never block consensus behind a 300s compiler run; anything that wants
     the build to happen calls available() at startup instead."""
-    with _lib_mtx:
+    # non-blocking: the warm thread holds _lib_mtx for the whole build
+    # (up to 300s) — while it does, the hot path must see "not ready",
+    # never wait
+    if not _lib_mtx.acquire(blocking=False):
+        return False
+    try:
         if _lib is not None:
             return True
         if _load_failed:
             return False
+    finally:
+        _lib_mtx.release()
     return os.path.exists(_LIB_PATH) and not _sources_newer_than_lib()
 
 

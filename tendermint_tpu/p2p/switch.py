@@ -332,7 +332,10 @@ class Switch(BaseService):
     def stop_peer_for_error(self, peer: Peer, reason) -> None:
         if not self.peers.has(peer.id()):
             return
-        self.logger.info("stopping peer %s for error: %s", peer, reason)
+        # warning, not info: a peer dropped for cause is an operator-
+        # relevant event (and surfaces in pytest's captured-log section
+        # when a net test fails)
+        self.logger.warning("stopping peer %s for error: %s", peer, reason)
         self._stop_and_remove(peer, reason)
         if peer.persistent and self.is_running():
             # reconnect to the address WE dialed, not anything the peer

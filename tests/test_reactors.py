@@ -289,13 +289,19 @@ def test_fast_sync_rides_the_tpu_gateway():
             # heavy parallel load — record enough to tell "never connected"
             # from "connected but no requests" from "requests but no blocks"
             bc_b = switches[1].reactors.get("BLOCKCHAIN")
+            from collections import Counter
+
+            names = Counter(
+                t.name.split("-")[0].split(".")[0] for t in threading.enumerate()
+            )
             raise AssertionError(
                 f"B at {node_b.store.height()}, A at {target}; "
                 f"peers A={switches[0].peers.size()} B={switches[1].peers.size()}; "
                 f"B pool height={bc_b.pool.height} "
                 f"requesters={len(bc_b.pool.requesters)} "
                 f"max_peer_height={bc_b.pool.max_peer_height}; "
-                f"B synced={bc_b.blocks_synced}"
+                f"B synced={bc_b.blocks_synced}; "
+                f"threads={threading.active_count()} {dict(names.most_common(8))}"
             )
         for h in range(1, target + 1):
             assert node_b.store.load_block(h).hash() == node_a.store.load_block(h).hash()
