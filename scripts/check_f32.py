@@ -75,22 +75,15 @@ def main():
     el = (time.perf_counter() - t0) / REPS
     print(f"f32 sustained: {el*1e3:.1f} ms/batch = {B/el:.0f} sigs/s")
 
-    # f32p (pallas ladder): SAME protocol — pre-marshaled device-resident
-    # args, one aggregate fetch (timing the public async entry would fold
-    # the host marshal into the device number)
-    s_total = B // 128
-    pargs = (
-        jax.device_put(np.asarray(prep[0]).reshape(32, s_total, 128)),
-        jax.device_put(np.asarray(prep[1]).reshape(32, s_total, 128)),
-        jax.device_put(np.asarray(prep[2]).reshape(32, s_total, 128)),
-        jax.device_put(np.asarray(prep[3]).reshape(1, s_total, 128)),
-    )
-    dig_s, dig_h = FP._expand_digits(jnp.asarray(prep[4]), jnp.asarray(prep[5]))
-    fnp = FP._get_verify(FP.S_TILE, False)
-    okp = np.asarray(fnp(*pargs, dig_s, dig_h))
+    # f32p (pallas ladder): SAME protocol — the production marshal runs
+    # ONCE (FP.marshal_device_args, the same helper verify_batch_async
+    # uses), then only the device call is timed with one aggregate fetch
+    pargs, _valid, _n = FP.marshal_device_args(items)
+    fnp = FP._get_verify(FP.S_TILE, not FP._on_tpu())
+    okp = np.asarray(fnp(*pargs))
     assert (okp.reshape(-1)[:B] != 0).all()
     t0 = time.perf_counter()
-    outs = [fnp(*pargs, dig_s, dig_h) for _ in range(REPS)]
+    outs = [fnp(*pargs) for _ in range(REPS)]
     np.asarray(jnp.stack(outs))
     el = (time.perf_counter() - t0) / REPS
     print(f"f32p sustained: {el*1e3:.1f} ms/batch = {B/el:.0f} sigs/s")

@@ -97,18 +97,19 @@ def ready() -> bool:
     gateway's CPU verify fallback) call this so the first wide batch can
     never block consensus behind a 300s compiler run; anything that wants
     the build to happen calls available() at startup instead."""
-    # non-blocking: the warm thread holds _lib_mtx for the whole build
-    # (up to 300s) — while it does, the hot path must see "not ready",
-    # never wait
+    # lock-free fast path: these reads are GIL-atomic, and a loaded
+    # library must never be reported not-ready just because another
+    # thread briefly holds the mutex
+    if _lib is not None:
+        return True
+    if _load_failed:
+        return False
+    # non-blocking probe: the warm thread holds _lib_mtx for the whole
+    # build (up to 300s) — while it does, the hot path must see
+    # "not ready", never wait
     if not _lib_mtx.acquire(blocking=False):
         return False
-    try:
-        if _lib is not None:
-            return True
-        if _load_failed:
-            return False
-    finally:
-        _lib_mtx.release()
+    _lib_mtx.release()
     return os.path.exists(_LIB_PATH) and not _sources_newer_than_lib()
 
 

@@ -339,13 +339,13 @@ def _expand_digits(s8, h8):
     return ds, dh
 
 
-def verify_batch_async(items: list[tuple[bytes, bytes, bytes]]):
-    """Marshal + enqueue now; return a zero-arg resolver for bool[B] —
-    same pipelining contract as base.verify_batch_async."""
+def marshal_device_args(items: list[tuple[bytes, bytes, bytes]]):
+    """Host marshal + H2D: kernel-call args for a batch. Returns
+    (args, valid, n) where args feeds _get_verify(S_TILE, ...) directly.
+    The SINGLE definition of the dispatch layout — verify_batch_async and
+    the out-of-suite soak (scripts/check_f32.py) both use it, so a layout
+    change cannot silently leave the soak measuring a stale path."""
     n = len(items)
-    if n == 0:
-        return lambda: np.zeros(0, dtype=bool)
-    interpret = not _on_tpu()
     tile_lanes = S_TILE * 128
     # power-of-two tile counts so distinct Mosaic compiles stay bounded at
     # log2(maxN) shapes (the 127-step unrolled ladder takes ~2min to
@@ -357,8 +357,7 @@ def verify_batch_async(items: list[tuple[bytes, bytes, bytes]]):
     ax, ay, ry, rs, s8, h8, valid = base.prepare_batch8(items, bucket)
     s_total = bucket // 128
     dig_s, dig_h = _expand_digits(jnp.asarray(s8), jnp.asarray(h8))
-    fn = _get_verify(S_TILE, interpret)
-    ok = fn(
+    args = (
         jnp.asarray(ax.reshape(NL, s_total, 128)),
         jnp.asarray(ay.reshape(NL, s_total, 128)),
         jnp.asarray(ry.reshape(NL, s_total, 128)),
@@ -366,6 +365,17 @@ def verify_batch_async(items: list[tuple[bytes, bytes, bytes]]):
         dig_s,
         dig_h,
     )
+    return args, valid, n
+
+
+def verify_batch_async(items: list[tuple[bytes, bytes, bytes]]):
+    """Marshal + enqueue now; return a zero-arg resolver for bool[B] —
+    same pipelining contract as base.verify_batch_async."""
+    if len(items) == 0:
+        return lambda: np.zeros(0, dtype=bool)
+    args, valid, n = marshal_device_args(items)
+    fn = _get_verify(S_TILE, not _on_tpu())
+    ok = fn(*args)
     return lambda: (np.asarray(ok).reshape(-1)[:n] != 0) & valid[:n]
 
 

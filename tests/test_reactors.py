@@ -230,12 +230,41 @@ def test_fast_sync_catches_up_and_switches():
         stop_net([node_a, node_b], switches)
 
 
-def test_fast_sync_rides_the_tpu_gateway():
+def test_fast_sync_rides_the_tpu_gateway(monkeypatch):
     """Regression: fast sync with the gateway wired (as node/node.py wires
     it) must actually route commit signatures AND part hashing through the
     batched kernels — the stats counters move, and the synced chain is
     byte-identical to the builder's (blockchain/reactor.go:229-236)."""
     from tendermint_tpu.ops import gateway
+
+    # close/fatal tracer for the intermittent both-peers-drop flake
+    # ("stream closed" on both sides, full-suite-only): record who closes
+    # streams and why connections die, dump on failure
+    import traceback as _tb
+
+    from tendermint_tpu.p2p import conn as _conn
+    from tendermint_tpu.p2p import stream as _stream
+
+    trace: list = []
+    orig_close = _stream.SocketStream.close
+    orig_fatal = _conn.MConnection._fatal
+
+    def traced_close(self):
+        trace.append(
+            (time.monotonic(), "close", repr(self.sock),
+             "".join(_tb.format_stack(limit=8)[:-1])[-600:])
+        )
+        return orig_close(self)
+
+    def traced_fatal(self, exc):
+        trace.append(
+            (time.monotonic(), "fatal", f"{type(exc).__name__}: {exc}",
+             "".join(_tb.format_stack(limit=8)[:-1])[-600:])
+        )
+        return orig_fatal(self, exc)
+
+    monkeypatch.setattr(_stream.SocketStream, "close", traced_close)
+    monkeypatch.setattr(_conn.MConnection, "_fatal", traced_fatal)
 
     verifier = gateway.Verifier(min_tpu_batch=1, use_tpu=True)
     hasher = gateway.Hasher(min_tpu_batch=1, use_tpu=True)
@@ -294,6 +323,9 @@ def test_fast_sync_rides_the_tpu_gateway():
             names = Counter(
                 t.name.split("-")[0].split(".")[0] for t in threading.enumerate()
             )
+            tr = "\n".join(
+                f"  t={t:.3f} {kind} {what}\n{stack}" for t, kind, what, stack in trace
+            )
             raise AssertionError(
                 f"B at {node_b.store.height()}, A at {target}; "
                 f"peers A={switches[0].peers.size()} B={switches[1].peers.size()}; "
@@ -301,7 +333,8 @@ def test_fast_sync_rides_the_tpu_gateway():
                 f"requesters={len(bc_b.pool.requesters)} "
                 f"max_peer_height={bc_b.pool.max_peer_height}; "
                 f"B synced={bc_b.blocks_synced}; "
-                f"threads={threading.active_count()} {dict(names.most_common(8))}"
+                f"threads={threading.active_count()} {dict(names.most_common(8))}\n"
+                f"close/fatal trace ({len(trace)} events):\n{tr}"
             )
         for h in range(1, target + 1):
             assert node_b.store.load_block(h).hash() == node_a.store.load_block(h).hash()
