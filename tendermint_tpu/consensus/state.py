@@ -82,6 +82,8 @@ class ConsensusState(BaseService):
         self.state = None  # sm.State, set by update_to_state
 
         self.peer_msg_queue: queue.Queue = queue.Queue(maxsize=1000)
+        self._peer_msg_drops = 0
+        self._peer_msg_drop_logged = 0.0
         self.internal_msg_queue: queue.Queue = queue.Queue(maxsize=1000)
         self.timeout_ticker: TickerI = TimeoutTicker()
         # combined input queue preserving the reference's select semantics
@@ -213,18 +215,55 @@ class ConsensusState(BaseService):
     def send_internal_message(self, mi: MsgInfo) -> None:
         self.internal_msg_queue.put(mi)
 
+    # every peer-originated enqueue goes through _enqueue_peer_msg so the
+    # bounded-wait invariant below cannot be bypassed by a sibling entry
+    # point
+    PEER_PUT_TIMEOUT = 0.5  # s
+
+    def _enqueue_peer_msg(self, msg, peer_id: str) -> None:
+        """Called (indirectly) from the peer RECV routine — must never
+        wedge it. A bounded-timeout put gives a briefly-behind state
+        machine time to drain (no message loss under transient pressure —
+        important because gossip senders optimistically mark parts/votes
+        as delivered and won't re-offer them within the round); only when
+        the queue stays full past the timeout — a flooding peer or a
+        stopped state machine — is the message dropped. An UNbounded put
+        here wedges the recv routine, freezes the whole multiplexed
+        connection, and hands any flooding peer a denial-of-service lever
+        (found via the fast-sync stall flake: a stopped consensus state
+        filled the queue, the blocked put froze the peer, and both sides
+        eventually dropped 'stream closed'). Drops are counted and logged
+        at most once per 5s so the flood can't also spam the log."""
+        try:
+            self.peer_msg_queue.put(MsgInfo(msg, peer_id), timeout=self.PEER_PUT_TIMEOUT)
+            return
+        except queue.Full:
+            pass
+        now = time.monotonic()
+        self._peer_msg_drops += 1
+        if now - self._peer_msg_drop_logged > 5.0:
+            self._peer_msg_drop_logged = now
+            self.logger.warning(
+                "peer_msg_queue full; dropped %d messages (latest: %s from %.8s)",
+                self._peer_msg_drops, type(msg).__name__, peer_id,
+            )
+
     def add_peer_message(self, msg, peer_id: str) -> None:
-        self.peer_msg_queue.put(MsgInfo(msg, peer_id))
+        self._enqueue_peer_msg(msg, peer_id)
 
     def set_proposal_msg(self, proposal: Proposal, peer_id: str = "") -> None:
-        (self.peer_msg_queue if peer_id else self.internal_msg_queue).put(
-            MsgInfo(msgs.ProposalMessage(proposal), peer_id)
-        )
+        m = msgs.ProposalMessage(proposal)
+        if peer_id:
+            self._enqueue_peer_msg(m, peer_id)
+        else:
+            self.internal_msg_queue.put(MsgInfo(m, peer_id))
 
     def add_vote_msg(self, vote: Vote, peer_id: str = "") -> None:
-        (self.peer_msg_queue if peer_id else self.internal_msg_queue).put(
-            MsgInfo(msgs.VoteMessage(vote), peer_id)
-        )
+        m = msgs.VoteMessage(vote)
+        if peer_id:
+            self._enqueue_peer_msg(m, peer_id)
+        else:
+            self.internal_msg_queue.put(MsgInfo(m, peer_id))
 
     # -- state sync --------------------------------------------------------
 

@@ -511,3 +511,42 @@ def test_live_vote_path_batches_on_gateway():
         assert st["tpu_batches"] >= 1 and st["tpu_sigs"] >= 32, st
     finally:
         cs.stop()
+
+
+def test_add_peer_message_never_blocks_when_full():
+    """The peer recv routine calls add_peer_message; a full queue (state
+    machine behind or stopped) must DROP, not block — a blocking put
+    wedges the whole multiplexed connection and hands a flooding peer a
+    DoS lever (found via the fast-sync stall flake)."""
+    import time as _time
+
+    from tests.test_reactors import make_genesis, make_node
+
+    doc, pvs = make_genesis(1)
+    node = make_node(doc, pvs[0])  # cs constructed, NOT started: no drain
+    cs = node.cs
+
+    class _Msg:
+        pass
+
+    # fill the queue instantly, then verify overflow waits are BOUNDED:
+    # each excess put may wait up to PEER_PUT_TIMEOUT, never forever
+    for _ in range(cs.peer_msg_queue.maxsize):
+        cs.add_peer_message(_Msg(), "peerX")
+    assert cs.peer_msg_queue.full()
+    t0 = _time.monotonic()
+    for _ in range(3):
+        cs.add_peer_message(_Msg(), "peerX")
+    dt = _time.monotonic() - t0
+    assert dt < 3 * cs.PEER_PUT_TIMEOUT + 1.0, f"wedged for {dt:.1f}s"
+    assert cs._peer_msg_drops == 3
+    # the sibling peer-originated entry points share the bounded helper
+    from tests.test_types import BLOCK_ID
+    from tendermint_tpu.types import Vote
+    from tendermint_tpu.types.vote import VOTE_TYPE_PREVOTE
+
+    v = Vote(b"\x00" * 20, 0, 1, 0, VOTE_TYPE_PREVOTE, BLOCK_ID)
+    t0 = _time.monotonic()
+    cs.add_vote_msg(v, "peerX")
+    assert _time.monotonic() - t0 < cs.PEER_PUT_TIMEOUT + 1.0
+    assert cs._peer_msg_drops == 4
