@@ -84,6 +84,7 @@ class ConsensusState(BaseService):
         self.peer_msg_queue: queue.Queue = queue.Queue(maxsize=1000)
         self._peer_msg_drops = 0
         self._peer_msg_drop_logged = 0.0
+        self._peer_drop_mtx = threading.Lock()
         self.internal_msg_queue: queue.Queue = queue.Queue(maxsize=1000)
         self.timeout_ticker: TickerI = TimeoutTicker()
         # combined input queue preserving the reference's select semantics
@@ -171,10 +172,19 @@ class ConsensusState(BaseService):
         )
         self._thread.start()
 
+    # soft cap on peer-originated messages waiting in _inputs: beyond it
+    # the PEER forwarder drops instead of growing the combined queue
+    # without bound (a flooding peer would otherwise OOM a live node —
+    # peer_msg_queue alone can't bound anything while its forwarder
+    # drains it). Internal/timeout forwarders are never capped: the
+    # receive routine itself enqueues internal messages, so blocking or
+    # dropping THOSE could deadlock or corrupt the state machine.
+    PEER_INPUT_BACKLOG_CAP = 2000
+
     def _start_forwarders(self) -> None:
         """Drain the three source queues into the combined input queue."""
 
-        def fwd(src: queue.Queue, tag: str):
+        def fwd(src: queue.Queue, tag: str, peer_capped: bool = False):
             while not self._stopping.is_set():
                 try:
                     item = src.get(timeout=0.1)
@@ -182,14 +192,17 @@ class ConsensusState(BaseService):
                     continue
                 if item is None:
                     continue
+                if peer_capped and self._inputs.qsize() >= self.PEER_INPUT_BACKLOG_CAP:
+                    self._note_peer_drop(item)
+                    continue
                 self._inputs.put((tag, item))
 
-        for src, tag in (
-            (self.peer_msg_queue, "msg"),
-            (self.internal_msg_queue, "msg"),
-            (self.timeout_ticker.chan, "timeout"),
+        for src, tag, capped in (
+            (self.peer_msg_queue, "msg", True),
+            (self.internal_msg_queue, "msg", False),
+            (self.timeout_ticker.chan, "timeout", False),
         ):
-            t = threading.Thread(target=fwd, args=(src, tag), daemon=True)
+            t = threading.Thread(target=fwd, args=(src, tag, capped), daemon=True)
             t.start()
             self._forwarders.append(t)
 
@@ -238,15 +251,24 @@ class ConsensusState(BaseService):
             self.peer_msg_queue.put(MsgInfo(msg, peer_id), timeout=self.PEER_PUT_TIMEOUT)
             return
         except queue.Full:
-            pass
-        now = time.monotonic()
-        self._peer_msg_drops += 1
-        if now - self._peer_msg_drop_logged > 5.0:
+            self._note_peer_drop(MsgInfo(msg, peer_id))
+
+    def _note_peer_drop(self, mi) -> None:
+        """Count + rate-limited-log a dropped peer message (locked: drop
+        sites run on concurrent peer recv/forwarder threads, and an
+        unsynchronized read-modify-write would undercount exactly during
+        the floods the counter exists to observe)."""
+        with self._peer_drop_mtx:
+            self._peer_msg_drops += 1
+            drops = self._peer_msg_drops
+            now = time.monotonic()
+            if now - self._peer_msg_drop_logged <= 5.0:
+                return
             self._peer_msg_drop_logged = now
-            self.logger.warning(
-                "peer_msg_queue full; dropped %d messages (latest: %s from %.8s)",
-                self._peer_msg_drops, type(msg).__name__, peer_id,
-            )
+        self.logger.warning(
+            "peer message backlog full; dropped %d total (latest: %s from %.8s)",
+            drops, type(mi.msg).__name__, mi.peer_id,
+        )
 
     def add_peer_message(self, msg, peer_id: str) -> None:
         self._enqueue_peer_msg(msg, peer_id)

@@ -9,6 +9,7 @@ and every honest node commits identical blocks.
 
 from __future__ import annotations
 
+import threading
 import time
 
 import pytest
@@ -156,3 +157,123 @@ def test_byzantine_proposer_cannot_halt_chain():
             sw.stop()
         for n in nodes:
             n.evsw.stop()
+
+
+def test_flooding_peer_cannot_halt_chain():
+    """Adversarial liveness: a peer that floods decodable consensus
+    messages (valid-shape votes from a non-validator key) at wire rate
+    must not stall the honest validators — the bounded peer-message
+    enqueue drops excess instead of wedging recv routines
+    (consensus/state._enqueue_peer_msg; the pre-fix behavior froze the
+    whole multiplexed connection)."""
+    from tendermint_tpu.consensus.reactor import (
+        DATA_CHANNEL as _DC,
+        STATE_CHANNEL,
+        VOTE_CHANNEL,
+        VOTE_SET_BITS_CHANNEL,
+    )
+    from tendermint_tpu.p2p import Switch, connect2_switches
+    from tendermint_tpu.p2p.conn import ChannelDescriptor
+    from tendermint_tpu.p2p.node_info import NodeInfo, default_version
+    from tendermint_tpu.p2p.switch import Reactor
+    from tendermint_tpu.types import Vote
+    from tendermint_tpu.types.vote import VOTE_TYPE_PREVOTE
+    from tests.test_reactors import start_consensus_net, stop_net, wait_until
+
+    nodes, switches = start_consensus_net(4)
+
+    from tendermint_tpu.libs.service import BaseService
+
+    class FloodSender(Reactor, BaseService):
+        """Speaks the consensus channels but only to inject traffic."""
+
+        def __init__(self):
+            BaseService.__init__(self, name="flood")
+
+        def get_channels(self):
+            # all four consensus channels: the victim gossips on
+            # STATE/DATA too, and an unknown channel drops the peer
+            return [
+                ChannelDescriptor(id=ch, priority=5, send_queue_capacity=1000)
+                for ch in (STATE_CHANNEL, _DC, VOTE_CHANNEL, VOTE_SET_BITS_CHANNEL)
+            ]
+
+        def add_peer(self, peer):
+            pass
+
+        def remove_peer(self, peer, reason):
+            pass
+
+        def receive(self, ch_id, peer, msg_bytes):
+            pass
+
+    flood_sw = Switch()
+    flood_sw.add_reactor("FLOOD", FloodSender())
+    flood_sw.set_node_info(
+        NodeInfo(
+            pub_key=flood_sw.node_priv_key.pub_key(),
+            moniker="flooder",
+            network=nodes[0].state.chain_id,
+            version=default_version("test"),
+        )
+    )
+    flood_sw.start()
+    try:
+        assert wait_until(lambda: all(len(n.blocks) >= 1 for n in nodes),
+                          timeout=60)
+        connect2_switches(switches + [flood_sw], 0, 4)
+        victim_peer = next(iter(flood_sw.peers.list()), None)
+        assert victim_peer is not None
+
+        # flood: shape-valid votes signed by a NON-validator, pinned to
+        # the height at flood start (stale as the chain advances — still
+        # decodable, still enqueued, still rejected by processing)
+        from tendermint_tpu.crypto.keys import gen_priv_key_ed25519
+
+        atk = PrivValidatorFS(gen_priv_key_ed25519(), None)
+        flood_height = nodes[0].cs.get_round_state().height  # pin once:
+        # the live RoundState mutates under us from the consensus thread
+        stop_flood = threading.Event()
+        stats = {"sent": 0}
+
+        def flood():
+            # sustained pressure, PACED: this box has one CPU core, so an
+            # unthrottled python sign+send loop starves the validators of
+            # the GIL and stalls consensus by resource exhaustion — which
+            # is not the property under test (the bounded enqueue keeping
+            # recv routines un-wedged is). ~200 msg/s is far above honest
+            # gossip and still exercises the drop/bound path.
+            i = 0
+            while not stop_flood.is_set():
+                v = Vote(
+                    validator_address=atk.get_address(),
+                    validator_index=i % 4,
+                    height=flood_height,
+                    round_=0,
+                    type_=VOTE_TYPE_PREVOTE,
+                    block_id=BlockID(),
+                )
+                v = atk.sign_vote(nodes[0].state.chain_id, v)  # returns the
+                # signed copy; Vote is not mutated in place
+                if victim_peer.try_send(VOTE_CHANNEL, _enc(msgs.VoteMessage(v))):
+                    stats["sent"] += 1
+                i += 1
+                time.sleep(0.005)
+
+        flooder = threading.Thread(target=flood, daemon=True)
+        flooder.start()
+
+        # the chain must keep committing WHILE being flooded
+        start = min(len(n.blocks) for n in nodes)
+        ok = wait_until(
+            lambda: all(len(n.blocks) >= start + 2 for n in nodes), timeout=90
+        )
+        stop_flood.set()
+        flooder.join(5)
+        assert stats["sent"] > 20, f"flood only delivered {stats['sent']}"
+        assert ok, f"chain stalled under flood: {[len(n.blocks) for n in nodes]}"
+        # and the victim still has its honest peers
+        assert switches[0].peers.size() >= 3
+    finally:
+        flood_sw.stop()
+        stop_net(nodes, switches)
