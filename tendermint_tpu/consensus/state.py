@@ -273,6 +273,11 @@ class ConsensusState(BaseService):
     def add_peer_message(self, msg, peer_id: str) -> None:
         self._enqueue_peer_msg(msg, peer_id)
 
+    @property
+    def peer_msg_drops(self) -> int:
+        """Messages dropped by the ingress backpressure (/metrics)."""
+        return self._peer_msg_drops
+
     def set_proposal_msg(self, proposal: Proposal, peer_id: str = "") -> None:
         m = msgs.ProposalMessage(proposal)
         if peer_id:

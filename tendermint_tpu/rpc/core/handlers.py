@@ -339,6 +339,7 @@ def metrics(ctx) -> dict:
     out["consensus_round"] = rs.round_
     out["consensus_step"] = int(rs.step)
     out["blockstore_height"] = ctx.block_store.height()
+    out["consensus_peer_msg_drops"] = ctx.consensus_state.peer_msg_drops
     out["mempool_size"] = ctx.mempool.size()
     outbound, inbound, dialing = ctx.switch.num_peers()
     out["p2p_peers_outbound"] = outbound

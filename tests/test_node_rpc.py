@@ -221,5 +221,6 @@ def test_metrics_endpoint(node, client):
     assert m["mempool_size"] >= 0
     assert "p2p_peers_outbound" in m and "p2p_peers_inbound" in m
     assert "gateway_verify_tpu_sigs" in m
+    assert m["consensus_peer_msg_drops"] == 0  # healthy node drops nothing
     assert "gateway_hash_cpu_leaves" in m
     assert all(isinstance(v, (int, float)) for v in m.values()), m
