@@ -32,6 +32,10 @@ class LightClient:
         self.validators = trusted_validators
         self.height = trusted_height
         self.batch_verifier = batch_verifier
+        # last header verified by advance(); persisting it means every
+        # validator-set change is chain-linked to a verified predecessor,
+        # even across separate advance() calls
+        self._trusted_header: Header | None = None
 
     @classmethod
     def from_genesis(cls, client, **kw) -> "LightClient":
@@ -53,6 +57,13 @@ class LightClient:
         TRUSTED set signed it. Returns the verified header; raises
         LightClientError otherwise. Does not advance trust. `_res` lets
         advance() share one /commit fetch instead of issuing two."""
+        return self._verify_with(self.validators, height, _res)
+
+    def _verify_with(
+        self, validators: ValidatorSet, height: int, _res: dict | None = None
+    ) -> Header:
+        """verify_header against an explicit set — advance() uses this so a
+        candidate set is never installed as trusted before it verifies."""
         res = _res if _res is not None else self.client.commit(height=int(height))
         if not res.get("commit"):
             raise LightClientError(f"no commit for height {height}")
@@ -67,14 +78,14 @@ class LightClient:
         # the commit must be over THIS header: BlockID.hash == header hash
         if commit.block_id.hash != header.hash():
             raise LightClientError("commit is not over the fetched header")
-        # the signing set must be the one we trust: +2/3 check below uses
-        # self.validators, and the header must commit to the same set
-        if header.validators_hash != self.validators.hash():
+        # the signing set must be the verifying one, and the header must
+        # commit to the same set
+        if header.validators_hash != validators.hash():
             raise LightClientError(
                 "validator set changed; advance() trust to this height first"
             )
         try:
-            self.validators.verify_commit(
+            validators.verify_commit(
                 self.chain_id, commit.block_id, height, commit,
                 batch_verifier=self.batch_verifier,
             )
@@ -98,12 +109,20 @@ class LightClient:
         the old set's power — i.e. the set we already trust still
         controls the chain across the transition. An attacker without
         2/3 of the trusted keys cannot fabricate (d)."""
+        prev_header = self._trusted_header
+        if prev_header is None and self.height >= 1:
+            # trust was established out-of-band (or this object was rebuilt
+            # from a persisted height): verify the trusted height itself so
+            # every later set change is chain-linked to a VERIFIED header —
+            # only genesis trust (height 0) legitimately has no predecessor
+            prev_header = self.verify_header(self.height)
+            self._trusted_header = prev_header
         h = max(self.height, 1)
-        prev_header: Header | None = None
         while h <= to_height:
             res = self.client.commit(height=h)
             header = Header.from_json(res["header"])
-            if header.validators_hash != self.validators.hash():
+            vals = self.validators
+            if header.validators_hash != vals.hash():
                 claimed = ValidatorSet.from_json(
                     self.client.validators(height=h)["validators"]
                 )
@@ -119,9 +138,14 @@ class LightClient:
                     )
                 commit = Commit.from_json(res["commit"])
                 self._check_old_set_overlap(h, commit, claimed)
-                self.validators = claimed
-            prev_header = self.verify_header(h, _res=res)
+                vals = claimed
+            # verify with the candidate set FIRST; only a fully verified
+            # height moves trust (set, height, header) forward — a raised
+            # LightClientError leaves the previous trusted state intact
+            prev_header = self._verify_with(vals, h, _res=res)
+            self.validators = vals
             self.height = h
+            self._trusted_header = prev_header
             h += 1
 
     def _check_old_set_overlap(
@@ -134,6 +158,19 @@ class LightClient:
         signed_old_power = 0
         for idx, pre in enumerate(commit.precommits):
             if pre is None:
+                continue
+            # only precommits FOR this commit's block at this height count:
+            # commit_tally tolerates valid precommits for other block ids
+            # (they're evidence of the network's round, not endorsement), so
+            # without this filter an attacker could stuff replayed genuine
+            # old-set precommits from the real chain — same height, different
+            # block — into a forged commit and satisfy (d) with zero old-set
+            # endorsement of the fork
+            if (
+                pre.height != height
+                or pre.round_ != commit.round_()
+                or pre.block_id != commit.block_id
+            ):
                 continue
             _, val = new_set.get_by_index(idx)
             if val is None:

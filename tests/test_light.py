@@ -130,6 +130,124 @@ def test_advance_rejects_forged_validator_set():
         lc.advance(3)
 
 
+def test_advance_rejects_replayed_precommit_stuffing():
+    """ADVICE r3 (high): condition (d) must only credit old-set power for
+    precommits over THIS commit's block. A vote's sign-bytes exclude the
+    validator index/address, so an attacker can re-wrap genuine old-set
+    precommits replayed from the real chain (same height/round, the REAL
+    block) into a forged commit over a forged block; without the block_id
+    filter those replays satisfy (d) with zero old-set endorsement."""
+    from tendermint_tpu.types.block import Commit
+
+    pv1 = _pv()
+    v1 = Validator.new(pv1.get_pub_key(), 2)
+    old_set = ValidatorSet([v1.copy()])
+    privs = {pv1.get_address(): pv1}
+    stub = StubClient()
+    prev_id = None
+    for h in (1, 2):
+        hd = _header(h, old_set, prev_id)
+        stub.add_height(hd, _commit_for(hd, old_set, privs), old_set)
+        prev_id = BlockID(hd.hash(), PartSetHeader(1, b"\x01" * 20))
+
+    # the REAL height-3 block the honest chain committed — the source of
+    # the replayable precommit material
+    real_hd3 = _header(3, old_set, prev_id)
+    real_block_id = BlockID(real_hd3.hash(), PartSetHeader(1, b"\x01" * 20))
+
+    # the forged chain: {v1, attacker} with the attacker holding +2/3 of
+    # the NEW set, so the new-set tally passes on attacker signatures alone
+    atk = _pv()
+    new_set = ValidatorSet([v1.copy(), Validator.new(atk.get_pub_key(), 100)])
+    forged_hd3 = _header(3, new_set, prev_id)
+    forged_block_id = BlockID(forged_hd3.hash(), PartSetHeader(1, b"\x01" * 20))
+    precommits: list = [None] * new_set.size()
+    for i in range(new_set.size()):
+        addr, _ = new_set.get_by_index(i)
+        if addr == pv1.get_address():
+            # replayed genuine precommit: v1's signature covers only
+            # (block_id, height, round, type), so index/address re-wrap
+            # is free for the attacker
+            vote = Vote(addr, i, 3, 0, VOTE_TYPE_PRECOMMIT, real_block_id)
+            precommits[i] = pv1.sign_vote(CHAIN, vote)
+        else:
+            vote = Vote(addr, i, 3, 0, VOTE_TYPE_PRECOMMIT, forged_block_id)
+            precommits[i] = atk.sign_vote(CHAIN, vote)
+    stub.add_height(forged_hd3, Commit(forged_block_id, precommits), new_set)
+
+    lc = LightClient(stub, CHAIN, old_set.copy())
+    with pytest.raises(LightClientError, match="trusted set signed only"):
+        lc.advance(3)
+    assert lc.validators.hash() == old_set.hash()
+
+
+def test_failed_advance_does_not_install_candidate_set():
+    """ADVICE r3 (medium): if verify_header rejects the transition commit
+    AFTER the old-set-overlap check passed, the candidate set must not be
+    left installed as trusted — a catching caller would otherwise verify
+    all later headers against the attacker's set."""
+    pv1, pv2, pv3 = _pv(), _pv(), _pv()
+    v1 = Validator.new(pv1.get_pub_key(), 3)
+    old_set = ValidatorSet([v1.copy()])
+    privs = {pv1.get_address(): pv1, pv2.get_address(): pv2}  # pv3 never signs
+    stub = StubClient()
+    prev_id = None
+    for h in (1, 2):
+        hd = _header(h, old_set, prev_id)
+        stub.add_height(hd, _commit_for(hd, old_set, privs), old_set)
+        prev_id = BlockID(hd.hash(), PartSetHeader(1, b"\x01" * 20))
+    # transition commit signed by v1+v2 only: the OLD-set overlap passes
+    # (v1 is 100% of old power) but the NEW set's +2/3 tally fails (pv3
+    # holds most of the new power and did not sign)
+    new_set = ValidatorSet([
+        v1.copy(),
+        Validator.new(pv2.get_pub_key(), 1),
+        Validator.new(pv3.get_pub_key(), 100),
+    ])
+    hd3 = _header(3, new_set, prev_id)
+    stub.add_height(hd3, _commit_for(hd3, new_set, privs), new_set)
+
+    lc = LightClient(stub, CHAIN, old_set.copy())
+    with pytest.raises(LightClientError, match="commit verification failed"):
+        lc.advance(3)
+    assert lc.validators.hash() == old_set.hash()
+    assert lc.height == 2
+    # and the client still works against the honest chain from there
+    lc.verify_header(2)
+
+
+def test_set_change_at_trust_anchor_cannot_skip_chain_link():
+    """ADVICE r3 (low): a validator-set change landing on the FIRST height
+    an advance() call processes used to skip the last_block_id chain-link
+    check (prev_header was None). Out-of-band trust anchors are now
+    verified before the walk, so a change at the anchor height cannot
+    bypass chain linkage."""
+    from tendermint_tpu.types.block import Commit  # noqa: F401 — parity with sibling test
+
+    pv1, pv2 = _pv(), _pv()
+    v1 = Validator.new(pv1.get_pub_key(), 2)
+    old_set = ValidatorSet([v1.copy()])
+    privs = {pv1.get_address(): pv1, pv2.get_address(): pv2}
+    stub = StubClient()
+    prev_id = None
+    for h in (1, 2):
+        hd = _header(h, old_set, prev_id)
+        stub.add_height(hd, _commit_for(hd, old_set, privs), old_set)
+        prev_id = BlockID(hd.hash(), PartSetHeader(1, b"\x01" * 20))
+    # height 3 changes the set AND chains to garbage; its commit is
+    # self-consistent and v1 signs it, so both the overlap and the
+    # new-set tally would pass — only the chain link betrays it
+    new_set = ValidatorSet([v1.copy(), Validator.new(pv2.get_pub_key(), 1)])
+    bad_link = BlockID(b"\xee" * 20, PartSetHeader(1, b"\x01" * 20))
+    hd3 = _header(3, new_set, bad_link)
+    stub.add_height(hd3, _commit_for(hd3, new_set, privs), new_set)
+
+    lc = LightClient(stub, CHAIN, old_set.copy(), trusted_height=3)
+    with pytest.raises(LightClientError):
+        lc.advance(3)
+    assert lc.validators.hash() == old_set.hash()
+
+
 def test_advance_rejects_unchained_header():
     """A validator change whose header does not chain to the verified
     previous header is rejected (the chain-link check runs before any
