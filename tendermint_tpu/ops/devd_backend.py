@@ -1,0 +1,43 @@
+"""Gateway kernel backend that routes batches to the device daemon.
+
+Selected as `devd` in ops/gateway.KERNELS (and as the automatic default
+when a daemon is serving — gateway.kernel_name). With this backend a
+node, bench, or test process NEVER initializes a jax backend or dials
+the accelerator tunnel: the daemon (tendermint_tpu/devd.py) owns the
+device; this module is pure socket IPC. That is the wedge-proofing: the
+only process with device state is one that is never killed mid-op.
+
+Same contract as the kernel modules (ops/ed25519_f32.py): verify_batch
+returns an array-like of bools; verify_batch_async returns a zero-arg
+resolver. Failures raise — the gateway's existing CPU-fallback handling
+(ops/gateway.Verifier.verify_batch) treats a dead daemon exactly like a
+dead device.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from tendermint_tpu import devd
+
+_client: devd.DevdClient | None = None
+_mtx = threading.Lock()
+
+
+def _get_client() -> devd.DevdClient:
+    global _client
+    with _mtx:
+        if _client is None:
+            _client = devd.DevdClient()
+        return _client
+
+
+def verify_batch(items) -> np.ndarray:
+    return np.asarray(_get_client().verify_batch(items), dtype=bool)
+
+
+def verify_batch_async(items):
+    resolve = _get_client().verify_batch_async(items)
+    return lambda: np.asarray(resolve(), dtype=bool)

@@ -69,6 +69,10 @@ KERNELS = {
     "f32": "tendermint_tpu.ops.ed25519_f32",
     "int32": "tendermint_tpu.ops.ed25519",
     "pallas": "tendermint_tpu.ops.ed25519_pallas",
+    # not a kernel: socket IPC to the device daemon (devd.py), which runs
+    # f32p/f32 on the device it holds. The automatic default whenever a
+    # daemon is serving — see kernel_name().
+    "devd": "tendermint_tpu.ops.devd_backend",
 }
 
 
@@ -90,14 +94,23 @@ def kernel_name() -> str:
     Verifier.__init__ calls this so a typo'd env var fails at startup
     rather than silently latching the CPU fallback.
 
-    Default is platform-aware: "f32p" (the pallas ladder, the measured
-    winner) on real TPU hardware, "f32" elsewhere — the pallas kernel
-    only runs in slow interpret mode on CPU backends, while the
-    conv-composed f32 kernel compiles natively everywhere. Resolving the
-    platform needs an initialized jax backend, so the default branch is
-    evaluated lazily here, not at import."""
+    Default is environment-aware, in priority order:
+    1. a serving device daemon (devd.available) -> "devd": the daemon
+       owns the chip, this process stays off the tunnel entirely (the
+       wedge-proof path — see tendermint_tpu/devd.py);
+    2. real TPU hardware -> "f32p" (the pallas ladder, the measured
+       winner);
+    3. otherwise "f32" — the pallas kernel only runs in slow interpret
+       mode on CPU backends, while the conv-composed f32 kernel compiles
+       natively everywhere.
+    Resolving the platform needs an initialized jax backend, so the
+    default branch is evaluated lazily here, not at import."""
     name = os.environ.get("TENDERMINT_TPU_KERNEL", "")
     if not name:
+        from tendermint_tpu import devd
+
+        if devd.available() is not None:
+            return "devd"
         return "f32p" if on_tpu() else "f32"
     if name not in KERNELS:
         raise ValueError(
@@ -135,8 +148,10 @@ class Verifier:
     def __init__(self, min_tpu_batch: int = 32, use_tpu: bool | None = None):
         if use_tpu is None:
             use_tpu = os.environ.get("TENDERMINT_TPU_DISABLE", "") == ""
-        if use_tpu:
-            kernel_name()  # typo'd TENDERMINT_TPU_KERNEL fails at startup
+        # kernel choice is resolved ONCE per verifier (a typo'd env var
+        # fails at startup; a daemon appearing or dying mid-run cannot
+        # flip the hot path under a live consensus node)
+        self._kernel = kernel_name() if use_tpu else None
         self.min_tpu_batch = min_tpu_batch
         self._tpu_ok = use_tpu
         self._mtx = threading.Lock()
@@ -150,7 +165,29 @@ class Verifier:
     def _kernel_module(self):
         """The batch kernel this verifier dispatches to. Overridable so
         ShardedVerifier can pin f32 for BOTH the sync and async paths."""
-        return kernel_module()
+        import importlib
+
+        return importlib.import_module(KERNELS[self._kernel])
+
+    def _demote_after_failure(self) -> None:
+        """A verify raised. If the devd daemon was the backend, fall back
+        to a DIRECT kernel when the device answers a bounded dial — a
+        dead daemon must not cost a healthy node its accelerator. Any
+        direct-kernel failure (or an unreachable device) latches the
+        permanent CPU fallback, as before."""
+        if self._kernel == "devd":
+            from tendermint_tpu.jitcache import probe_device
+
+            platform = probe_device(15.0)
+            if platform in ("tpu", "axon"):
+                self._kernel = "f32p"
+                logger.warning("devd unreachable; direct %s kernel", self._kernel)
+                return
+            if platform is not None:
+                self._kernel = "f32"
+                logger.warning("devd unreachable; direct %s kernel", self._kernel)
+                return
+        self._tpu_ok = False
 
     # -- core API ----------------------------------------------------------
 
@@ -184,8 +221,9 @@ class Verifier:
                     self._stats["tpu_sigs"] += n
                 return [bool(b) for b in out]
             except Exception:
-                logger.exception("TPU verify failed; falling back to CPU")
-                self._tpu_ok = False
+                logger.exception("batch verify via %s failed", self._kernel)
+                self._demote_after_failure()
+                return self.verify_batch(items)  # direct kernel or CPU path
         with self._mtx:
             self._stats["cpu_sigs"] += n
         return _cpu_verify_batch(items)
@@ -230,25 +268,25 @@ class Verifier:
 
                 def resolve():
                     # async dispatch surfaces device-side failures only at
-                    # materialization: keep the sync path's CPU-fallback
+                    # materialization: keep the sync path's fallback
                     # guarantee here too.
                     try:
                         return [bool(b) for b in kernel_resolve()]
                     except Exception:
                         logger.exception(
-                            "TPU verify failed at resolve; falling back to CPU"
+                            "verify via %s failed at resolve", self._kernel
                         )
-                        self._tpu_ok = False
                         with self._mtx:
                             self._stats["tpu_batches"] -= 1
                             self._stats["tpu_sigs"] -= n
-                            self._stats["cpu_sigs"] += n
-                        return _cpu_verify_batch(items)
+                        self._demote_after_failure()
+                        return self.verify_batch(items)
 
                 return resolve
             except Exception:
-                logger.exception("TPU verify failed; falling back to CPU")
-                self._tpu_ok = False
+                logger.exception("batch verify via %s failed", self._kernel)
+                self._demote_after_failure()
+                return self.verify_batch_async(items)
         with self._mtx:
             self._stats["cpu_sigs"] += n
         res = _cpu_verify_batch(items)
@@ -322,6 +360,8 @@ class ShardedVerifier(Verifier):
 
         from tendermint_tpu.ops import ed25519_f32 as ops_ed
 
+        self._kernel = "f32"  # base init may have resolved devd/f32p; this
+        # class dispatches its own pjit'd f32 and must demote as f32
         self.mesh = mesh
         self._n_dev = mesh.size
         batch_last = NamedSharding(mesh, PS(None, "batch"))

@@ -117,7 +117,9 @@ class LightClient:
             # only genesis trust (height 0) legitimately has no predecessor
             prev_header = self.verify_header(self.height)
             self._trusted_header = prev_header
-        h = max(self.height, 1)
+        # a verified header at self.height means the walk starts after it;
+        # only genesis trust (no header) starts at 1
+        h = self.height + 1 if prev_header is not None else 1
         while h <= to_height:
             res = self.client.commit(height=h)
             header = Header.from_json(res["header"])

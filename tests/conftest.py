@@ -7,6 +7,13 @@ anywhere in the test process.
 
 import os
 
+# Tests must be hermetic: never route the default Verifier through a
+# production device daemon that happens to be serving on this box
+# (tendermint_tpu/devd.py) — unconditionally, since the operator may have
+# TENDERMINT_DEVD_SOCK exported. test_devd.py points at its own socket
+# per-test with monkeypatch.
+os.environ["TENDERMINT_DEVD_SOCK"] = "/nonexistent/devd.sock"
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,190 @@
+"""Device-daemon tests (tendermint_tpu/devd.py): protocol, verify parity,
+async pipelining, and the gateway's automatic devd routing — all against
+a real daemon subprocess serving the CPU backend, so the IPC path CI
+exercises is byte-for-byte the one the TPU daemon serves in production.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tendermint_tpu import devd
+from tendermint_tpu.crypto import ed25519 as ed
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def daemon(tmp_path_factory):
+    sock = str(tmp_path_factory.mktemp("devd") / "devd.sock")
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "TENDERMINT_DEVD_SOCK": sock,
+        "TENDERMINT_DEVD_ACCEPT_CPU": "1",
+        "TENDERMINT_DEVD_WARM": "16",
+        "TENDERMINT_DEVD_EXIT_ON_TERM": "1",
+    }
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.devd"],
+        env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+    )
+    client = devd.DevdClient(sock)
+    deadline = time.time() + 240  # cold .jax_cache: one f32 ladder compile
+    held = False
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            break
+        try:
+            rep = client.ping(timeout=2.0)
+            if rep.get("held"):
+                held = True
+                break
+        except Exception:
+            pass
+        time.sleep(1.0)
+    if not held:
+        err = b""
+        if proc.poll() is not None:
+            err = proc.stderr.read() if proc.stderr else b""
+        proc.kill()
+        pytest.fail(f"daemon never reached serving state: {err[-2000:]!r}")
+    yield sock, client
+    try:
+        client.shutdown()
+    except Exception:
+        pass
+    client.close()
+    try:
+        proc.wait(timeout=15)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def _items(n: int, tag: bytes = b"devd"):
+    seed = b"\x21" * 32
+    pub = ed.public_key(seed)
+    return [
+        (pub, tag + b"-%d" % i, ed.sign(seed, tag + b"-%d" % i))
+        for i in range(n)
+    ]
+
+
+def test_ping_reports_serving(daemon):
+    _, client = daemon
+    rep = client.ping()
+    assert rep["held"] and rep["status"] == "serving"
+    assert rep["platform"] == "cpu"
+    assert rep["warmed"] == [16]
+    assert rep["pid"] > 0
+
+
+def test_verify_parity_with_cpu(daemon):
+    _, client = daemon
+    items = _items(6)
+    items[2] = (items[2][0], items[2][1], b"\x13" * 64)  # forged
+    items[4] = (items[4][0], items[4][1] + b"x", items[4][2])  # tampered msg
+    got = client.verify_batch(items)
+    want = [ed.verify(p, m, s) for p, m, s in items]
+    assert got == want == [True, True, False, True, False, True]
+
+
+def test_async_pipelining_preserves_order(daemon):
+    _, client = daemon
+    batches = [_items(5, tag=b"pipe%d" % k) for k in range(4)]
+    for k in range(4):
+        p, m, _ = batches[k][k]
+        batches[k][k] = (p, m, b"\x31" * 64)
+    resolvers = [client.verify_batch_async(b) for b in batches]
+    for k, resolve in enumerate(resolvers):
+        assert resolve() == [i != k for i in range(5)], k
+
+
+def test_gateway_default_routes_through_daemon(daemon, monkeypatch):
+    """With a daemon serving, a default-constructed Verifier picks the
+    devd backend automatically: this process does no device (or kernel)
+    work at all, and the daemon's counters move."""
+    sock, client = daemon
+    monkeypatch.setenv("TENDERMINT_DEVD_SOCK", sock)
+    monkeypatch.delenv("TENDERMINT_TPU_KERNEL", raising=False)
+    import tendermint_tpu.ops.devd_backend as backend
+    from tendermint_tpu.ops import gateway
+
+    monkeypatch.setattr(backend, "_client", None)
+    devd._avail_cache.update(t=0.0)  # bust the TTL cache for the new path
+    assert gateway.kernel_name() == "devd"
+
+    before = client.stats().get("tpu_sigs", 0) + client.stats().get("cpu_sigs", 0)
+    v = gateway.Verifier(min_tpu_batch=1)
+    items = _items(8, tag=b"gw")
+    items[3] = (items[3][0], items[3][1], b"\x55" * 64)
+    assert v.verify_batch(items) == [i != 3 for i in range(8)]
+    assert v.stats()["tpu_sigs"] == 8  # routed, not CPU-fallback
+    after = client.stats().get("tpu_sigs", 0) + client.stats().get("cpu_sigs", 0)
+    assert after - before == 8
+
+
+def test_daemon_death_demotes_to_direct_kernel(daemon, monkeypatch):
+    """A dead daemon must not cost the node its accelerator (or correct
+    results): the verifier demotes devd -> direct platform kernel, not
+    devd -> permanent CPU latch."""
+    sock, _ = daemon
+    monkeypatch.setenv("TENDERMINT_DEVD_SOCK", sock)
+    monkeypatch.delenv("TENDERMINT_TPU_KERNEL", raising=False)
+    devd._avail_cache.update(t=0.0)
+    import tendermint_tpu.ops.devd_backend as backend
+    from tendermint_tpu.ops import gateway
+
+    v = gateway.Verifier(min_tpu_batch=1)
+    assert v._kernel == "devd"
+
+    class Dead:
+        def verify_batch(self, items):
+            raise ConnectionError("daemon died")
+
+        def verify_batch_async(self, items):
+            raise ConnectionError("daemon died")
+
+    monkeypatch.setattr(backend, "_client", Dead())
+    items = _items(4, tag=b"demote")
+    items[1] = (items[1][0], items[1][1], b"\x99" * 64)
+    assert v.verify_batch(items) == [True, False, True, True]
+    assert v._kernel in ("f32", "f32p"), v._kernel  # direct, not CPU-latched
+    assert v._tpu_ok
+    # and the async contract survives the same failure
+    resolve = v.verify_batch_async(items)
+    assert resolve() == [True, False, True, True]
+
+
+def test_second_daemon_refuses_live_socket(daemon):
+    sock, _ = daemon
+    env = {
+        **os.environ,
+        "TENDERMINT_DEVD_SOCK": sock,
+        "TENDERMINT_DEVD_ACCEPT_CPU": "1",
+        "TENDERMINT_DEVD_EXIT_ON_TERM": "1",
+    }
+    proc = subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.devd"],
+        env=env, cwd=REPO, capture_output=True, timeout=60,
+    )
+    assert proc.returncode != 0
+    assert b"already serving" in proc.stderr
+
+
+def test_available_requires_held_device(daemon, monkeypatch, tmp_path):
+    sock, _ = daemon
+    monkeypatch.setenv("TENDERMINT_DEVD_SOCK", sock)
+    devd._avail_cache.update(t=0.0)
+    rep = devd.available()
+    assert rep is not None and rep["held"]
+    # no socket -> unavailable (and the gateway default falls back)
+    monkeypatch.setenv("TENDERMINT_DEVD_SOCK", str(tmp_path / "absent.sock"))
+    devd._avail_cache.update(t=0.0)
+    assert devd.available() is None

@@ -222,8 +222,6 @@ def test_set_change_at_trust_anchor_cannot_skip_chain_link():
     check (prev_header was None). Out-of-band trust anchors are now
     verified before the walk, so a change at the anchor height cannot
     bypass chain linkage."""
-    from tendermint_tpu.types.block import Commit  # noqa: F401 — parity with sibling test
-
     pv1, pv2 = _pv(), _pv()
     v1 = Validator.new(pv1.get_pub_key(), 2)
     old_set = ValidatorSet([v1.copy()])
