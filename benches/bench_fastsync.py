@@ -94,9 +94,12 @@ def main() -> None:
 
     # -- CPU reference: sequential verify + hash, block by block ----------
     t0 = time.perf_counter()
+    cpu_hash_s = 0.0
     for h, ((block_id, commit), payload) in enumerate(zip(commits, payloads), 1):
         vs.verify_commit(CHAIN_ID, block_id, h, commit)  # per-sig CPU loop
+        th = time.perf_counter()
         PartSet.from_data(payload, PART_SIZE)
+        cpu_hash_s += time.perf_counter() - th
     cpu_s = time.perf_counter() - t0
 
     # -- TPU pipeline: the reactor's speculative pipeline shape
@@ -107,11 +110,14 @@ def main() -> None:
     PASSES = int(os.environ.get("BENCH_PASSES", "2"))  # best-of: the chip
     # sits behind a shared tunnel, so single passes see contention noise
     tpu_s = float("inf")
+    stages_best: dict = {}
     for _ in range(PASSES):
         t0 = time.perf_counter()
+        stages = {"dispatch_s": 0.0, "part_hash_s": 0.0, "resolve_wait_s": 0.0}
         pending: list = []
         for g, g_end in spans:
             group = commits[g:g_end]
+            ts = time.perf_counter()
             pending.extend(
                 vs.verify_commits_async(
                     CHAIN_ID,
@@ -119,13 +125,29 @@ def main() -> None:
                     verifier.verify_batch_async,
                 )
             )
+            stages["dispatch_s"] += time.perf_counter() - ts
+            ts = time.perf_counter()
             for payload in payloads[g:g_end]:
                 PartSet.from_data(payload, PART_SIZE, hasher=hasher.part_leaf_hashes)
+            stages["part_hash_s"] += time.perf_counter() - ts
+            ts = time.perf_counter()
             while len(pending) > DEPTH:
                 pending.pop(0)()
+            stages["resolve_wait_s"] += time.perf_counter() - ts
+        ts = time.perf_counter()
         for fin in pending:
             fin()
-        tpu_s = min(tpu_s, time.perf_counter() - t0)
+        stages["resolve_wait_s"] += time.perf_counter() - ts
+        elapsed = time.perf_counter() - t0
+        if elapsed < tpu_s:
+            tpu_s = elapsed
+            stages_best = {k: round(v, 3) for k, v in stages.items()}
+    # dispatch_s is host-serial work (structural checks + sign-bytes +
+    # marshal); resolve_wait_s is time blocked on the device; part_hash_s
+    # is host hashing. The residual bottleneck is whichever dominates —
+    # recorded so the next optimization is measured, not guessed
+    # (VERDICT r3 weak #6).
+    stages_best["other_s"] = round(tpu_s - sum(stages_best.values()), 3)
 
     total_sigs = N_VALS * N_BLOCKS
     print(
@@ -141,6 +163,8 @@ def main() -> None:
                     "cpu_blocks_per_sec": round(N_BLOCKS / cpu_s, 2),
                     "tpu_sigs_per_sec": round(total_sigs / tpu_s, 1),
                     "cpu_sigs_per_sec": round(total_sigs / cpu_s, 1),
+                    "cpu_part_hash_s": round(cpu_hash_s, 3),
+                    "pipeline_stages": stages_best,
                     "platform": platform_label(),
                     "gateway_stats": verifier.stats(),
                 },

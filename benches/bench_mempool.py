@@ -23,6 +23,89 @@ N_TXS = int(os.environ.get("BENCH_N_TXS", "50000"))
 # reap at most what the burst inserted (BENCH_N_TXS is shared with the
 # testnet bench, so small smoke runs would otherwise break the dup assert)
 REAP = min(int(os.environ.get("BENCH_REAP", "10000")), N_TXS)
+N_SIGNED = int(os.environ.get("BENCH_SIGNED_TXS", "4096"))
+
+
+def _signed_scenario() -> dict:
+    """BASELINE config 5's TPU dimension: sig-carrying txs through the
+    mempool's batched signature gate (SigBatcher -> gateway kernel)
+    versus the reference shape — the app verifying one signature per
+    CheckTx on CPU (mempool/mempool.go:166-205). Reports both rates and
+    the gateway counters so the batch path is provably exercised."""
+    import tempfile
+    import threading
+
+    from tendermint_tpu.abci.apps.signedkv import (
+        SignedKVStoreApp,
+        make_sig_tx,
+        parse_sig_tx,
+    )
+    from tendermint_tpu.abci.client import LocalClient
+    from tendermint_tpu.config import test_config
+    from tendermint_tpu.mempool import Mempool
+    from tendermint_tpu.mempool.mempool import SigBatcher
+    from tendermint_tpu.ops.gateway import Verifier
+    from tendermint_tpu.proxy.app_conn import AppConnMempool
+
+    seeds = [bytes([i + 1]) * 32 for i in range(64)]
+    txs = [
+        make_sig_tx(seeds[i % 64], b"sk%06d=v%d" % (i, i)) for i in range(N_SIGNED)
+    ]
+    n_forged = 0
+    for i in range(0, N_SIGNED, 97):  # sprinkle forged lanes
+        txs[i] = txs[i][:40] + bytes([txs[i][40] ^ 1]) + txs[i][41:]
+        n_forged += 1
+    n_good = N_SIGNED - n_forged
+
+    def drain(mp, want, timeout=600.0):
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            mp.flush_app_conn()
+            if mp.size() == want:
+                return True
+            time.sleep(0.005)
+        return False
+
+    # -- gated: batch pre-verification ahead of the app -------------------
+    cfg = test_config().mempool
+    cfg.root_dir = tempfile.mkdtemp(prefix="bench-mempool-sig-")
+    app = SignedKVStoreApp(verify_in_app=False)
+    verifier = Verifier(min_tpu_batch=32)
+    batcher = SigBatcher(verifier, parse_sig_tx, max_batch=4096, max_wait_s=0.004)
+    mp = Mempool(cfg, AppConnMempool(LocalClient(app, threading.RLock())),
+                 sig_batcher=batcher)
+    # warm the kernel bucket off the clock
+    verifier.verify_batch([parse_sig_tx(t) for t in txs[:64]])
+    warm_stats = verifier.stats()
+    t0 = time.perf_counter()
+    for tx in txs:
+        mp.check_tx(tx)
+    assert drain(mp, n_good), f"gated drain stalled at {mp.size()}/{n_good}"
+    gated_s = time.perf_counter() - t0
+    batcher.stop()
+    stats = verifier.stats()
+    stats = {k: stats[k] - warm_stats.get(k, 0) for k in stats}
+    assert app.check_tx_calls == n_good, (app.check_tx_calls, n_good)
+
+    # -- reference shape: the app verifies per tx on CPU ------------------
+    cfg2 = test_config().mempool
+    cfg2.root_dir = tempfile.mkdtemp(prefix="bench-mempool-sig-")
+    app2 = SignedKVStoreApp(verify_in_app=True)
+    mp2 = Mempool(cfg2, AppConnMempool(LocalClient(app2, threading.RLock())))
+    t0 = time.perf_counter()
+    for tx in txs:
+        mp2.check_tx(tx)
+    assert drain(mp2, n_good), f"in-app drain stalled at {mp2.size()}/{n_good}"
+    in_app_s = time.perf_counter() - t0
+
+    return {
+        "signed_txs": N_SIGNED,
+        "forged": n_forged,
+        "gated_sigs_per_sec": round(N_SIGNED / gated_s, 1),
+        "in_app_sigs_per_sec": round(N_SIGNED / in_app_s, 1),
+        "gate_speedup": round(in_app_s / gated_s, 2),
+        "gateway_stats": stats,
+    }
 
 
 def main() -> None:
@@ -79,6 +162,7 @@ def main() -> None:
                     "reap_update_s": round(cycle_s, 3),
                     "reaped": len(reaped),
                     "app": "counter(local)",
+                    "signed": _signed_scenario(),
                 },
             }
         )

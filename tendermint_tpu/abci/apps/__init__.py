@@ -5,5 +5,9 @@ proxy/client.go:64-76)."""
 from tendermint_tpu.abci.apps.kvstore import KVStoreApp, PersistentKVStoreApp
 from tendermint_tpu.abci.apps.counter import CounterApp
 from tendermint_tpu.abci.apps.nilapp import NilApp
+from tendermint_tpu.abci.apps.signedkv import SignedKVStoreApp
 
-__all__ = ["KVStoreApp", "PersistentKVStoreApp", "CounterApp", "NilApp"]
+__all__ = [
+    "KVStoreApp", "PersistentKVStoreApp", "CounterApp", "NilApp",
+    "SignedKVStoreApp",
+]

@@ -237,7 +237,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_init)
 
     sp = sub.add_parser("node", help="run the node")
-    sp.add_argument("--proxy_app", default=None, help="app address or name (kvstore, counter, nilapp, tcp://...)")
+    sp.add_argument("--proxy_app", default=None, help="app address or name (kvstore, signedkv, counter, nilapp, tcp://...)")
     sp.add_argument("--moniker", default=None)
     sp.add_argument("--fast_sync", action="store_true", default=None)
     sp.add_argument("--p2p.laddr", dest="p2p_laddr", default=None)

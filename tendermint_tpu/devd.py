@@ -89,11 +89,15 @@ class _DaemonState:
         self.stop = threading.Event()
 
 
-def _subprocess_probe(timeout_s: float) -> str | None:
-    """Dial the device in a THROWAWAY subprocess. The probe bounds itself
-    (jitcache.probe_device daemon-thread dial + clean interpreter exit),
-    so no one ever SIGKILLs a process mid-device-op here. If the child
-    somehow outlives its own bound, it is left to finish — never killed."""
+def subprocess_probe(timeout_s: float) -> str | None:
+    """Dial the device in a THROWAWAY subprocess; the platform name or
+    None. The probe bounds itself (jitcache.probe_device daemon-thread
+    dial + clean interpreter exit), so no one ever SIGKILLs a process
+    mid-device-op here; if the child somehow outlives its own bound, it
+    is left to finish — never killed. Use THIS (not an in-process
+    probe_device) from any process that must stay usable afterwards: a
+    hung in-process dial holds jax's backend-init lock forever, so even
+    later CPU-only jax calls in that process would block."""
     code = (
         "from tendermint_tpu.jitcache import probe_device; import sys;"
         f"p = probe_device({timeout_s});"
@@ -138,7 +142,7 @@ def _device_loop(st: _DaemonState, *, accept_cpu: bool, probe_timeout: float,
         if accept_cpu:
             platform = "cpu"
         else:
-            platform = _subprocess_probe(probe_timeout)
+            platform = subprocess_probe(probe_timeout)
         if platform is None:
             st.status = "waiting-for-device"
             logger.warning(

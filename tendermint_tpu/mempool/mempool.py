@@ -13,14 +13,99 @@ interleaves with state transition (state/execution.py commit path).
 
 from __future__ import annotations
 
+import queue
 import threading
+import time
 from collections import OrderedDict
 
-from tendermint_tpu.abci.types import ResponseCheckTx
+from tendermint_tpu.abci.types import CODE_UNAUTHORIZED, ResponseCheckTx
 from tendermint_tpu.libs.autofile import Group
 from tendermint_tpu.libs.clist import CList
 
 CACHE_SIZE = 100_000
+
+
+class SigBatcher:
+    """Batch signature pre-verification gate ahead of app CheckTx
+    (BASELINE config 5). The reference mempool hands every tx straight to
+    the app, which verifies one signature at a time on CPU
+    (mempool/mempool.go:166-205); here a CheckTx burst's sig-carrying txs
+    accumulate for up to `max_wait_s` (or `max_batch`), the collected
+    signatures verify in ONE gateway batch — the TPU kernel when wide —
+    and only txs whose signature held are dispatched to the app at all.
+
+    `parse(tx) -> (pubkey, msg, sig) | None`; txs parsing to None bypass
+    the gate (the app decides). Runs its own drain thread; submit() is
+    called under the mempool lock and never blocks on the device.
+
+    The intake queue is BOUNDED (`max_backlog`): a peer flooding unique
+    signed txs faster than the verifier drains must get refusals, not an
+    unbounded in-memory backlog — the same end-to-end-bound rule the
+    consensus peer ingress follows (consensus/state._enqueue_peer_msg;
+    the tx cache's FIFO eviction means fresh floods are never refused
+    there). submit() returns False on overflow and the caller rejects
+    the tx retriably."""
+
+    def __init__(self, verifier, parse, max_batch: int = 512,
+                 max_wait_s: float = 0.002, max_backlog: int = 8192):
+        self.verifier = verifier
+        self.parse = parse
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_s
+        self.dropped = 0
+        self._q: queue.Queue = queue.Queue(maxsize=max_backlog)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="mempool.sigbatch"
+        )
+        self._thread.start()
+
+    def submit(self, item, ok_cb, bad_cb) -> bool:
+        """Enqueue for the next batch; False if the gate is saturated
+        (caller must reject the tx without app dispatch)."""
+        try:
+            self._q.put_nowait((item, ok_cb, bad_cb))
+            return True
+        except queue.Full:
+            self.dropped += 1
+            return False
+
+    def stop(self) -> None:
+        self._q.put(None)
+
+    def _run(self) -> None:
+        while True:
+            first = self._q.get()
+            if first is None:
+                return
+            batch = [first]
+            deadline = time.monotonic() + self.max_wait_s
+            while len(batch) < self.max_batch:
+                wait = deadline - time.monotonic()
+                if wait <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=wait)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._q.put(None)  # re-arm stop for after this batch
+                    break
+                batch.append(nxt)
+            try:
+                oks = self.verifier.verify_batch([b[0] for b in batch])
+            except Exception:  # noqa: BLE001 — fail OPEN: the gate is an
+                # optimization, not the security boundary (DeliverTx
+                # re-verifies unconditionally — apps/signedkv.py), so a
+                # verifier bug may admit junk to the pool but never to a
+                # block; failing closed would drop valid txs instead
+                oks = None
+            for (item, ok_cb, bad_cb), ok in zip(
+                batch, oks if oks is not None else [True] * len(batch)
+            ):
+                try:
+                    (ok_cb if ok else bad_cb)()
+                except Exception:  # noqa: BLE001 — one bad cb must not stall the gate
+                    pass
 
 
 class TxInCacheError(Exception):
@@ -70,9 +155,10 @@ class TxCache:
 
 
 class Mempool:
-    def __init__(self, config, proxy_app_conn):
+    def __init__(self, config, proxy_app_conn, sig_batcher: SigBatcher | None = None):
         self.config = config
         self.proxy_app_conn = proxy_app_conn
+        self.sig_batcher = sig_batcher
         self.txs = CList()
         self.counter = 0
         self.height = 0
@@ -138,16 +224,52 @@ class Mempool:
 
     def check_tx(self, tx: bytes, cb=None) -> None:
         """Validate tx against the app; good txs enter the pool when the
-        async response lands (mempool/mempool.go:166-205)."""
+        async response lands (mempool/mempool.go:166-205). With a
+        SigBatcher wired, sig-carrying txs first pass the batched
+        signature gate — invalid signatures are rejected here without
+        ever reaching the app."""
         with self._mtx:
             if not self.cache.push(tx):
                 raise TxInCacheError(tx.hex()[:16])
             if self.wal is not None:
                 self.wal.write_line(tx.hex())
                 self.wal.flush()
+            if self.sig_batcher is not None:
+                item = self.sig_batcher.parse(tx)
+                if item is not None:
+                    if not self.sig_batcher.submit(
+                        item,
+                        ok_cb=lambda: self._dispatch_preverified(tx, cb),
+                        bad_cb=lambda: self._reject_bad_sig(tx, cb),
+                    ):
+                        # gate saturated: refuse retriably, never grow an
+                        # unbounded backlog off a peer-driven path
+                        self.cache.remove(tx)
+                        if cb is not None:
+                            cb(ResponseCheckTx(
+                                code=CODE_UNAUTHORIZED,
+                                log="signature gate saturated; retry",
+                            ))
+                    return
             reqres = self.proxy_app_conn.check_tx_async(tx)
             if cb is not None:
                 reqres.set_callback(lambda res: cb(res))
+
+    def _dispatch_preverified(self, tx: bytes, cb) -> None:
+        """Signature held: forward to the app (batcher thread)."""
+        with self._mtx:
+            reqres = self.proxy_app_conn.check_tx_async(tx)
+            if cb is not None:
+                reqres.set_callback(lambda res: cb(res))
+
+    def _reject_bad_sig(self, tx: bytes, cb) -> None:
+        """Signature failed the batch gate: reject without app dispatch —
+        same cache semantics as an app-rejected tx (allow resubmission,
+        mempool/mempool.go:231)."""
+        self.cache.remove(tx)
+        if cb is not None:
+            cb(ResponseCheckTx(code=CODE_UNAUTHORIZED,
+                               log="invalid signature (batch pre-verify)"))
 
     def _res_cb(self, req_type: str, tx, res) -> None:
         """Routed to normal or recheck mode by cursor state

@@ -121,8 +121,24 @@ class Node(BaseService):
                 fast_sync = False
         self.fast_sync = fast_sync
 
-        # -- mempool (node.go:206-212) ------------------------------------
-        self.mempool = Mempool(config.mempool, self.proxy_app.mempool())
+        # -- mempool (node.go:206-212). A local app that publishes a tx
+        # signature parser (e.g. apps/signedkv.py) gets the batched
+        # signature gate: CheckTx bursts verify through the TPU gateway
+        # BEFORE app dispatch (BASELINE config 5; the reference app
+        # verifies per-tx on CPU, mempool/mempool.go:166-205) ------------
+        sig_batcher = None
+        local_app = getattr(client_creator, "app", None)
+        tx_parser = getattr(local_app, "tx_sig_parser", None)
+        if tx_parser is not None:
+            from tendermint_tpu.mempool.mempool import SigBatcher
+
+            # the gate replaces the app's own CheckTx verification
+            if hasattr(local_app, "verify_in_app"):
+                local_app.verify_in_app = False
+            sig_batcher = SigBatcher(self.verifier, tx_parser)
+        self.mempool = Mempool(
+            config.mempool, self.proxy_app.mempool(), sig_batcher=sig_batcher
+        )
         self.mempool.init_wal()
         self.mempool_reactor = MempoolReactor(config.mempool, self.mempool)
 
@@ -242,6 +258,8 @@ class Node(BaseService):
         if self.rpc_server is not None:
             self.rpc_server.stop()
         self.sw.stop()
+        if self.mempool.sig_batcher is not None:
+            self.mempool.sig_batcher.stop()
         self.mempool.close_wal()
         self.proxy_app.stop()
         self.evsw.stop()

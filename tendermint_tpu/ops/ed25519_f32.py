@@ -297,7 +297,10 @@ def _verify_impl(ax, ay, r_y, r_sign, s8, h8):
     Interleaved Straus, 2-bit joint windows: 127 x (2 doublings + 1
     16-entry table add)."""
     batch = ax.shape[-1]
-    zeros = jnp.zeros((NL, batch), dtype=jnp.float32)
+    # derive from the input (not jnp.zeros): the scan carry must be
+    # batch-varying from step 0 under shard_map's manual axes (see the
+    # same construction in ed25519_f32p._ladder); value-identical
+    zeros = ax * 0.0
     one = zeros.at[0].set(1.0)
     d2 = jnp.broadcast_to(jnp.asarray(_D2)[:, None], (NL, batch))
 

@@ -215,7 +215,15 @@ def _finv_rows(z: list) -> list:
 # -- the kernel ---------------------------------------------------------------
 
 
-def _verify_kernel(ax_ref, ay_ref, ry_ref, rsign_ref, dig_s_ref, dig_h_ref, out_ref):
+def _ladder(ax_ref, ay_ref, ry_ref, rsign_ref, dig_s_ref, dig_h_ref):
+    """The full f32p verify ladder — table build, 127-step joint Straus
+    walk with masked-FMA select, inversion, canonicalization, R-point
+    comparison. Written against ref-OR-array inputs: `x[k]` (static limb
+    index) and `x[i]` (traced step index) mean the same thing for a
+    pallas VMEM ref and a jnp array, so ONE body serves both the Mosaic
+    kernel (_verify_kernel) and the plain-XLA per-shard path the sharded
+    verifier runs on non-TPU meshes (make_sharded_verify). Returns the
+    (S, LANES) int32 accept mask."""
     S, LANES = ax_ref.shape[1], ax_ref.shape[2]
 
     def rows(ref):
@@ -224,8 +232,13 @@ def _verify_kernel(ax_ref, ay_ref, ry_ref, rsign_ref, dig_s_ref, dig_h_ref, out_
     def const_rows(vals):
         return [jnp.full((S, LANES), v, dtype=jnp.float32) for v in vals]
 
-    zero = jnp.zeros((S, LANES), dtype=jnp.float32)
-    one_v = jnp.ones((S, LANES), dtype=jnp.float32)
+    # derive zero/one from the input rows (not jnp.zeros): under
+    # shard_map the fori_loop carry must be batch-varying from step 0,
+    # and a fresh constant is replicated — the scan would reject the
+    # carry with a varying-manual-axes mismatch. Inside the pallas
+    # kernel this is the same value either way.
+    zero = ax_ref[0] * 0.0
+    one_v = zero + 1.0
     zeros = [zero] * NL
     one = [one_v] + [zero] * (NL - 1)
     d2 = const_rows(_D2_L)
@@ -282,7 +295,11 @@ def _verify_kernel(ax_ref, ay_ref, ry_ref, rsign_ref, dig_s_ref, dig_h_ref, out_
         eq = eq & (y_aff[k] == ry[k])
     sign = jnp.mod(x_aff[0], 2.0).astype(jnp.int32)
     eq = eq & (sign == rsign_ref[0])
-    out_ref[0] = eq.astype(jnp.int32)
+    return eq.astype(jnp.int32)
+
+
+def _verify_kernel(ax_ref, ay_ref, ry_ref, rsign_ref, dig_s_ref, dig_h_ref, out_ref):
+    out_ref[0] = _ladder(ax_ref, ay_ref, ry_ref, rsign_ref, dig_s_ref, dig_h_ref)
 
 
 S_TILE = 8  # (8, 128) f32 rows; tile = 1024 lanes (Mosaic requires the
@@ -382,3 +399,114 @@ def verify_batch_async(items: list[tuple[bytes, bytes, bytes]]):
 def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
     """Drop-in gateway backend (same contract as base.verify_batch)."""
     return verify_batch_async(items)()
+
+
+# -- multi-chip: the ladder sharded over a device mesh ------------------------
+
+_sharded_calls: dict = {}
+
+
+def lane_quantum(n_dev: int, on_tpu: bool) -> int:
+    """Smallest lane count divisible into equal per-device shards: each
+    device takes whole (S, 128) rows, and on TPU Mosaic additionally
+    needs S_TILE rows per grid step."""
+    return n_dev * 128 * (S_TILE if on_tpu else 1)
+
+
+def make_sharded_verify(mesh, on_tpu: bool):
+    """jit(shard_map(per-shard verify)) over `mesh`'s "batch" axis — the
+    f32p kernel's multi-chip path.
+
+    Pure data parallelism: all inputs are (rows, S, 128) with the S
+    dimension sharded, each chip verifies its slice, no collectives
+    (independent signature lanes — SURVEY §2.3). The per-shard body:
+
+    - TPU mesh: byte-digit expansion (base._digits2, plain XLA) feeding
+      the SAME Mosaic pallas_call the single-chip path runs — the
+      VMEM-resident ladder, grid over the shard's tiles.
+    - non-TPU mesh: the conv-lowered fp32 ladder (base._verify_impl) on
+      the shard's flattened lanes. The unrolled pallas body cannot stand
+      in here: it is Mosaic-shaped (~3*10^5 scalar HLO ops), and XLA CPU
+      was measured at >40min compiling it (interpret mode: >9min for ONE
+      128-lane tile). Same field representation, same radix-2^8 ladder
+      algorithm, same accept/reject semantics (lane-for-lane parity is
+      pinned by tests); the pallas BODY's own parity stays covered by the
+      hardware-gated single-chip test (tests/test_ops_f32.py).
+
+    So a CPU-mesh run (tests, dryrun_multichip) executes the f32p path's
+    real sharding structure — specs, bucketing, marshal, digit layout —
+    end to end, and a TPU mesh runs the real kernel per chip."""
+    n_dev = mesh.size
+    # Mesh is hashable by value — an id() key could hand a NEW mesh at a
+    # recycled address the stale compiled call of a dead one
+    key = (mesh, on_tpu)
+    if key in _sharded_calls:
+        return _sharded_calls[key]
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as PS
+
+    spec = PS(None, "batch", None)
+
+    def per_shard(ax, ay, ry, rs, s8, h8):
+        s_local = ax.shape[1]
+        if on_tpu:
+            ds = base._digits2(s8.reshape(32, -1)).reshape(127, s_local, 128)
+            dh = base._digits2(h8.reshape(32, -1)).reshape(127, s_local, 128)
+            spec32 = pl.BlockSpec(
+                (NL, S_TILE, 128), lambda i: (0, i, 0), memory_space=pltpu.VMEM
+            )
+            spec127 = pl.BlockSpec(
+                (127, S_TILE, 128), lambda i: (0, i, 0), memory_space=pltpu.VMEM
+            )
+            spec1 = pl.BlockSpec(
+                (1, S_TILE, 128), lambda i: (0, i, 0), memory_space=pltpu.VMEM
+            )
+            return pl.pallas_call(
+                _verify_kernel,
+                grid=(s_local // S_TILE,),
+                in_specs=[spec32, spec32, spec32, spec1, spec127, spec127],
+                out_specs=spec1,
+                out_shape=jax.ShapeDtypeStruct((1, s_local, 128), jnp.int32),
+            )(ax, ay, ry, rs, ds, dh)
+        ok = base._verify_impl(
+            ax.reshape(NL, -1), ay.reshape(NL, -1), ry.reshape(NL, -1),
+            rs.reshape(-1), s8.reshape(32, -1), h8.reshape(32, -1),
+        )
+        return ok.astype(jnp.int32).reshape(1, s_local, 128)
+
+    fn = jax.jit(
+        shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, spec, spec, spec),
+            out_specs=spec,
+        )
+    )
+    _sharded_calls[key] = fn
+    return fn
+
+
+def sharded_verify_batch(items, mesh, on_tpu: bool) -> np.ndarray:
+    """Marshal + run a batch through make_sharded_verify. Buckets to the
+    smallest power of two >= n that divides into equal per-device shards
+    (compile count stays bounded at log2(maxN) shapes per mesh)."""
+    n = len(items)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    q = lane_quantum(mesh.size, on_tpu)
+    bucket = q
+    while bucket < n:
+        bucket <<= 1
+    ax, ay, ry, rs, s8, h8, valid = base.prepare_batch8(items, bucket)
+    s_total = bucket // 128
+    fn = make_sharded_verify(mesh, on_tpu)
+    ok = fn(
+        jnp.asarray(ax.reshape(NL, s_total, 128)),
+        jnp.asarray(ay.reshape(NL, s_total, 128)),
+        jnp.asarray(ry.reshape(NL, s_total, 128)),
+        jnp.asarray(rs.reshape(1, s_total, 128)),
+        jnp.asarray(s8.reshape(32, s_total, 128)),
+        jnp.asarray(h8.reshape(32, s_total, 128)),
+    )
+    return (np.asarray(ok).reshape(-1)[:n] != 0) & valid[:n]

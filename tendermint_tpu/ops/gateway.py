@@ -176,9 +176,13 @@ class Verifier:
         direct-kernel failure (or an unreachable device) latches the
         permanent CPU fallback, as before."""
         if self._kernel == "devd":
-            from tendermint_tpu.jitcache import probe_device
+            # probe in a throwaway subprocess: an in-process dial that
+            # hangs (wedged tunnel — likely why the daemon died) would
+            # hold jax's backend-init lock forever and poison every later
+            # jax call in this process (see devd.subprocess_probe)
+            from tendermint_tpu import devd
 
-            platform = probe_device(15.0)
+            platform = devd.subprocess_probe(15.0)
             if platform in ("tpu", "axon"):
                 self._kernel = "f32p"
                 logger.warning("devd unreachable; direct %s kernel", self._kernel)
@@ -339,29 +343,32 @@ class ShardedVerifier(Verifier):
     """Verifier whose kernel inputs are sharded over a device mesh along the
     batch axis. Each chip verifies its slice; results gather to host. This
     is how a 10k-validator commit rides a v5e pod slice: 10k lanes split
-    over N chips on ICI."""
+    over N chips on ICI.
+
+    Two sharded backends: "f32p" (shard_map over the pallas ladder — the
+    single-chip winner, now the TPU-mesh default; per-shard body is plain
+    XLA on non-TPU meshes, same math — ed25519_f32p.make_sharded_verify)
+    and "f32" (pjit over the conv formulation — the non-TPU default and
+    the fallback). Bake-off backends don't shard; requesting one
+    explicitly is an error rather than a silent misreport."""
 
     def __init__(self, mesh, min_tpu_batch: int = 32):
         super().__init__(min_tpu_batch=min_tpu_batch, use_tpu=True)
-        if (kn := os.environ.get("TENDERMINT_TPU_KERNEL") or "f32") != "f32":
-            # the sharded wide-batch path jits ed25519_f32._verify_impl
-            # directly (pjit over the conv formulation; the pallas grid
-            # doesn't shard across a mesh), so honoring a different
-            # backend here would silently report f32 numbers under the
-            # other kernel's name. Only an EXPLICIT override is an error —
-            # the platform-aware default doesn't apply to this class.
+        explicit = os.environ.get("TENDERMINT_TPU_KERNEL", "")
+        if explicit and explicit not in ("f32", "f32p"):
             raise ValueError(
-                f"ShardedVerifier only supports the f32 kernel; "
-                f"TENDERMINT_TPU_KERNEL={kn!r} — use the base Verifier to "
-                f"run a bake-off backend"
+                f"ShardedVerifier shards the f32/f32p kernels; "
+                f"TENDERMINT_TPU_KERNEL={explicit!r} — use the base "
+                f"Verifier to run a bake-off backend or the device daemon"
             )
+        # base init may have resolved devd; this class does its own
+        # in-process sharded dispatch
+        self._kernel = explicit or ("f32p" if on_tpu() else "f32")
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as PS
 
         from tendermint_tpu.ops import ed25519_f32 as ops_ed
 
-        self._kernel = "f32"  # base init may have resolved devd/f32p; this
-        # class dispatches its own pjit'd f32 and must demote as f32
         self.mesh = mesh
         self._n_dev = mesh.size
         batch_last = NamedSharding(mesh, PS(None, "batch"))
@@ -373,9 +380,9 @@ class ShardedVerifier(Verifier):
         )
 
     def _kernel_module(self):
-        # pin f32 for the inherited sync/async fallback paths too — the
-        # platform default must never swap this class onto the unsharded
-        # pallas kernel
+        # pin f32 for the inherited sync/async fallback paths — the
+        # narrow-batch path must never swap onto the unsharded pallas
+        # kernel (and self._kernel may be the sharded "f32p")
         import importlib
 
         return importlib.import_module(KERNELS["f32"])
@@ -391,6 +398,15 @@ class ShardedVerifier(Verifier):
         if not self._tpu_ok or n < self.min_tpu_batch:
             return super().verify_batch(items)
         try:
+            if self._kernel == "f32p":
+                from tendermint_tpu.ops import ed25519_f32p as ops_f32p
+
+                oks = ops_f32p.sharded_verify_batch(items, self.mesh, on_tpu())
+                with self._mtx:
+                    self._stats["tpu_batches"] += 1
+                    self._stats["tpu_sigs"] += n
+                return [bool(b) for b in oks]
+
             import jax.numpy as jnp
 
             from tendermint_tpu.ops import ed25519_f32 as ops_ed
@@ -411,6 +427,10 @@ class ShardedVerifier(Verifier):
                 self._stats["tpu_sigs"] += n
             return [bool(b) for b in (np.asarray(ok)[:n] & valid[:n])]
         except Exception:
+            if self._kernel == "f32p":
+                logger.exception("sharded f32p verify failed; trying f32")
+                self._kernel = "f32"
+                return self.verify_batch(items)
             logger.exception("sharded TPU verify failed; falling back to CPU")
             self._tpu_ok = False
             return super().verify_batch(items)
@@ -422,16 +442,23 @@ class ShardedVerifier(Verifier):
 class Hasher:
     """Batched hashing gateway for the PartSet/tx-tree hot paths.
 
-    Policy (measured, v5e behind a tunnel, benches/bench_partset.py):
-    hashing is Merkle-Damgard-serial integer work — the opposite shape of
-    the MXU/VPU sweet spot — and CPU OpenSSL sustains ~190 MB/s/core
-    while the device kernel pays per-call dispatch + host->device bytes.
-    Measured ratios (CPU/TPU): 16x64KB parts 0.01, 256x64KB 0.07,
-    16384x128B leaves 0.16 — CPU wins every production shape. So unlike
-    the signature Verifier (11x on TPU), the hashing default is CPU;
-    set TENDERMINT_TPU_HASHES=1 (or use_tpu=True) to route wide batches
-    to the device kernels, e.g. on hosts where CPU cores, not chips, are
-    the scarce resource."""
+    Policy (FINAL, round 4): CPU-default. Measured on a v5e behind the
+    axon tunnel (benches/bench_partset.py): offload 2.28 vs CPU 205
+    MB/s; ratios (CPU/TPU) 16x64KB parts 0.01, 256x64KB 0.07,
+    16384x128B leaves 0.16. The tunnel confound is acknowledged and
+    modeled: its 85-150 ms sync round-trip alone caps any tunneled hash
+    kernel at ~8-11 MB/s for a 1 MB part batch, so the tunneled number
+    says little about the kernel. The closure rests on the workload
+    shape instead: SHA-256/RIPEMD-160 are strictly serial 64-byte
+    compression chains (a 64 KB part = 1024 sequential rounds of
+    integer rotate/xor — no MXU help), so the device's only parallel
+    axis is across parts, 16-256 wide at production shapes — far under
+    VPU width. Modeled local-chip ceiling is O(one CPU core); OpenSSL
+    already sustains ~200 MB/s/core with zero transfer cost. Unlike the
+    signature Verifier (11x on TPU), hashing stays on CPU.
+    TENDERMINT_TPU_HASHES=1 (or use_tpu=True) remains for chip-rich/
+    core-poor hosts and genuinely wide batches (e.g. 16k+ small
+    leaves, where the measured gap narrows to 6x)."""
 
     def __init__(self, min_tpu_batch: int = 16, use_tpu: bool | None = None):
         if use_tpu is None:

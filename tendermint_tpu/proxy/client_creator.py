@@ -51,6 +51,10 @@ def default_client_creator(addr: str, db_dir: str = ".", transport: str = "socke
         return LocalClientCreator(KVStoreApp())
     if addr in ("persistent_kvstore", "persistent_dummy"):
         return LocalClientCreator(PersistentKVStoreApp(db_dir))
+    if addr == "signedkv":
+        from tendermint_tpu.abci.apps.signedkv import SignedKVStoreApp
+
+        return LocalClientCreator(SignedKVStoreApp())
     if addr == "counter":
         return LocalClientCreator(CounterApp())
     if addr == "counter_serial":

@@ -351,6 +351,8 @@ def metrics(ctx) -> dict:
         out["fastsync_active"] = int(bool(bc.fast_sync))
         out["fastsync_blocks_synced"] = bc.blocks_synced
         out["fastsync_rate_blocks_per_sec"] = round(bc.sync_rate, 3)
+        for stage, secs in bc.stage_s.items():
+            out[f"fastsync_{stage}_s"] = round(secs, 3)
     verifier = getattr(node, "verifier", None)
     if verifier is not None:
         for k, v in verifier.stats().items():

@@ -109,6 +109,151 @@ class TestMempool:
             assert f.read().strip() == b"abc".hex()
 
 
+class TestSigPreVerification:
+    """Mempool batch signature gate (BASELINE config 5): a CheckTx
+    burst's signatures verify in ONE gateway batch before app dispatch;
+    bad-sig txs never reach the app (ref mempool/mempool.go:166-205
+    dispatches everything and lets the app verify per tx)."""
+
+    def _mk(self, max_wait_s=0.01):
+        from tendermint_tpu.abci.apps.signedkv import SignedKVStoreApp, parse_sig_tx
+        from tendermint_tpu.mempool.mempool import SigBatcher
+        from tendermint_tpu.ops.gateway import Verifier
+
+        app = SignedKVStoreApp(verify_in_app=False)
+        verifier = Verifier(min_tpu_batch=4, use_tpu=True)
+        # warm the kernel buckets OFF the drain clock (a cold .jax_cache
+        # compile takes minutes; the batcher thread would sit inside it)
+        warm = [self._sig_item(i) for i in range(12)]
+        verifier.verify_batch(warm)
+        self._warm_stats = verifier.stats()
+        batcher = SigBatcher(verifier, parse_sig_tx, max_wait_s=max_wait_s)
+        cfg = _test_config().mempool
+        mp = Mempool(cfg, AppConnMempool(LocalClient(app)), sig_batcher=batcher)
+        return mp, app, verifier, batcher
+
+    @staticmethod
+    def _sig_item(i: int):
+        from tendermint_tpu.abci.apps.signedkv import parse_sig_tx
+
+        return parse_sig_tx(TestSigPreVerification._signed(i))
+
+    @staticmethod
+    def _signed(i: int, forge: bool = False) -> bytes:
+        from tendermint_tpu.abci.apps.signedkv import make_sig_tx
+
+        seed = bytes([i % 7 + 1]) * 32
+        tx = make_sig_tx(seed, b"k%d=v%d" % (i, i))
+        if forge:
+            tx = tx[:40] + bytes([tx[40] ^ 1]) + tx[41:]
+        return tx
+
+    def _drain(self, mp, expect_size, timeout=60.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            mp.flush_app_conn()
+            if mp.size() == expect_size:
+                return
+            time.sleep(0.01)
+        assert mp.size() == expect_size, mp.size()
+
+    def test_bad_sigs_never_reach_the_app(self):
+        mp, app, verifier, batcher = self._mk()
+        results = {}
+        for i in range(12):
+            tx = self._signed(i, forge=(i % 3 == 0))
+            mp.check_tx(tx, cb=lambda res, i=i: results.__setitem__(i, res.code))
+        self._drain(mp, 8)  # 4 of 12 forged
+        batcher.stop()
+        assert app.check_tx_calls == 8  # forged txs cost no app round-trip
+        assert {i for i, c in results.items() if c != 0} == {0, 3, 6, 9}
+        # signatures rode the gateway in batches, not one-at-a-time
+        st = verifier.stats()
+        d_sigs = (st["tpu_sigs"] + st["cpu_sigs"]
+                  - self._warm_stats["tpu_sigs"] - self._warm_stats["cpu_sigs"])
+        d_batches = st["tpu_batches"] - self._warm_stats["tpu_batches"]
+        assert d_sigs >= 12
+        assert d_batches <= 4
+
+    def test_bad_sig_tx_can_be_resubmitted(self):
+        import threading
+
+        mp, app, _, batcher = self._mk()
+        bad = self._signed(1, forge=True)
+        rejected = threading.Event()
+        mp.check_tx(bad, cb=lambda res: rejected.set())
+        assert rejected.wait(60), "batch gate never rejected the forged tx"
+        # cache slot released on rejection (mempool/mempool.go:231)
+        rejected2 = threading.Event()
+        mp.check_tx(bad, cb=lambda res: rejected2.set())
+        assert rejected2.wait(60)
+        assert mp.size() == 0
+        batcher.stop()
+
+    def test_unsigned_txs_bypass_the_gate(self):
+        from tendermint_tpu.abci.types import CODE_UNAUTHORIZED
+
+        mp, app, _, batcher = self._mk()
+        results = []
+        mp.check_tx(b"short", cb=lambda res: results.append(res.code))
+        self._drain(mp, 0)
+        batcher.stop()
+        assert app.check_tx_calls == 1  # the APP judged it (malformed)
+        assert results == [CODE_UNAUTHORIZED]
+
+    def test_saturated_gate_refuses_retriably(self):
+        """A flood beyond the gate's bounded backlog gets retriable
+        refusals (cache slot freed), never an unbounded in-memory queue —
+        the same end-to-end-bound rule as the consensus peer ingress."""
+        import threading
+
+        from tendermint_tpu.abci.apps.signedkv import SignedKVStoreApp, parse_sig_tx
+        from tendermint_tpu.abci.types import CODE_UNAUTHORIZED
+        from tendermint_tpu.mempool.mempool import SigBatcher
+
+        release = threading.Event()
+
+        class SlowVerifier:
+            def verify_batch(self, items):
+                release.wait(30)
+                return [True] * len(items)
+
+        batcher = SigBatcher(SlowVerifier(), parse_sig_tx,
+                             max_batch=1, max_wait_s=0.001, max_backlog=2)
+        app = SignedKVStoreApp(verify_in_app=False)
+        cfg = _test_config().mempool
+        mp = Mempool(cfg, AppConnMempool(LocalClient(app)), sig_batcher=batcher)
+
+        results: dict = {}
+        sent = []
+        for i in range(8):
+            tx = self._signed(i + 40)
+            sent.append(tx)
+            mp.check_tx(tx, cb=lambda res, i=i: results.__setitem__(i, res))
+        assert batcher.dropped > 0  # the flood overflowed the bound
+        saturated = [i for i, r in results.items()
+                     if r.code == CODE_UNAUTHORIZED and "saturated" in r.log]
+        assert saturated, results
+        release.set()
+        # a refused tx is retriable once the gate drains (cache slot freed)
+        self._drain(mp, 8 - len(saturated))
+        mp.check_tx(sent[saturated[0]])
+        self._drain(mp, 8 - len(saturated) + 1)
+        batcher.stop()
+
+    def test_deliver_tx_always_verifies(self):
+        """The gate is an optimization, not the security boundary: a
+        forged tx arriving in a BLOCK (bypassing this node's mempool)
+        dies in DeliverTx."""
+        from tendermint_tpu.abci.apps.signedkv import SignedKVStoreApp
+
+        app = SignedKVStoreApp(verify_in_app=False)
+        good = self._signed(2)
+        assert app.deliver_tx(good).code == 0
+        assert app.deliver_tx(self._signed(3, forge=True)).code != 0
+        assert app.query(b"k2").value == b"v2"
+
+
 def _make_block_with_commit(height, chain_id="test-store"):
     from tendermint_tpu.types.block import empty_commit
 

@@ -339,7 +339,7 @@ class TestKernelRegistry:
             def verify_batch(its):
                 return np.array([True] * len(its))
 
-        monkeypatch.setattr(gw, "kernel_module", lambda: SyncOnly)
+        monkeypatch.setattr(v, "_kernel_module", lambda: SyncOnly)
         resolve = v.verify_batch_async(items)
         assert resolve() == [True] * 4
         assert v.stats()["tpu_batches"] == 1

@@ -1,0 +1,59 @@
+"""Mock RPC client tests (rpc/mock.py; reference rpc/client/mock/client.go)."""
+
+from __future__ import annotations
+
+import pytest
+
+from tendermint_tpu.rpc.mock import Call, MockClient, MockClientError
+
+
+def test_canned_values_and_recording():
+    mc = MockClient().expect("status", {"latest_block_height": 7})
+    assert mc.status() == {"latest_block_height": 7}
+    assert mc.call("status") == {"latest_block_height": 7}
+    assert len(mc.calls_for("status")) == 2
+    assert mc.calls_for("status")[0].response["latest_block_height"] == 7
+
+
+def test_callable_exception_and_unknown():
+    boom = RuntimeError("node down")
+    mc = MockClient(responses={
+        "block": lambda height: {"height": height * 2},
+        "tx": boom,
+    })
+    assert mc.block(height=21) == {"height": 42}
+    assert mc.calls_for("block")[0].params == {"height": 21}
+    with pytest.raises(RuntimeError, match="node down"):
+        mc.tx(hash="ab")
+    assert mc.calls_for("tx")[0].error is boom
+    with pytest.raises(MockClientError, match="no canned response"):
+        mc.genesis()
+
+
+def test_passthrough_composes_with_real_client():
+    class Real:
+        def call(self, method, **params):
+            return {"from": "real", "method": method, **params}
+
+    mc = MockClient(responses={"status": {"from": "mock"}}, client=Real())
+    assert mc.status() == {"from": "mock"}
+    assert mc.validators(height=3) == {
+        "from": "real", "method": "validators", "height": 3,
+    }
+
+
+def test_drives_the_light_client():
+    """Interface-fit proof: the light client runs against MockClient with
+    callable canned responses (replacing an ad-hoc stub)."""
+    from tendermint_tpu.rpc.light import LightClient
+    from tests.test_light import CHAIN, _chain_with_change
+
+    stub, old_set = _chain_with_change(old_signs_transition=True)
+    mc = MockClient(responses={
+        "commit": lambda height: stub.commit(height),
+        "validators": lambda height=0: stub.validators(height),
+    })
+    lc = LightClient(mc, CHAIN, old_set.copy())
+    lc.advance(3)
+    assert lc.height == 3
+    assert [c.method for c in mc.calls][:2] == ["commit", "commit"]

@@ -1,0 +1,61 @@
+"""Honest TPU smoke tier (VERDICT r3 #8): every default suite run
+exercises the live accelerator when it is reachable, and a dead tunnel
+shows up as a SKIP with a reason in CI output — not only in bench JSON.
+
+The probe goes through the device daemon (tendermint_tpu/devd.py) at its
+PRODUCTION socket, so this test process never initializes jax against
+the tunnel (tests pin jax to CPU precisely because a wedged tunnel hangs
+any in-process dial). When the daemon holds the chip, the 64-lane batch
+below runs the production f32p kernel on real hardware — the coverage
+the hardware-gated parity test (tests/test_ops_f32.py) can't give CI.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tendermint_tpu import devd
+from tendermint_tpu.crypto import ed25519 as ed
+
+
+def _serving_daemon() -> tuple[devd.DevdClient, dict]:
+    client = devd.DevdClient(devd.DEFAULT_SOCK, connect_timeout=2.0, io_timeout=60.0)
+    try:
+        rep = client.ping(timeout=3.0)
+    except Exception as exc:  # noqa: BLE001 — reason goes in the skip
+        pytest.skip(
+            f"TPU smoke: no device daemon serving on {devd.DEFAULT_SOCK} "
+            f"({type(exc).__name__}) — start one with `python -m "
+            f"tendermint_tpu.devd`; tunnel state unknown"
+        )
+    if not rep.get("held"):
+        pytest.skip(
+            f"TPU smoke: daemon up (pid {rep.get('pid')}) but device not "
+            f"held — status {rep.get('status')!r} (tunnel down or still "
+            f"warming); uptime {rep.get('uptime_s')}s"
+        )
+    if rep.get("platform") not in ("tpu", "axon"):
+        pytest.skip(
+            f"TPU smoke: daemon serving platform {rep.get('platform')!r}, "
+            f"not real accelerator hardware"
+        )
+    return client, rep
+
+
+def test_live_accelerator_parity_64_lanes():
+    client, rep = _serving_daemon()
+    seed = b"\x2a" * 32
+    pub = ed.public_key(seed)
+    items = [
+        (pub, b"tpu-smoke-%d" % i, ed.sign(seed, b"tpu-smoke-%d" % i))
+        for i in range(64)
+    ]
+    items[7] = (items[7][0], items[7][1], b"\x66" * 64)  # forged
+    items[23] = (items[23][0], items[23][1] + b"!", items[23][2])  # tampered
+    before = rep["stats"].get("tpu_sigs", 0)
+    got = client.verify_batch(items)
+    want = [ed.verify(p, m, s) for p, m, s in items]
+    assert got == want, "device/CPU verdict mismatch on live hardware"
+    after = client.stats().get("tpu_sigs", 0)
+    assert after - before >= 64, "batch did not ride the device kernel"
+    client.close()
