@@ -71,11 +71,12 @@ def _signed_scenario() -> dict:
     cfg.root_dir = tempfile.mkdtemp(prefix="bench-mempool-sig-")
     app = SignedKVStoreApp(verify_in_app=False)
     verifier = Verifier(min_tpu_batch=32)
-    batcher = SigBatcher(verifier, parse_sig_tx, max_batch=4096, max_wait_s=0.004)
+    batcher = SigBatcher(verifier, parse_sig_tx, max_batch=4096, max_wait_s=0.02)
     mp = Mempool(cfg, AppConnMempool(LocalClient(app, threading.RLock())),
                  sig_batcher=batcher)
-    # warm the kernel bucket off the clock
-    verifier.verify_batch([parse_sig_tx(t) for t in txs[:64]])
+    # warm the kernel at the bucket the run will actually hit (batches
+    # are capped at the batcher's max_batch), off the clock
+    verifier.verify_batch([parse_sig_tx(t) for t in txs[:batcher.max_batch]])
     warm_stats = verifier.stats()
     t0 = time.perf_counter()
     for tx in txs:

@@ -158,7 +158,13 @@ def _device_loop(st: _DaemonState, *, accept_cpu: bool, probe_timeout: float,
             st.status = "claiming"
             from tendermint_tpu.ops import gateway
 
-            on_tpu = False if accept_cpu else gateway.on_tpu()
+            # decide from the probe's OWN answer — going through
+            # gateway.on_tpu() here would run a second redundant probe
+            # (this daemon's socket isn't "held" yet), and a slow second
+            # probe would mis-pin the daemon's jax to CPU while reporting
+            # a TPU platform
+            on_tpu = (not accept_cpu) and platform in ("tpu", "axon")
+            gateway._platform_cache["v"] = "cpu" if accept_cpu else platform
             # pin the direct kernel explicitly so the gateway default can
             # never route the daemon's own verifier back through devd
             os.environ["TENDERMINT_TPU_KERNEL"] = "f32p" if on_tpu else "f32"
@@ -429,6 +435,12 @@ class DevdClient:
 
 _avail_cache: dict = {"t": 0.0, "path": None, "rep": None}
 _AVAIL_TTL = 15.0
+
+
+def bust_avail_cache() -> None:
+    """Force the next available() to ping fresh — failure paths must not
+    trust a TTL-cached 'held' from a daemon that just died."""
+    _avail_cache["t"] = 0.0
 
 
 def available(timeout: float = 1.0) -> dict | None:

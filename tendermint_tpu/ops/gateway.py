@@ -76,17 +76,65 @@ KERNELS = {
 }
 
 
-def on_tpu() -> bool:
-    """Is the default jax backend real TPU hardware ("tpu", or "axon" for
-    a tunneled chip)? The ONE platform probe — the kernel default, the
-    pallas interpret-mode switch, and the TPU-gated tests all call this,
-    so a new platform string only needs adding here."""
-    try:
-        import jax
+_platform_cache: dict = {}
+_platform_lock = threading.Lock()
 
-        return jax.devices()[0].platform in ("tpu", "axon")
-    except Exception:
-        return False
+
+def resolve_platform() -> str | None:
+    """BOUNDED platform resolution, cached per process. jax.devices()
+    blocks FOREVER on a wedged accelerator tunnel, and even a bounded
+    in-process probe thread left hanging poisons jax's backend-init lock
+    (devd.subprocess_probe) — so no caller of the gateway may ever dial
+    in-process before knowing the tunnel answers. Order:
+
+    1. TENDERMINT_TPU_PLATFORM env override (tests pin "cpu");
+    2. TENDERMINT_TPU_DISABLE=1 -> "cpu";
+    3. a serving device daemon's platform (one socket ping);
+    4. ONE throwaway-subprocess probe (~45s worst case), cached for the
+       process lifetime. If it fails, this process's jax is pinned to
+       the CPU backend so even the CPU-path kernels can't dial the dead
+       tunnel, and None is returned."""
+    if "v" in _platform_cache:
+        return _platform_cache["v"]
+    with _platform_lock:
+        return _resolve_platform_locked()
+
+
+def _resolve_platform_locked() -> str | None:
+    if "v" in _platform_cache:  # a concurrent caller resolved while we waited
+        return _platform_cache["v"]
+    env = os.environ.get("TENDERMINT_TPU_PLATFORM", "")
+    if env:
+        _platform_cache["v"] = env
+        return env
+    if os.environ.get("TENDERMINT_TPU_DISABLE", "") == "1":
+        _platform_cache["v"] = "cpu"
+        return "cpu"
+    from tendermint_tpu import devd
+
+    rep = devd.available()
+    if rep is not None:
+        _platform_cache["v"] = rep.get("platform")
+        return _platform_cache["v"]
+    p = devd.subprocess_probe(45.0)
+    if p is None:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # noqa: BLE001 — backend may already be up
+            logger.warning("could not pin jax to cpu after failed probe")
+    _platform_cache["v"] = p
+    return p
+
+
+def on_tpu() -> bool:
+    """Is the reachable accelerator real TPU hardware ("tpu", or "axon"
+    for a tunneled chip)? The ONE platform check — the kernel default,
+    the pallas interpret-mode switch, and the TPU-gated tests all call
+    this, so a new platform string only needs adding here. Bounded: see
+    resolve_platform."""
+    return resolve_platform() in ("tpu", "axon")
 
 
 def kernel_name() -> str:
@@ -146,14 +194,32 @@ class Verifier:
     """Batch signature verifier with TPU acceleration and CPU fallback."""
 
     def __init__(self, min_tpu_batch: int = 32, use_tpu: bool | None = None):
+        kernel = None
         if use_tpu is None:
-            use_tpu = os.environ.get("TENDERMINT_TPU_DISABLE", "") == ""
+            if os.environ.get("TENDERMINT_TPU_DISABLE", "") == "1":
+                use_tpu = False
+            else:
+                # default policy: the kernel path needs an accelerator (a
+                # serving daemon or real hardware) or an explicit operator
+                # kernel choice — on a CPU-only host the f32 kernel is
+                # SLOWER than the native C++ batch verifier the CPU path
+                # runs (measured: ~5k vs ~10k sigs/s), so "no accelerator"
+                # must mean the native path, not a de-optimizing kernel
+                kernel = kernel_name()
+                use_tpu = (
+                    kernel == "devd"
+                    or bool(os.environ.get("TENDERMINT_TPU_KERNEL"))
+                    or on_tpu()
+                )
+        if kernel is None and use_tpu:
+            kernel = kernel_name()
         # kernel choice is resolved ONCE per verifier (a typo'd env var
         # fails at startup; a daemon appearing or dying mid-run cannot
         # flip the hot path under a live consensus node)
-        self._kernel = kernel_name() if use_tpu else None
+        self._kernel = kernel if use_tpu else None
         self.min_tpu_batch = min_tpu_batch
         self._tpu_ok = use_tpu
+        self._devd_fails = 0
         self._mtx = threading.Lock()
         self._stats = {"tpu_batches": 0, "tpu_sigs": 0, "cpu_sigs": 0}
         # verify-ahead results for the live vote path: consensus drains a
@@ -170,26 +236,43 @@ class Verifier:
         return importlib.import_module(KERNELS[self._kernel])
 
     def _demote_after_failure(self) -> None:
-        """A verify raised. If the devd daemon was the backend, fall back
-        to a DIRECT kernel when the device answers a bounded dial — a
-        dead daemon must not cost a healthy node its accelerator. Any
-        direct-kernel failure (or an unreachable device) latches the
+        """A verify raised. For the devd backend, re-ping the daemon
+        FRESH (never the TTL cache — it may predate the daemon's death):
+
+        - daemon alive and holding: transient failure — keep devd and let
+          the caller retry, up to 3 consecutive failures; a persistently
+          failing-but-alive daemon latches CPU (an in-process dial while
+          the daemon holds the chip would violate the one-owner rule);
+        - daemon dead: re-resolve the platform from scratch (bounded:
+          env, ping, subprocess probe) and take the direct kernel only if
+          an accelerator genuinely answers.
+
+        Any direct-kernel failure (or an unreachable device) latches the
         permanent CPU fallback, as before."""
         if self._kernel == "devd":
-            # probe in a throwaway subprocess: an in-process dial that
-            # hangs (wedged tunnel — likely why the daemon died) would
-            # hold jax's backend-init lock forever and poison every later
-            # jax call in this process (see devd.subprocess_probe)
             from tendermint_tpu import devd
 
-            platform = devd.subprocess_probe(15.0)
+            devd.bust_avail_cache()
+            if devd.available() is not None:
+                self._devd_fails += 1
+                if self._devd_fails < 3:
+                    logger.warning(
+                        "devd request failed but daemon is serving; retry "
+                        "%d/3", self._devd_fails,
+                    )
+                    return  # keep devd; the caller's retry re-dispatches
+                logger.warning("devd failing persistently while alive; CPU path")
+                self._tpu_ok = False
+                return
+            _platform_cache.pop("v", None)
+            platform = resolve_platform()
             if platform in ("tpu", "axon"):
                 self._kernel = "f32p"
-                logger.warning("devd unreachable; direct %s kernel", self._kernel)
+                logger.warning("devd dead; direct %s kernel", self._kernel)
                 return
             if platform is not None:
                 self._kernel = "f32"
-                logger.warning("devd unreachable; direct %s kernel", self._kernel)
+                logger.warning("devd dead; direct %s kernel", self._kernel)
                 return
         self._tpu_ok = False
 
@@ -223,6 +306,7 @@ class Verifier:
                 with self._mtx:
                     self._stats["tpu_batches"] += 1
                     self._stats["tpu_sigs"] += n
+                self._devd_fails = 0
                 return [bool(b) for b in out]
             except Exception:
                 logger.exception("batch verify via %s failed", self._kernel)
@@ -275,7 +359,9 @@ class Verifier:
                     # materialization: keep the sync path's fallback
                     # guarantee here too.
                     try:
-                        return [bool(b) for b in kernel_resolve()]
+                        res = [bool(b) for b in kernel_resolve()]
+                        self._devd_fails = 0
+                        return res
                     except Exception:
                         logger.exception(
                             "verify via %s failed at resolve", self._kernel

@@ -13,6 +13,10 @@ import os
 # TENDERMINT_DEVD_SOCK exported. test_devd.py points at its own socket
 # per-test with monkeypatch.
 os.environ["TENDERMINT_DEVD_SOCK"] = "/nonexistent/devd.sock"
+# Bounded platform resolution (ops/gateway.resolve_platform): tests are
+# CPU-only, so pin the answer rather than paying a 45s subprocess probe
+# per test process (the env override is consulted first).
+os.environ["TENDERMINT_TPU_PLATFORM"] = "cpu"
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

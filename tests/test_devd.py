@@ -130,36 +130,60 @@ def test_gateway_default_routes_through_daemon(daemon, monkeypatch):
     assert after - before == 8
 
 
-def test_daemon_death_demotes_to_direct_kernel(daemon, monkeypatch):
-    """A dead daemon must not cost the node its accelerator (or correct
-    results): the verifier demotes devd -> direct platform kernel, not
-    devd -> permanent CPU latch."""
+class _DeadClient:
+    def verify_batch(self, items):
+        raise ConnectionError("daemon transport died")
+
+    def verify_batch_async(self, items):
+        raise ConnectionError("daemon transport died")
+
+
+def test_transport_failure_with_live_daemon_latches_cpu(daemon, monkeypatch):
+    """Requests failing while the daemon still serves: keep devd for a
+    bounded retry window, then latch CPU — never dial the chip the live
+    daemon exclusively holds."""
     sock, _ = daemon
     monkeypatch.setenv("TENDERMINT_DEVD_SOCK", sock)
     monkeypatch.delenv("TENDERMINT_TPU_KERNEL", raising=False)
-    devd._avail_cache.update(t=0.0)
+    devd.bust_avail_cache()
     import tendermint_tpu.ops.devd_backend as backend
     from tendermint_tpu.ops import gateway
 
     v = gateway.Verifier(min_tpu_batch=1)
     assert v._kernel == "devd"
-
-    class Dead:
-        def verify_batch(self, items):
-            raise ConnectionError("daemon died")
-
-        def verify_batch_async(self, items):
-            raise ConnectionError("daemon died")
-
-    monkeypatch.setattr(backend, "_client", Dead())
+    monkeypatch.setattr(backend, "_client", _DeadClient())
     items = _items(4, tag=b"demote")
     items[1] = (items[1][0], items[1][1], b"\x99" * 64)
+    # correct results throughout (retries then the CPU fallback)
     assert v.verify_batch(items) == [True, False, True, True]
-    assert v._kernel in ("f32", "f32p"), v._kernel  # direct, not CPU-latched
-    assert v._tpu_ok
-    # and the async contract survives the same failure
+    assert v._kernel == "devd"  # never stole the daemon's device
+    assert not v._tpu_ok  # persistent transport failure -> CPU latch
     resolve = v.verify_batch_async(items)
     assert resolve() == [True, False, True, True]
+
+
+def test_daemon_death_demotes_to_direct_kernel(daemon, monkeypatch):
+    """The daemon actually gone: demote devd -> direct platform kernel
+    (f32 on this CPU host), not a permanent CPU latch."""
+    sock, _ = daemon
+    monkeypatch.setenv("TENDERMINT_DEVD_SOCK", sock)
+    monkeypatch.delenv("TENDERMINT_TPU_KERNEL", raising=False)
+    devd.bust_avail_cache()
+    import tendermint_tpu.ops.devd_backend as backend
+    from tendermint_tpu.ops import gateway
+
+    v = gateway.Verifier(min_tpu_batch=1)
+    assert v._kernel == "devd"
+    # simulate death: transport raises AND the fresh re-ping finds nothing
+    monkeypatch.setattr(backend, "_client", _DeadClient())
+    monkeypatch.setattr(devd, "available", lambda *a, **k: None)
+    items = _items(4, tag=b"demote2")
+    items[2] = (items[2][0], items[2][1], b"\x77" * 64)
+    assert v.verify_batch(items) == [True, True, False, True]
+    assert v._kernel == "f32", v._kernel  # direct, not CPU-latched
+    assert v._tpu_ok
+    resolve = v.verify_batch_async(items)
+    assert resolve() == [True, True, False, True]
 
 
 def test_second_daemon_refuses_live_socket(daemon):

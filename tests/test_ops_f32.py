@@ -238,7 +238,7 @@ class TestGatewayAsync:
     def test_async_matches_sync_and_order(self):
         from tendermint_tpu.ops.gateway import Verifier
 
-        v = Verifier(min_tpu_batch=4)
+        v = Verifier(min_tpu_batch=4, use_tpu=True)
         batches = []
         for salt in range(3):
             seeds = [bytes([salt * 8 + i + 1]) * 32 for i in range(6)]
@@ -257,7 +257,7 @@ class TestGatewayAsync:
     def test_async_below_threshold_resolves_cpu(self):
         from tendermint_tpu.ops.gateway import Verifier
 
-        v = Verifier(min_tpu_batch=64)
+        v = Verifier(min_tpu_batch=64, use_tpu=True)
         seed = b"\x51" * 32
         items = [(ed.public_key(seed), b"small", ed.sign(seed, b"small"))]
         resolve = v.verify_batch_async(items)
@@ -276,7 +276,7 @@ class TestGatewayAsync:
             def __getitem__(self, k):
                 raise RuntimeError("device lost")
 
-        v = gw.Verifier(min_tpu_batch=1)
+        v = gw.Verifier(min_tpu_batch=1, use_tpu=True)
         seed = b"\x52" * 32
         items = [(ed.public_key(seed), b"m%d" % i, ed.sign(seed, b"m%d" % i)) for i in range(4)]
         monkeypatch.setattr(f32, "_verify_jit", lambda *a: Boom())
@@ -327,7 +327,7 @@ class TestKernelRegistry:
         """Backends without verify_batch_async still honor the async API."""
         from tendermint_tpu.ops import gateway as gw
 
-        v = gw.Verifier(min_tpu_batch=1)
+        v = gw.Verifier(min_tpu_batch=1, use_tpu=True)
         seed = b"\x53" * 32
         items = [
             (ed.public_key(seed), b"s%d" % i, ed.sign(seed, b"s%d" % i))
