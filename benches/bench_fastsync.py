@@ -146,7 +146,10 @@ def main() -> None:
     # marshal); resolve_wait_s is time blocked on the device; part_hash_s
     # is host hashing. The residual bottleneck is whichever dominates —
     # recorded so the next optimization is measured, not guessed
-    # (VERDICT r3 weak #6).
+    # (VERDICT r3 weak #6). NOTE: when the gateway is on its CPU fallback
+    # (no accelerator), verification itself runs synchronously inside the
+    # "dispatch" stage — only an accelerator run separates dispatch from
+    # device wait.
     stages_best["other_s"] = round(tpu_s - sum(stages_best.values()), 3)
 
     total_sigs = N_VALS * N_BLOCKS
