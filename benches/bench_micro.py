@@ -1,0 +1,133 @@
+"""Codec / primitive micro-benchmarks (reference: benchmarks/codec_test.go,
+chan_test.go, map_test.go, os_test.go, atomic_test.go).
+
+The reference's micro set times its hot primitives: status/NodeInfo
+encoding over the wire codec, map churn with address-like string keys,
+channel make/close, and small appending file writes. Same shapes here
+against OUR primitives — the JSON-RPC status payload, the binary codec
+(codec/binary.py), canonical JSON sign-bytes, NodeInfo JSON, dict churn,
+queue.Queue make/close (the CList/queue analogue), and autofile group
+writes — so codec or runtime regressions show up as numbers, not
+anecdotes.
+
+Prints ONE JSON line like the other benches. Run from the repo root.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N = int(os.environ.get("BENCH_MICRO_N", "20000"))
+
+
+def _rate(fn, n=N) -> float:
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return n / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    from tendermint_tpu.codec.binary import Encoder
+    from tendermint_tpu.codec.canonical import canonical_dumps
+    from tendermint_tpu.crypto.keys import gen_priv_key_ed25519
+    from tendermint_tpu.libs.autofile import Group
+    from tendermint_tpu.p2p.node_info import NodeInfo, default_version
+
+    pv = gen_priv_key_ed25519(b"\x11" * 32)
+    info = NodeInfo(
+        pub_key=pv.pub_key(),
+        moniker="micro-bench",
+        network="bench-chain",
+        version=default_version("bench"),
+        listen_addr="127.0.0.1:46656",
+    )
+
+    # status payload a node serves per /status call (codec_test.go:14-38)
+    status = {
+        "node_info": info.to_json(),
+        "latest_block_height": 123456,
+        "latest_block_hash": "ab" * 20,
+        "latest_app_hash": "cd" * 20,
+        "latest_block_time": 1_700_000_000_000,
+    }
+
+    def enc_status_json():
+        json.dumps(status, sort_keys=True)
+
+    def enc_node_info_json():
+        json.dumps(info.to_json(), sort_keys=True)
+
+    def enc_node_info_binary():
+        e = Encoder()
+        e.write_string(info.moniker)
+        e.write_string(info.network)
+        e.write_bytes(info.pub_key.raw)
+        e.write_string(info.listen_addr or "")
+        e.buf()
+
+    vote_canonical = {
+        "chain_id": "bench-chain",
+        "vote": {"block_id": {}, "height": 1, "round": 0, "type": 2},
+    }
+
+    def enc_canonical_sign_bytes():
+        canonical_dumps(vote_canonical)
+
+    # map churn with hex-address keys (map_test.go)
+    addrs = [("%040x" % i) for i in range(256)]
+
+    def map_churn():
+        m: dict = {}
+        for a in addrs:
+            m[a] = 1
+        for a in addrs:
+            m[a]
+
+    # queue make/close — the Go chan make/close analogue (chan_test.go)
+    def queue_make():
+        queue.Queue(maxsize=1)
+
+    results = {
+        "encode_status_json_per_sec": round(_rate(enc_status_json), 1),
+        "encode_node_info_json_per_sec": round(_rate(enc_node_info_json), 1),
+        "encode_node_info_binary_per_sec": round(_rate(enc_node_info_binary), 1),
+        "encode_canonical_vote_per_sec": round(_rate(enc_canonical_sign_bytes), 1),
+        "map_churn_256_per_sec": round(_rate(map_churn, n=2000), 1),
+        "queue_make_per_sec": round(_rate(queue_make), 1),
+    }
+
+    # small appending writes through the tx-WAL file group (os_test.go)
+    d = tempfile.mkdtemp(prefix="bench-micro-")
+    g = Group(os.path.join(d, "wal"))
+    line = "ab" * 32
+
+    def wal_write():
+        g.write_line(line)
+
+    results["wal_write_per_sec"] = round(_rate(wal_write, n=5000), 1)
+    g.flush()
+    g.close()
+
+    print(
+        json.dumps(
+            {
+                "metric": "micro_encode_status_per_sec",
+                "value": results["encode_status_json_per_sec"],
+                "unit": "ops/s",
+                "vs_baseline": 1.0,  # host-path micro set: no reference numbers
+                "detail": results,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())
