@@ -40,7 +40,24 @@ def main() -> int:
     # device-dialing sub-bench for its full 30-min timeout (jitcache.probe_device
     # docstring has the failure mode)
     env = dict(os.environ)
-    if env.get("TENDERMINT_TPU_DISABLE", "") != "1":
+    need_direct_probe = env.get("TENDERMINT_TPU_DISABLE", "") != "1"
+    if need_direct_probe:
+        # a serving device daemon changes the topology: IT holds the chip
+        # and every sub-bench routes over IPC (the gateway auto-selects
+        # the devd backend), so probing the device directly would contend
+        # with the daemon's exclusive session — skip straight to running
+        sys.path.insert(0, ROOT)
+        from tendermint_tpu import devd
+
+        rep = devd.available(timeout=3.0)
+        if rep is not None and rep.get("platform") in ("tpu", "axon"):
+            results["device"] = (
+                f"devd daemon ({rep.get('platform')}, pid {rep.get('pid')})"
+            )
+            print(f"run_all: {results['device']}; benches ride the daemon",
+                  file=sys.stderr)
+            need_direct_probe = False
+    if need_direct_probe:
         # probe in a THROWAWAY subprocess: probing in-process would
         # initialize this parent's jax backend and hold the exclusive
         # device, starving every sub-bench (each bench is its own
