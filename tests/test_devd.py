@@ -186,6 +186,86 @@ def test_daemon_death_demotes_to_direct_kernel(daemon, monkeypatch):
     assert resolve() == [True, True, False, True]
 
 
+def test_fast_sync_rides_the_daemon(daemon, monkeypatch):
+    """End to end, the production topology in miniature: a fast-syncing
+    node's commit-signature batches — including concurrent speculative
+    dispatches — route over IPC to the device daemon; the synced chain is
+    byte-identical and the node process did no kernel work itself."""
+    sock, client = daemon
+    monkeypatch.setenv("TENDERMINT_DEVD_SOCK", sock)
+    monkeypatch.delenv("TENDERMINT_TPU_KERNEL", raising=False)
+    devd.bust_avail_cache()
+    from tendermint_tpu.blockchain.reactor import BlockchainReactor
+    from tendermint_tpu.consensus.reactor import ConsensusReactor
+    from tendermint_tpu.ops import gateway
+    from tendermint_tpu.p2p import Switch, connect2_switches
+    from tendermint_tpu.p2p.node_info import NodeInfo, default_version
+    from tests.test_reactors import (
+        TEST_CHAIN_ID,
+        make_genesis,
+        make_node,
+        stop_net,
+        wait_until,
+    )
+
+    verifier = gateway.Verifier(min_tpu_batch=1)
+    assert verifier._kernel == "devd"
+    daemon_sigs_before = client.stats().get("tpu_sigs", 0) + client.stats().get(
+        "cpu_sigs", 0
+    )
+
+    doc, pvs = make_genesis(1)
+    node_a = make_node(doc, pvs[0])
+    node_b = make_node(doc, None)
+
+    def init(i, sw):
+        node = (node_a, node_b)[i]
+        fast_sync = i == 1
+        con_r = ConsensusReactor(node.cs, fast_sync=fast_sync)
+        con_r.set_event_switch(node.evsw)
+        sw.add_reactor("CONSENSUS", con_r)
+        sw.add_reactor("BLOCKCHAIN", BlockchainReactor(
+            node.state.copy(),
+            node.cs.proxy_app_conn,
+            node.store,
+            fast_sync=fast_sync,
+            batch_verifier=verifier.commit_batch_verifier() if fast_sync else None,
+            async_batch_verifier=verifier.verify_batch_async if fast_sync else None,
+            status_update_interval=0.5,
+        ))
+        sw.set_node_info(NodeInfo(
+            pub_key=sw.node_priv_key.pub_key(),
+            moniker=f"devd-node{i}",
+            network=TEST_CHAIN_ID,
+            version=default_version("test"),
+        ))
+        return sw
+
+    switches = [init(i, Switch()) for i in range(2)]
+    for sw in switches:
+        sw.start()
+    try:
+        assert wait_until(lambda: node_a.store.height() >= 3, timeout=120)
+        node_a.cs.stop()
+        target = node_a.store.height()
+        connect2_switches(switches, 0, 1)
+        assert wait_until(
+            lambda: node_b.store.height() >= target, timeout=120
+        ), f"B at {node_b.store.height()}, A at {target}"
+        for h in range(1, target + 1):
+            assert node_b.store.load_block(h).hash() == node_a.store.load_block(h).hash()
+        # the signature work landed in the DAEMON, and the node-side
+        # verifier recorded those batches as accelerated (devd)
+        vstats = verifier.stats()
+        assert vstats["tpu_sigs"] > 0 and vstats["tpu_batches"] > 0, vstats
+        daemon_sigs_after = client.stats().get("tpu_sigs", 0) + client.stats().get(
+            "cpu_sigs", 0
+        )
+        assert daemon_sigs_after - daemon_sigs_before >= vstats["tpu_sigs"]
+    finally:
+        stop_net([node_a, node_b], switches)
+
+
 def test_second_daemon_refuses_live_socket(daemon):
     sock, _ = daemon
     env = {
