@@ -58,27 +58,11 @@ def main() -> int:
                   file=sys.stderr)
             need_direct_probe = False
     if need_direct_probe:
-        # probe in a THROWAWAY subprocess: probing in-process would
-        # initialize this parent's jax backend and hold the exclusive
-        # device, starving every sub-bench (each bench is its own
-        # process precisely because the TPU is exclusive per process)
-        try:
-            probe_rc = subprocess.run(
-                [
-                    sys.executable,
-                    "-c",
-                    "import sys; sys.path.insert(0, %r); "
-                    "from tendermint_tpu.jitcache import probe_device; "
-                    "sys.exit(0 if probe_device() else 3)" % ROOT,
-                ],
-                cwd=ROOT,
-                timeout=180,
-            ).returncode
-        except subprocess.TimeoutExpired:
-            # the child found the device dead but jax's atexit teardown
-            # hung on it — exactly the wedge the probe exists to detect
-            probe_rc = 3
-        if probe_rc != 0:
+        # throwaway-subprocess probe (devd.subprocess_probe): probing
+        # in-process would initialize this parent's jax backend and hold
+        # the exclusive device, starving every sub-bench (each bench is
+        # its own process precisely because the TPU is exclusive then)
+        if devd.subprocess_probe(90.0) is None:
             print(
                 "run_all: accelerator unreachable; all benches measure "
                 "the CPU fallback",
@@ -112,6 +96,20 @@ def main() -> int:
         results[name] = json.loads(line)
         print(f"   {line} ({time.time()-t0:.0f}s)", file=sys.stderr)
     out = os.path.join(ROOT, "BENCHES.json")
+    if results.get("device", "").startswith("unreachable"):
+        # never clobber a recorded accelerator run with a CPU fallback:
+        # BENCHES.json is the standing TPU record (round-3 postmortem —
+        # a fallback that overwrites the record reads as a regression)
+        try:
+            with open(out) as f:
+                prior = json.load(f)
+        except (OSError, ValueError):
+            prior = {}
+        if any(
+            isinstance(v, dict) and "tpu" in str(v.get("detail", {}).get("platform", ""))
+            for v in prior.values()
+        ):
+            out = os.path.join(ROOT, "BENCHES.cpu-fallback.json")
     with open(out, "w") as f:
         json.dump(results, f, indent=2)
         f.write("\n")

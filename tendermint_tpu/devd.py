@@ -164,7 +164,7 @@ def _device_loop(st: _DaemonState, *, accept_cpu: bool, probe_timeout: float,
             # probe would mis-pin the daemon's jax to CPU while reporting
             # a TPU platform
             on_tpu = (not accept_cpu) and platform in ("tpu", "axon")
-            gateway._platform_cache["v"] = "cpu" if accept_cpu else platform
+            gateway.set_platform("cpu" if accept_cpu else platform)
             # pin the direct kernel explicitly so the gateway default can
             # never route the daemon's own verifier back through devd
             os.environ["TENDERMINT_TPU_KERNEL"] = "f32p" if on_tpu else "f32"
@@ -208,10 +208,13 @@ def _handle_conn(conn: socket.socket, st: _DaemonState) -> None:
             except (ConnectionError, EOFError):
                 return
             op = req.get("op")
+
+            def held_stats() -> dict:
+                with st.lock:
+                    return st.verifier.stats() if st.verifier else {}
+
             try:
                 if op == "ping":
-                    with st.lock:
-                        stats = st.verifier.stats() if st.verifier else {}
                     _send_frame(conn, {
                         "ok": True,
                         "platform": st.platform,
@@ -219,7 +222,7 @@ def _handle_conn(conn: socket.socket, st: _DaemonState) -> None:
                         "status": st.status,
                         "warmed": list(st.warmed),
                         "uptime_s": round(time.time() - st.started, 1),
-                        "stats": stats,
+                        "stats": held_stats(),
                         "pid": os.getpid(),
                     })
                 elif op == "verify":
@@ -233,9 +236,7 @@ def _handle_conn(conn: socket.socket, st: _DaemonState) -> None:
                         oks = v.verify_batch(req["items"])
                         _send_frame(conn, {"ok": True, "results": [bool(b) for b in oks]})
                 elif op == "stats":
-                    with st.lock:
-                        stats = st.verifier.stats() if st.verifier else {}
-                    _send_frame(conn, {"ok": True, "stats": stats})
+                    _send_frame(conn, {"ok": True, "stats": held_stats()})
                 elif op == "shutdown":
                     _send_frame(conn, {"ok": True})
                     st.stop.set()
@@ -289,7 +290,6 @@ def serve(path: str | None = None) -> None:
         probe.settimeout(1.0)
         try:
             probe.connect(path)
-            probe.close()
             raise SystemExit(f"devd already serving on {path}")
         except (ConnectionRefusedError, socket.timeout, FileNotFoundError):
             os.unlink(path)  # stale socket from a dead daemon

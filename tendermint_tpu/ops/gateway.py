@@ -128,6 +128,13 @@ def _resolve_platform_locked() -> str | None:
     return p
 
 
+def set_platform(platform: str | None) -> None:
+    """Pin resolve_platform's answer for this process — for callers that
+    already KNOW (the device daemon just probed; a test harness is CPU by
+    construction) and must not pay or confuse a second resolution."""
+    _platform_cache["v"] = platform
+
+
 def on_tpu() -> bool:
     """Is the reachable accelerator real TPU hardware ("tpu", or "axon"
     for a tunneled chip)? The ONE platform check — the kernel default,
