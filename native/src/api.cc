@@ -114,6 +114,15 @@ void tm_ed25519_verify_batch(const uint8_t* pubs, const uint8_t* sigs,
         sigs + 64 * i);
 }
 
+// random-linear-combination batch verification: 1 iff ALL n signatures
+// verify (strict semantics, 2^-128 soundness); 0 -> caller falls back to
+// tm_ed25519_verify_batch for per-lane verdicts.
+int tm_ed25519_verify_batch_rlc(const uint8_t* pubs, const uint8_t* sigs,
+                                const uint8_t* msgs, const uint64_t* offsets,
+                                int64_t n) {
+  return ed25519_verify_batch_rlc(pubs, sigs, msgs, offsets, n);
+}
+
 // batch h = SHA512(R || A || M) mod L for the TPU-kernel marshal
 // (the per-item host cost the Python loop can't vectorize; one FFI call
 // per batch, no per-item overhead). sigs n*64 (R = first 32 bytes),

@@ -26,4 +26,14 @@ int ed25519_decompress(const uint8_t pub[32], uint8_t x_out[32],
 void ed25519_hram(const uint8_t r[32], const uint8_t pub[32],
                   const uint8_t* msg, uint64_t msg_len, uint8_t h_out[32]);
 
+// Random-linear-combination batch verification (one Pippenger MSM over
+// 2n+1 points). Returns 1 iff EVERY signature in the batch verifies
+// under the same strict semantics as ed25519_verify, up to the standard
+// 2^-128 soundness bound of the z-weighted combined equation; 0 means
+// "at least one bad or undecided" — callers fall back to the per-item
+// loop for exact lane verdicts.
+int ed25519_verify_batch_rlc(const uint8_t* pubs, const uint8_t* sigs,
+                             const uint8_t* msgs, const uint64_t* offsets,
+                             int64_t n);
+
 }  // namespace tm

@@ -56,9 +56,19 @@ def probe_device(timeout_s: float = 90.0) -> str | None:
     return out[0] if out else None
 
 
-def platform_label(probe_timeout: float = 30.0) -> str:
-    """Backend platform name for bench output, WITHOUT risking a hang; an
-    explicit TENDERMINT_TPU_DISABLE skips the dial entirely."""
+def platform_label() -> str:
+    """Backend platform name for bench output, WITHOUT risking a hang or
+    contending with a device daemon that holds the chip: an explicit
+    TENDERMINT_TPU_DISABLE skips everything, a serving daemon answers
+    from its ping, and otherwise the gateway's bounded resolution runs
+    (one cached subprocess probe)."""
     if os.environ.get("TENDERMINT_TPU_DISABLE", "") == "1":
         return "cpu (TENDERMINT_TPU_DISABLE)"
-    return probe_device(probe_timeout) or "unknown (device unreachable)"
+    from tendermint_tpu import devd
+
+    rep = devd.available()
+    if rep is not None:
+        return f"{rep.get('platform')} (via devd)"
+    from tendermint_tpu.ops.gateway import resolve_platform
+
+    return resolve_platform() or "unknown (device unreachable)"

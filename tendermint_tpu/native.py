@@ -81,6 +81,8 @@ def get_lib():
         lib.tm_merkle_leaf_hashes.argtypes = [u8p, u64p, i64, u8p]
         lib.tm_merkle_root.argtypes = [u8p, i64, u8p]
         lib.tm_ed25519_verify_batch.argtypes = [u8p, u8p, u8p, u64p, i64, u8p]
+        lib.tm_ed25519_verify_batch_rlc.argtypes = [u8p, u8p, u8p, u64p, i64]
+        lib.tm_ed25519_verify_batch_rlc.restype = ctypes.c_int
         lib.tm_ed25519_hram_batch.argtypes = [u8p, u8p, u8p, u64p, i64, u8p]
         lib.tm_ed25519_decompress_batch.argtypes = [u8p, i64, u8p, u8p]
         _lib = lib
@@ -177,8 +179,21 @@ def merkle_root(items: list[bytes]) -> bytes:
     return merkle_root_from_leaf_digests(merkle_leaf_hashes(items))
 
 
+RLC_MIN_BATCH = 32  # below this the MSM's fixed costs beat its savings
+
+
 def ed25519_verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> list[bool]:
-    """(pubkey32, msg, sig64) triples -> per-item validity."""
+    """(pubkey32, msg, sig64) triples -> per-item validity.
+
+    Wide all-well-formed batches first try random-linear-combination
+    batch verification (ONE Pippenger multi-scalar multiplication for
+    the whole batch — tm_ed25519_verify_batch_rlc, ~3-4x the per-item
+    loop): an accepting combined equation proves every lane valid up to
+    the standard 2^-128 soundness bound. Any rejection (or any
+    malformed lane) falls back to the exact per-item loop, so per-lane
+    verdicts and adversarial-input semantics are byte-for-byte those of
+    crypto/ed25519.verify; an all-forged flood just pays ~1.3x the
+    per-item cost."""
     lib = get_lib()
     n = len(items)
     pubs = np.zeros(n * 32, dtype=np.uint8)
@@ -194,10 +209,15 @@ def ed25519_verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> list[bool]:
         sigs[64 * i : 64 * i + 64] = np.frombuffer(sig, dtype=np.uint8)
         msgs.append(bytes(msg))
     data, offsets = _concat(msgs)
+    off_p = offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+    if n >= RLC_MIN_BATCH and ok_shape.all():
+        if lib.tm_ed25519_verify_batch_rlc(
+            _as_u8p(pubs), _as_u8p(sigs), _as_u8p(data), off_p, n
+        ):
+            return [True] * n
     out = np.zeros(n, dtype=np.uint8)
     lib.tm_ed25519_verify_batch(
-        _as_u8p(pubs), _as_u8p(sigs), _as_u8p(data),
-        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n, _as_u8p(out),
+        _as_u8p(pubs), _as_u8p(sigs), _as_u8p(data), off_p, n, _as_u8p(out),
     )
     return [bool(o and s) for o, s in zip(out, ok_shape)]
 

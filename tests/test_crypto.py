@@ -400,3 +400,96 @@ class TestSecp256k1:
             "test-chain", block_id, 5, commit, batch_verifier=v.commit_batch_verifier()
         )  # no raise
         assert v.stats()["cpu_sigs"] >= 1  # the secp lane went to CPU
+
+
+class TestNativeRLCBatchVerify:
+    """Random-linear-combination batch verification (native/src/ed25519.cc
+    ed25519_verify_batch_rlc): the combined-equation fast path must be
+    indistinguishable from the strict per-item loop on every adversarial
+    shape — any divergence is a consensus-safety bug."""
+
+    @staticmethod
+    def _items(n, mutate=None):
+        from tendermint_tpu.crypto import ed25519 as ed
+
+        seeds = [bytes([i % 48 + 1]) * 32 for i in range(n)]
+        items = []
+        for i, s in enumerate(seeds):
+            msg = b"rlc-t-%d" % i
+            items.append((ed.public_key(s), msg, ed.sign(s, msg)))
+        if mutate:
+            items = mutate(items)
+        return items
+
+    def _check_parity(self, items):
+        from tendermint_tpu import native
+        from tendermint_tpu.crypto import ed25519 as ed
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        got = native.ed25519_verify_batch(items)
+        want = [
+            len(p) == 32 and len(s) == 64 and ed.verify(p, m, s)
+            for p, m, s in items
+        ]
+        assert got == want
+        return got
+
+    def test_all_valid_wide_batch(self):
+        out = self._check_parity(self._items(128))
+        assert out == [True] * 128
+
+    def test_every_adversarial_lane_shape(self):
+        from tendermint_tpu.crypto import ed25519 as ed
+
+        def mutate(items):
+            P = 2**255 - 19
+            p0, m0, s0 = items[0]
+            items[1] = (p0, m0 + b"!", items[1][2])          # wrong msg
+            items[2] = (items[3][0], m0, s0)                 # wrong pub
+            sig = items[4][2]
+            items[4] = (items[4][0], items[4][1],
+                        sig[:10] + bytes([sig[10] ^ 1]) + sig[11:])  # tampered
+            # s >= L: s' = s + L verifies mod L — the strict check (and
+            # the RLC pre-check) must reject it
+            p5, m5, s5 = items[5]
+            s_plus_l = (int.from_bytes(s5[32:], "little") + ed.L).to_bytes(32, "little")
+            items[5] = (p5, m5, s5[:32] + s_plus_l)
+            # non-canonical R.y >= p
+            p6, m6, s6 = items[6]
+            items[6] = (p6, m6, (P + 1).to_bytes(32, "little") + s6[32:])
+            # invalid A point
+            items[7] = (b"\x01" * 32, items[7][1], items[7][2])
+            return items
+
+        out = self._check_parity(self._items(64, mutate))
+        # lanes 1,2,4,5,6,7 mutated bad; 0,3 and the rest stay valid
+        assert out == [
+            i not in (1, 2, 4, 5, 6, 7) for i in range(64)
+        ]
+
+    def test_rfc8032_vectors_through_the_batch(self):
+        from tests.test_ops_f32 import RFC8032_VECTORS
+
+        base = self._items(40)
+        for _sk, pk, msg, sig in RFC8032_VECTORS:
+            base.append((bytes.fromhex(pk), bytes.fromhex(msg), bytes.fromhex(sig)))
+        out = self._check_parity(base)
+        assert all(out)
+
+    def test_repeated_and_distinct_keys(self):
+        # one signer for the whole batch (max A-cache hits) and all
+        # distinct signers (no hits) must both verify
+        from tendermint_tpu.crypto import ed25519 as ed
+
+        seed = b"\x51" * 32
+        pub = ed.public_key(seed)
+        same = [(pub, b"m%d" % i, ed.sign(seed, b"m%d" % i)) for i in range(64)]
+        assert self._check_parity(same) == [True] * 64
+        distinct = self._items(64)
+        assert self._check_parity(distinct) == [True] * 64
+
+    def test_small_batches_take_the_exact_path(self):
+        # below RLC_MIN_BATCH nothing changes at all
+        out = self._check_parity(self._items(8))
+        assert out == [True] * 8
