@@ -640,7 +640,19 @@ struct NegACache {
 void msm(ge* out, const std::vector<std::array<uint8_t, 32>>& scalars,
          const std::vector<ge>& pts) {
   size_t m = pts.size();
-  int c = m < 64 ? 5 : m < 512 ? 8 : m < 4096 ? 11 : 13;
+  // choose the window by minimizing the actual addition count:
+  // ceil(256/c) windows, each costing m point-bucket adds plus
+  // 2*(2^c - 1) aggregation adds
+  int c = 4;
+  double best_cost = 1e30;
+  for (int cand = 4; cand <= 16; cand++) {
+    double cost =
+        ((256 + cand - 1) / cand) * ((double)m + 2.0 * ((1u << cand) - 1));
+    if (cost < best_cost) {
+      best_cost = cost;
+      c = cand;
+    }
+  }
   int nwin = (256 + c - 1) / c;
   size_t nb = ((size_t)1 << c) - 1;
   std::vector<ge> buckets(nb);
