@@ -1,0 +1,143 @@
+"""RPC/WebSocket load generator against a LIVE node (reference:
+benchmarks/simu/counter.go — a WS client firing broadcast_tx frames at a
+running node and draining the response stream).
+
+Boots a real `tendermint_tpu.cli node` process (kvstore, ephemeral home),
+opens the /websocket endpoint, streams BENCH_RPC_TXS broadcast_tx_async
+frames while a drain thread counts acceptances, and measures:
+- accepted tx/s through the full RPC + mempool ingress path,
+- block/commit progress while under load (the node must keep committing).
+
+Prints ONE JSON line like the other benches. Run from the repo root.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_TXS = int(os.environ.get("BENCH_RPC_TXS", "5000"))
+RPC_PORT = int(os.environ.get("BENCH_RPC_PORT", "47321"))
+
+
+def _status(port: int) -> dict | None:
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/",
+            data=json.dumps({"method": "status", "params": {}, "id": 1}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=2) as r:
+            return json.loads(r.read().decode())["result"]
+    except Exception:  # noqa: BLE001 — node not up yet
+        return None
+
+
+def main() -> int:
+    home = tempfile.mkdtemp(prefix="bench-rpc-")
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "TENDERMINT_TPU_PLATFORM": os.environ.get("TENDERMINT_TPU_PLATFORM", "cpu"),
+    }
+    subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cli", "--home", home,
+         "init", "--chain-id", "rpc-load"],
+        check=True, capture_output=True, env=env,
+    )
+    node = subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.cli", "--home", home, "node",
+         "--proxy_app", "kvstore",
+         "--rpc.laddr", f"tcp://127.0.0.1:{RPC_PORT}",
+         "--p2p.laddr", "tcp://127.0.0.1:0", "--log_level", "error"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        deadline = time.time() + 60
+        st = None
+        while time.time() < deadline:
+            st = _status(RPC_PORT)
+            if st and int(st["latest_block_height"]) >= 1:
+                break
+            time.sleep(0.5)
+        assert st, "node never served /status"
+        start_height = int(st["latest_block_height"])
+
+        from tendermint_tpu.rpc.client import WSClient
+
+        ws = WSClient(f"127.0.0.1:{RPC_PORT}")
+        accepted = {"n": 0, "err": 0}
+        done = threading.Event()
+
+        def drain():
+            while accepted["n"] + accepted["err"] < N_TXS:
+                try:
+                    msg = ws.responses.get(timeout=30)
+                except Exception:  # noqa: BLE001 — stalled stream ends the bench
+                    break
+                if msg.get("error"):
+                    accepted["err"] += 1
+                else:
+                    accepted["n"] += 1
+            done.set()
+
+        th = threading.Thread(target=drain, daemon=True)
+        th.start()
+
+        t0 = time.perf_counter()
+        for i in range(N_TXS):
+            tx = b"load-%06d=v" % i
+            ws._send_frame(0x1, json.dumps({
+                "jsonrpc": "2.0", "id": i + 1,
+                "method": "broadcast_tx_async", "params": {"tx": tx.hex()},
+            }).encode())
+        assert done.wait(300), "response drain stalled"
+        elapsed = time.perf_counter() - t0
+        # liveness: the flooded txs must land in blocks — on a 1-core box
+        # the burst can starve consensus DURING the load window, so allow
+        # a post-load commit window before judging
+        commit_deadline = time.time() + 60
+        blocks = 0
+        while time.time() < commit_deadline:
+            end_st = _status(RPC_PORT)
+            if end_st:
+                blocks = int(end_st["latest_block_height"]) - start_height
+                if blocks > 0:
+                    break
+            time.sleep(1.0)
+        ws.close()
+
+        assert accepted["err"] == 0, f"{accepted['err']} tx rejected"
+        assert blocks > 0, "node stopped committing under RPC load"
+        print(json.dumps({
+            "metric": "rpc_ws_broadcast_tx_per_sec",
+            "value": round(N_TXS / elapsed, 1),
+            "unit": "txs/s",
+            "vs_baseline": 1.0,  # host-path bench: no reference numbers exist
+            "detail": {
+                "txs": N_TXS,
+                "elapsed_s": round(elapsed, 3),
+                "blocks_committed_during_load": blocks,
+                "transport": "websocket (RFC6455, JSON-RPC frames)",
+                "app": "kvstore(local)",
+            },
+        }))
+        return 0
+    finally:
+        node.terminate()
+        try:
+            node.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            node.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
