@@ -89,6 +89,31 @@ def main() -> None:
 
         explicit_kernel = os.environ.get("TENDERMINT_TPU_KERNEL", "")
         daemon = devd.available(timeout=3.0)
+        if daemon is None:
+            # a daemon mid-claim/warm holds the chip already — dialing it
+            # directly now would time out and publish a stale CPU number
+            # minutes before the daemon starts serving. Wait it out.
+            wait_s = float(os.environ.get("BENCH_DEVD_WAIT_S", "900"))
+            deadline = time.time() + wait_s
+            try:
+                client = devd.DevdClient(devd.sock_path())
+                while time.time() < deadline:
+                    rep = client.ping(timeout=3.0)
+                    if rep.get("held"):
+                        devd.bust_avail_cache()
+                        daemon = devd.available(timeout=3.0)
+                        break
+                    if rep.get("status") == "waiting-for-device":
+                        break  # tunnel is down for the daemon too
+                    print(
+                        f"bench: daemon {rep.get('status')!r} "
+                        f"(warmed={rep.get('warmed')}); waiting...",
+                        file=sys.stderr,
+                    )
+                    time.sleep(15.0)
+                client.close()
+            except Exception:  # noqa: BLE001 — no daemon at all
+                pass
         if explicit_kernel == "devd" and daemon is None:
             print("bench: TENDERMINT_TPU_KERNEL=devd but no daemon is "
                   "serving a device", file=sys.stderr)
