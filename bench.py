@@ -148,6 +148,13 @@ def main() -> None:
                     file=sys.stderr,
                 )
 
+    # the CPU fallback path rides the native batch verifier; build it NOW
+    # (fresh clone: ~1 min) so a missing .so can't silently demote the
+    # fallback measurement to the per-item python loop
+    from tendermint_tpu import native as _native
+
+    _native.available()
+
     chunks = [_make_items(BATCH, salt) for salt in range(N_BATCHES)]
     verifier = Verifier(min_tpu_batch=1)
 
