@@ -138,36 +138,42 @@ class BlockchainReactor(Reactor, BaseService):
         self.pool.remove_peer(peer.id())
 
     def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        # EVERYTHING in the message is attacker input: any decode
+        # violation (missing key, wrong type, out-of-range scalar) must
+        # end as a peer error, never an exception escaping into the p2p
+        # recv routine (codec/jsonval contract)
+        from tendermint_tpu.codec import jsonval as jv
+
         try:
             msg = json.loads(msg_bytes.decode())
             mtype = msg["type"]
-        except (ValueError, KeyError, UnicodeDecodeError) as exc:
+            if mtype == "block_request":
+                self._handle_block_request(
+                    peer, jv.int_field(msg, "height", 0, jv.MAX_HEIGHT)
+                )
+            elif mtype == "block_response":
+                block = Block.from_json(jv.dict_field(msg, "block"))
+                self.pool.add_block(peer.id(), block, len(msg_bytes))
+            elif mtype == "status_request":
+                peer.try_send(
+                    BLOCKCHAIN_CHANNEL,
+                    _enc({"type": "status_response", "height": self.store.height()}),
+                )
+            elif mtype == "status_response":
+                self.pool.set_peer_height(
+                    peer.id(), jv.int_field(msg, "height", 0, jv.MAX_HEIGHT)
+                )
+            elif mtype == "no_block_response":
+                # honest "I don't have it" — free the requester for another peer
+                height = jv.int_field(msg, "height", 0, jv.MAX_HEIGHT)
+                self.logger.debug(
+                    "peer %s has no block at %s", peer.id()[:8], height
+                )
+                self.pool.peer_has_no_block(peer.id(), height)
+            else:
+                raise ValueError(f"unknown bc msg {mtype!r}")
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError) as exc:
             self.switch.stop_peer_for_error(peer, exc)
-            return
-        if mtype == "block_request":
-            self._handle_block_request(peer, int(msg["height"]))
-        elif mtype == "block_response":
-            try:
-                block = Block.from_json(msg["block"])
-            except (KeyError, ValueError) as exc:
-                self.switch.stop_peer_for_error(peer, exc)
-                return
-            self.pool.add_block(peer.id(), block, len(msg_bytes))
-        elif mtype == "status_request":
-            peer.try_send(
-                BLOCKCHAIN_CHANNEL,
-                _enc({"type": "status_response", "height": self.store.height()}),
-            )
-        elif mtype == "status_response":
-            self.pool.set_peer_height(peer.id(), int(msg["height"]))
-        elif mtype == "no_block_response":
-            # honest "I don't have it" — free the requester for another peer
-            self.logger.debug(
-                "peer %s has no block at %s", peer.id()[:8], msg.get("height")
-            )
-            self.pool.peer_has_no_block(peer.id(), int(msg["height"]))
-        else:
-            self.switch.stop_peer_for_error(peer, f"unknown bc msg {mtype!r}")
 
     def _handle_block_request(self, peer, height: int) -> None:
         block = self.store.load_block(height)

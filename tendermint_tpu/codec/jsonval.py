@@ -43,3 +43,31 @@ def dict_field(o, key) -> dict:
     if not isinstance(v, dict):
         raise ValueError(f"bad {key!r}")
     return v
+
+
+MAX_TX_BYTES = 1 << 22  # 4 MB, above any block-size policy
+MAX_STR = 1 << 10
+MAX_TIME_NS = 1 << 62  # ~year 2116 in unix nanoseconds
+
+
+def require_dict(o) -> dict:
+    """Entry guard for every wire-facing from_json: a peer sending a
+    list/scalar where an object belongs must produce ValueError (-> peer
+    disconnect), never a TypeError escaping into a reactor thread."""
+    if not isinstance(o, dict):
+        raise ValueError(f"expected object, got {type(o).__name__}")
+    return o
+
+
+def list_field(o, key, max_len: int) -> list:
+    v = o.get(key) if isinstance(o, dict) else None
+    if not isinstance(v, list) or len(v) > max_len:
+        raise ValueError(f"bad {key!r}")
+    return v
+
+
+def str_field(o, key, max_len: int = MAX_STR) -> str:
+    v = o.get(key) if isinstance(o, dict) else None
+    if not isinstance(v, str) or len(v) > max_len:
+        raise ValueError(f"bad {key!r}")
+    return v

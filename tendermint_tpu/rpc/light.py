@@ -65,10 +65,14 @@ class LightClient:
         """verify_header against an explicit set — advance() uses this so a
         candidate set is never installed as trusted before it verifies."""
         res = _res if _res is not None else self.client.commit(height=int(height))
-        if not res.get("commit"):
-            raise LightClientError(f"no commit for height {height}")
-        header = Header.from_json(res["header"])
-        commit = Commit.from_json(res["commit"])
+        if not res.get("commit") or not res.get("header"):
+            raise LightClientError(f"no commit/header for height {height}")
+        try:
+            header = Header.from_json(res["header"])
+            commit = Commit.from_json(res["commit"])
+        except ValueError as exc:
+            # the serving node's response is untrusted input too
+            raise LightClientError(f"malformed commit response: {exc}")
         if header.chain_id != self.chain_id:
             raise LightClientError(
                 f"chain id {header.chain_id!r} != trusted {self.chain_id!r}"
@@ -122,7 +126,10 @@ class LightClient:
         h = self.height + 1 if prev_header is not None else 1
         while h <= to_height:
             res = self.client.commit(height=h)
-            header = Header.from_json(res["header"])
+            try:
+                header = Header.from_json(res.get("header"))
+            except ValueError as exc:
+                raise LightClientError(f"malformed header at {h}: {exc}")
             vals = self.validators
             if header.validators_hash != vals.hash():
                 claimed = ValidatorSet.from_json(

@@ -98,16 +98,19 @@ class Header:
 
     @classmethod
     def from_json(cls, obj) -> "Header":
+        from tendermint_tpu.codec import jsonval as jv
+
+        obj = jv.require_dict(obj)
         return cls(
-            chain_id=obj["chain_id"],
-            height=obj["height"],
-            time_ns=obj["time"],
-            num_txs=obj["num_txs"],
-            last_block_id=BlockID.from_json(obj["last_block_id"]),
-            last_commit_hash=bytes.fromhex(obj["last_commit_hash"]),
-            data_hash=bytes.fromhex(obj["data_hash"]),
-            validators_hash=bytes.fromhex(obj["validators_hash"]),
-            app_hash=bytes.fromhex(obj["app_hash"]),
+            chain_id=jv.str_field(obj, "chain_id"),
+            height=jv.int_field(obj, "height", 0, jv.MAX_HEIGHT),
+            time_ns=jv.int_field(obj, "time", 0, jv.MAX_TIME_NS),
+            num_txs=jv.int_field(obj, "num_txs", 0, jv.MAX_INDEX),
+            last_block_id=BlockID.from_json(jv.dict_field(obj, "last_block_id")),
+            last_commit_hash=jv.hex_field(obj, "last_commit_hash"),
+            data_hash=jv.hex_field(obj, "data_hash"),
+            validators_hash=jv.hex_field(obj, "validators_hash"),
+            app_hash=jv.hex_field(obj, "app_hash"),
         )
 
 
@@ -214,9 +217,17 @@ class Commit:
 
     @classmethod
     def from_json(cls, obj) -> "Commit":
+        from tendermint_tpu.codec import jsonval as jv
+
+        obj = jv.require_dict(obj)
         return cls(
-            BlockID.from_json(obj["block_id"]),
-            [Vote.from_json(p) if p else None for p in obj["precommits"]],
+            BlockID.from_json(jv.dict_field(obj, "block_id")),
+            [
+                # only JSON null means "validator skipped"; falsy garbage
+                # (0, false, "", {}) must reject, not silently drop a vote
+                Vote.from_json(p) if p is not None else None
+                for p in jv.list_field(obj, "precommits", jv.MAX_INDEX)
+            ],
         )
 
     def __repr__(self):
@@ -251,7 +262,19 @@ class Data:
 
     @classmethod
     def from_json(cls, obj) -> "Data":
-        return cls([bytes.fromhex(t) for t in obj["txs"]])
+        from tendermint_tpu.codec import jsonval as jv
+
+        obj = jv.require_dict(obj)
+        txs = jv.list_field(obj, "txs", jv.MAX_INDEX)
+        out = []
+        for t in txs:
+            if not isinstance(t, str) or len(t) > 2 * jv.MAX_TX_BYTES:
+                raise ValueError("bad tx in block data")
+            try:
+                out.append(bytes.fromhex(t))
+            except ValueError as exc:
+                raise ValueError("bad tx in block data: not hex") from exc
+        return cls(out)
 
 
 class Block:
@@ -368,10 +391,13 @@ class Block:
 
     @classmethod
     def from_json(cls, obj) -> "Block":
+        from tendermint_tpu.codec import jsonval as jv
+
+        obj = jv.require_dict(obj)
         return cls(
-            Header.from_json(obj["header"]),
-            Data.from_json(obj["data"]),
-            Commit.from_json(obj["last_commit"]),
+            Header.from_json(jv.dict_field(obj, "header")),
+            Data.from_json(jv.dict_field(obj, "data")),
+            Commit.from_json(jv.dict_field(obj, "last_commit")),
         )
 
     def block_id(self, part_set: PartSet) -> BlockID:

@@ -1,0 +1,128 @@
+"""Seeded structural fuzz of the attacker-facing JSON decode surface:
+msg_from_json (consensus wire), Block/Vote/Commit from_json. Contract
+under test: ANY input either decodes or raises ValueError — never any
+other exception type (a KeyError/TypeError/AttributeError escaping a
+decode path would crash a reactor thread instead of disconnecting the
+peer). The reference gets this from go-wire's typed byte decoding; our
+equivalent is codec/jsonval + per-type from_json validation.
+
+Deterministic (seeded) so failures reproduce; prints the failing value.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from tendermint_tpu.consensus.messages import msg_from_json, msg_to_json
+
+SEED = 20260730
+
+
+def _rand_scalar(rng):
+    return rng.choice([
+        None, True, False, 0, 1, -1, 5, 257, 1 << 40, 1 << 70, -(1 << 70),
+        0.5, float("nan"), "", "x", "5", "ff", "zz", "ab" * 20, "ab" * 200,
+        [], {}, [1, 2], b"".hex(),
+    ])
+
+
+def _rand_json(rng, depth=0):
+    if depth >= 3 or rng.random() < 0.5:
+        return _rand_scalar(rng)
+    if rng.random() < 0.5:
+        return [_rand_json(rng, depth + 1) for _ in range(rng.randrange(3))]
+    return {
+        rng.choice([
+            "type", "data", "height", "round", "step", "hash", "parts",
+            "block_id", "signature", "validator_index", "bits", "elems",
+            "total", "proof", "index", "bytes", "votes", "pub_key",
+        ]): _rand_json(rng, depth + 1)
+        for _ in range(rng.randrange(4))
+    }
+
+
+MSG_TYPES = [
+    "new_round_step", "commit_step", "proposal", "proposal_pol",
+    "block_part", "vote", "has_vote", "vote_set_maj23", "vote_set_bits",
+    "heartbeat",
+]
+
+
+def test_random_structures_decode_or_valueerror():
+    rng = random.Random(SEED)
+    for i in range(2000):
+        obj = _rand_json(rng)
+        try:
+            msg_from_json(obj)
+        except ValueError:
+            pass
+        except Exception as exc:  # noqa: BLE001 — the contract violation
+            pytest.fail(f"case {i}: {type(exc).__name__}: {exc!r} on {obj!r}")
+
+
+def test_random_bodies_per_message_type():
+    rng = random.Random(SEED + 1)
+    for i in range(2000):
+        obj = {"type": rng.choice(MSG_TYPES), "data": _rand_json(rng)}
+        try:
+            msg_from_json(obj)
+        except ValueError:
+            pass
+        except Exception as exc:  # noqa: BLE001
+            pytest.fail(f"case {i}: {type(exc).__name__}: {exc!r} on {obj!r}")
+
+
+def _valid_messages():
+    """Round-trippable real messages to corrupt field-by-field."""
+    msgs = [
+        {"type": "new_round_step",
+         "data": {"height": 5, "round": 0, "step": 1,
+                  "seconds_since_start_time": 0, "last_commit_round": -1}},
+        {"type": "has_vote",
+         "data": {"height": 2, "round": 1, "type": 1, "index": 3}},
+        {"type": "proposal_pol",
+         "data": {"height": 1, "proposal_pol_round": 0,
+                  "proposal_pol": {"bits": 4, "elems": "f"}}},
+    ]
+    return msgs
+
+
+def test_single_field_corruptions_of_valid_messages():
+    rng = random.Random(SEED + 2)
+    for base in _valid_messages():
+        decoded = msg_from_json(base)
+        assert msg_from_json(msg_to_json(decoded)) is not None  # round trip
+        for _ in range(300):
+            obj = {"type": base["type"], "data": dict(base["data"])}
+            key = rng.choice(list(obj["data"].keys()))
+            obj["data"][key] = _rand_json(rng)
+            try:
+                msg_from_json(obj)
+            except ValueError:
+                pass
+            except Exception as exc:  # noqa: BLE001
+                pytest.fail(
+                    f"{type(exc).__name__}: {exc!r} corrupting "
+                    f"{base['type']}.{key} with {obj['data'][key]!r}"
+                )
+
+
+def test_block_and_vote_from_json_fuzz():
+    from tendermint_tpu.types.block import Block, Commit
+    from tendermint_tpu.types.vote import Vote
+
+    rng = random.Random(SEED + 3)
+    for i in range(1500):
+        obj = _rand_json(rng)
+        for cls in (Block, Commit, Vote):
+            try:
+                cls.from_json(obj)
+            except ValueError:
+                pass
+            except Exception as exc:  # noqa: BLE001
+                pytest.fail(
+                    f"case {i}: {cls.__name__}.from_json -> "
+                    f"{type(exc).__name__}: {exc!r} on {obj!r}"
+                )
