@@ -70,11 +70,14 @@ class MempoolReactor(Reactor, BaseService):
         return ps.get_height() if ps is not None else None
 
     def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        from tendermint_tpu.codec import jsonval as jv
+
         try:
             msg = json.loads(msg_bytes.decode())
-            if msg.get("type") != "tx":
-                raise ValueError(f"unknown mempool msg {msg.get('type')!r}")
-            tx = bytes.fromhex(msg["tx"])
+            if not isinstance(msg, dict) or msg.get("type") != "tx":
+                raise ValueError("unknown mempool msg")
+            tx_hex = jv.str_field(msg, "tx", 2 * jv.MAX_TX_BYTES)
+            tx = bytes.fromhex(tx_hex)
         except (ValueError, KeyError, UnicodeDecodeError) as exc:
             self.switch.stop_peer_for_error(peer, exc)
             return

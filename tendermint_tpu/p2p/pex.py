@@ -84,6 +84,8 @@ class PEXReactor(Reactor, BaseService):
             return
         try:
             msg = json.loads(msg_bytes.decode())
+            if not isinstance(msg, dict):
+                raise ValueError("pex message not an object")
         except (ValueError, UnicodeDecodeError):
             self.switch.stop_peer_for_error(peer, "bad pex message")
             return
@@ -96,7 +98,13 @@ class PEXReactor(Reactor, BaseService):
                 src = NetAddress.from_string(src_str) if src_str else None
             except ValueError:
                 src = None
-            for s in msg.get("addrs", [])[:250]:
+            sent = msg.get("addrs", [])
+            if not isinstance(sent, list):
+                self.switch.stop_peer_for_error(peer, "bad pex addrs")
+                return
+            for s in sent[:250]:
+                if not isinstance(s, str) or len(s) > 64:
+                    continue  # garbage entry; the cap bounds parsing work
                 try:
                     addr = NetAddress.from_string(s)
                 except ValueError:
