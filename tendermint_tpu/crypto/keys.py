@@ -78,8 +78,12 @@ class PubKeyEd25519:
 
     @classmethod
     def from_json(cls, obj) -> "PubKeyEd25519":
-        if obj[0] != TYPE_ED25519:
-            raise ValueError(f"unknown pubkey type {obj[0]}")
+        # wire/handshake input: same shape contract as signature decoding
+        # above — any violation is ValueError, never IndexError/TypeError
+        if not isinstance(obj, (list, tuple)) or len(obj) != 2 or obj[0] != TYPE_ED25519:
+            raise ValueError(f"unknown pubkey encoding {obj!r}")
+        if not isinstance(obj[1], str) or len(obj[1]) != 64:
+            raise ValueError("bad pubkey hex")
         return cls(bytes.fromhex(obj[1]))
 
     def __hash__(self):

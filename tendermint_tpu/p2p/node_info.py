@@ -55,15 +55,27 @@ class NodeInfo:
 
     @classmethod
     def from_json(cls, o: dict) -> "NodeInfo":
+        # handshake input from an unauthenticated peer: every field is
+        # type- and size-checked; violations raise ValueError (-> the
+        # switch drops the connection). The frame itself is already
+        # capped at MAX_NODE_INFO_SIZE (peer.exchange_node_info).
+        from tendermint_tpu.codec import jsonval as jv
+
+        o = jv.require_dict(o)
+        other = o.get("other", [])
+        if not isinstance(other, list) or len(other) > 32 or any(
+            not isinstance(x, str) or len(x) > jv.MAX_STR for x in other
+        ):
+            raise ValueError("bad node info 'other'")
         return cls(
-            pub_key=PubKeyEd25519.from_json(o["pub_key"]),
-            moniker=o["moniker"],
-            network=o["network"],
-            version=o["version"],
-            remote_addr=o.get("remote_addr", ""),
-            listen_addr=o.get("listen_addr", ""),
-            channels=bytes.fromhex(o.get("channels", "")),
-            other=o.get("other", []),
+            pub_key=PubKeyEd25519.from_json(o.get("pub_key")),
+            moniker=jv.str_field(o, "moniker"),
+            network=jv.str_field(o, "network"),
+            version=jv.str_field(o, "version"),
+            remote_addr=jv.str_field(o, "remote_addr") if o.get("remote_addr") else "",
+            listen_addr=jv.str_field(o, "listen_addr") if o.get("listen_addr") else "",
+            channels=jv.hex_field(o, "channels", max_bytes=32) if o.get("channels") else b"",
+            other=other,
         )
 
     def encode(self) -> bytes:

@@ -204,13 +204,14 @@ def test_reactor_receive_paths_never_leak_exceptions():
 
 
 def test_block_and_vote_from_json_fuzz():
+    from tendermint_tpu.p2p.node_info import NodeInfo
     from tendermint_tpu.types.block import Block, Commit
     from tendermint_tpu.types.vote import Vote
 
     rng = random.Random(SEED + 3)
     for i in range(1500):
         obj = _rand_json(rng)
-        for cls in (Block, Commit, Vote):
+        for cls in (Block, Commit, Vote, NodeInfo):
             try:
                 cls.from_json(obj)
             except ValueError:
@@ -220,3 +221,29 @@ def test_block_and_vote_from_json_fuzz():
                     f"case {i}: {cls.__name__}.from_json -> "
                     f"{type(exc).__name__}: {exc!r} on {obj!r}"
                 )
+
+
+def test_node_info_handshake_roundtrip_and_corruptions():
+    from tendermint_tpu.crypto.keys import gen_priv_key_ed25519
+    from tendermint_tpu.p2p.node_info import NodeInfo, default_version
+
+    info = NodeInfo(
+        pub_key=gen_priv_key_ed25519(b"\x44" * 32).pub_key(),
+        moniker="fuzz", network="net", version=default_version("t"),
+        listen_addr="1.2.3.4:46656", channels=b"\x20\x30\x40",
+        other=["a=b"],
+    )
+    decoded = NodeInfo.from_json(info.to_json())
+    assert decoded.pub_key.raw == info.pub_key.raw
+    assert decoded.channels == info.channels
+    rng = random.Random(SEED + 5)
+    base = info.to_json()
+    for _ in range(600):
+        obj = dict(base)
+        obj[rng.choice(list(obj.keys()))] = _rand_json(rng)
+        try:
+            NodeInfo.from_json(obj)
+        except ValueError:
+            pass
+        except Exception as exc:  # noqa: BLE001
+            pytest.fail(f"{type(exc).__name__}: {exc!r} on {obj!r}")
