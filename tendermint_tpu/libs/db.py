@@ -74,7 +74,15 @@ _REC = struct.Struct("<BII")  # op, klen, vlen
 class FileDB(DB):
     """Append-only journal of (op, key, value) records with load-time replay
     and size-triggered compaction. fsync on set_sync for the durability the
-    reference gets from LevelDB's WAL."""
+    reference gets from LevelDB's WAL.
+
+    VALUES LIVE ON DISK: memory holds only a key -> (offset, length)
+    index, so a long-running node's block store costs RAM proportional to
+    the KEY count (~60 B/entry), not the chain's bytes — the property the
+    reference gets from LevelDB. (A 30-min soak caught the prior design
+    retaining ~9 KB of RAM per block, unbounded with chain length.)
+    Reads seek the journal; the block-store/state hot paths read rarely
+    (serving fast sync, RPC) while writes stay append-only."""
 
     _OP_SET = 1
     _OP_DEL = 2
@@ -82,11 +90,14 @@ class FileDB(DB):
     def __init__(self, path: str, compact_threshold: int = 64 * 1024 * 1024):
         self._path = path
         self._mtx = threading.RLock()
-        self._data: dict[bytes, bytes] = {}
+        self._index: dict[bytes, tuple[int, int]] = {}  # key -> (off, vlen)
         self._compact_threshold = compact_threshold
+        self._compactions = 0  # observable: tests must prove live reads
+        # survive a compaction, not just a restart replay
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._load()
         self._f = open(path, "ab")
+        self._rf = open(path, "rb")
 
     def _load(self) -> None:
         if not os.path.exists(self._path):
@@ -102,71 +113,100 @@ class FileDB(DB):
                 break  # torn tail record from a crash: drop it
             key = buf[off : off + klen]
             off += klen
-            val = buf[off : off + vlen]
+            if op == self._OP_SET:
+                self._index[key] = (off, vlen)
+            elif op == self._OP_DEL:
+                self._index.pop(key, None)
             off += vlen
             valid_end = off
-            if op == self._OP_SET:
-                self._data[key] = val
-            elif op == self._OP_DEL:
-                self._data.pop(key, None)
         if valid_end < len(buf):
             # truncate the torn tail so subsequent appends don't concatenate
             # onto garbage and corrupt the journal for the next restart
             with open(self._path, "r+b") as f:
                 f.truncate(valid_end)
 
-    def _append(self, op: int, key: bytes, value: bytes, sync: bool) -> None:
-        rec = _REC.pack(op, len(key), len(value)) + key + value
-        self._f.write(rec)
+    def _append(self, op: int, key: bytes, value: bytes, sync: bool) -> int:
+        """Write one record; returns the VALUE's file offset. Compaction is
+        the caller's follow-up (_maybe_compact) so the new record's index
+        entry exists before the index is rewritten."""
+        value_off = self._f.tell() + _REC.size + len(key)
+        self._f.write(_REC.pack(op, len(key), len(value)) + key + value)
         self._f.flush()
         if sync:
             os.fsync(self._f.fileno())
+        return value_off
+
+    def _maybe_compact(self) -> None:
         if self._f.tell() > self._compact_threshold:
             self._compact()
 
+    def _read_at(self, off: int, vlen: int) -> bytes:
+        self._rf.seek(off)
+        return self._rf.read(vlen)
+
     def _compact(self) -> None:
         tmp = self._path + ".compact"
+        new_index: dict[bytes, tuple[int, int]] = {}
         with open(tmp, "wb") as f:
-            for k, v in self._data.items():
-                f.write(_REC.pack(self._OP_SET, len(k), len(v)) + k + v)
+            for k, (off, vlen) in self._index.items():
+                v = self._read_at(off, vlen)
+                new_index[k] = (f.tell() + _REC.size + len(k), vlen)
+                f.write(_REC.pack(self._OP_SET, len(k), vlen) + k + v)
             f.flush()
             os.fsync(f.fileno())
         self._f.close()
+        self._rf.close()
         os.replace(tmp, self._path)
+        self._index = new_index
+        self._compactions += 1
         self._f = open(self._path, "ab")
+        self._rf = open(self._path, "rb")
 
     def get(self, key: bytes) -> bytes | None:
         with self._mtx:
-            return self._data.get(key)
+            ent = self._index.get(key)
+            if ent is None:
+                return None
+            return self._read_at(*ent)
 
     def set(self, key: bytes, value: bytes) -> None:
         with self._mtx:
             key, value = bytes(key), bytes(value)
-            self._data[key] = value
-            self._append(self._OP_SET, key, value, sync=False)
+            off = self._append(self._OP_SET, key, value, sync=False)
+            self._index[key] = (off, len(value))
+            self._maybe_compact()
 
     def set_sync(self, key: bytes, value: bytes) -> None:
         with self._mtx:
             key, value = bytes(key), bytes(value)
-            self._data[key] = value
-            self._append(self._OP_SET, key, value, sync=True)
+            off = self._append(self._OP_SET, key, value, sync=True)
+            self._index[key] = (off, len(value))
+            self._maybe_compact()
 
     def delete(self, key: bytes) -> None:
         with self._mtx:
-            if key in self._data:
-                del self._data[key]
+            if key in self._index:
                 self._append(self._OP_DEL, key, b"", sync=False)
+                del self._index[key]
+                self._maybe_compact()
 
     def iterate_prefix(self, prefix: bytes):
+        # snapshot KEYS only (filter before sorting); read each value via
+        # get() at yield time — re-resolving the index per key keeps reads
+        # correct across a concurrent compaction (stored offsets go stale
+        # when the journal is rewritten) and never materializes the whole
+        # matching range in RAM
         with self._mtx:
-            items = sorted(
-                (k, v) for k, v in self._data.items() if k.startswith(prefix)
-            )
-        yield from items
+            keys = sorted(k for k in self._index if k.startswith(prefix))
+        for k in keys:
+            v = self.get(k)
+            if v is not None:  # deleted since the snapshot: skip
+                yield (k, v)
 
     def close(self) -> None:
         with self._mtx:
             self._f.close()
+            self._rf.close()
 
 
 def db_provider(name: str, backend: str, db_dir: str) -> DB:
