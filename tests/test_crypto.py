@@ -493,3 +493,28 @@ class TestNativeRLCBatchVerify:
         # below RLC_MIN_BATCH nothing changes at all
         out = self._check_parity(self._items(8))
         assert out == [True] * 8
+
+    def test_bisection_finds_random_forged_subsets(self):
+        """On rejection the batch bisects (k bad lanes cost O(k log n)
+        RLC work, not a full per-item rerun) — verdicts must stay exact
+        for any forged-subset shape, including subsets straddling the
+        bisection midpoints."""
+        import random as _random
+
+        rng = _random.Random(77)
+        # forge by mutating the MESSAGE: the signature stays canonical
+        # (s < L, valid R), so rejection happens at the RLC combined
+        # EQUATION, not the cheap strict pre-checks — the mathematically
+        # interesting path
+        for n, k in ((64, 1), (64, 2), (96, 5), (128, 33), (128, 128)):
+            items = self._items(n)
+            bad = set(rng.sample(range(n), k))
+            for b in bad:
+                items[b] = (items[b][0], items[b][1] + b"!", items[b][2])
+            out = self._check_parity(items)
+            assert out == [i not in bad for i in range(n)], (n, k)
+        # and the exact midpoint-straddle shape
+        items = self._items(64)
+        for b in (31, 32):
+            items[b] = (items[b][0], items[b][1] + b"!", items[b][2])
+        assert self._check_parity(items) == [i not in (31, 32) for i in range(64)]
