@@ -341,6 +341,9 @@ def metrics(ctx) -> dict:
     out["blockstore_height"] = ctx.block_store.height()
     out["consensus_peer_msg_drops"] = ctx.consensus_state.peer_msg_drops
     out["mempool_size"] = ctx.mempool.size()
+    batcher = getattr(ctx.mempool, "sig_batcher", None)
+    if batcher is not None:
+        out["mempool_sig_gate_dropped"] = batcher.dropped
     outbound, inbound, dialing = ctx.switch.num_peers()
     out["p2p_peers_outbound"] = outbound
     out["p2p_peers_inbound"] = inbound
