@@ -311,6 +311,20 @@ class TestShardedVerifier:
         assert v.stats()["tpu_sigs"] == 16
         assert v._kernel == "f32p"  # did not silently demote to f32
 
+    def test_sharded_async_uses_the_sharded_path(self):
+        """verify_batch_async on a ShardedVerifier must ride the sharded
+        dispatch (regression: the inherited base implementation silently
+        ran the UNSHARDED kernel)."""
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()), ("batch",))
+        v = gateway.ShardedVerifier(mesh, min_tpu_batch=1)
+        items = _mk_items(16, corrupt=[(9, "msg")])
+        resolve = v.verify_batch_async(items)
+        assert resolve() == [i != 9 for i in range(16)]
+        assert v.stats()["tpu_batches"] == 1
+        assert v.stats()["tpu_sigs"] == 16
+
     def test_sharded_rejects_bakeoff_kernels(self, monkeypatch):
         from jax.sharding import Mesh
 
