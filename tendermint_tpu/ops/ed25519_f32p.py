@@ -443,7 +443,10 @@ def make_sharded_verify(mesh, on_tpu: bool):
     if key in _sharded_calls:
         return _sharded_calls[key]
     import jax
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as PS
 
     spec = PS(None, "batch", None)
