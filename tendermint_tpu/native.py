@@ -199,18 +199,24 @@ def ed25519_verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> list[bool]:
     individually, every rejected lane individually."""
     lib = get_lib()
     n = len(items)
-    pubs = np.zeros(n * 32, dtype=np.uint8)
-    sigs = np.zeros(n * 64, dtype=np.uint8)
+    # one join + frombuffer, not n numpy slice-writes: the per-slice
+    # path cost ~17ms per 4096-lane batch, a quarter of the whole verify
+    pub_parts: list[bytes] = []
+    sig_parts: list[bytes] = []
     msgs = []
     ok_shape = np.ones(n, dtype=bool)
     for i, (pub, msg, sig) in enumerate(items):
         if len(pub) != 32 or len(sig) != 64:
             ok_shape[i] = False
+            pub_parts.append(b"\x00" * 32)
+            sig_parts.append(b"\x00" * 64)
             msgs.append(b"")
             continue
-        pubs[32 * i : 32 * i + 32] = np.frombuffer(pub, dtype=np.uint8)
-        sigs[64 * i : 64 * i + 64] = np.frombuffer(sig, dtype=np.uint8)
+        pub_parts.append(bytes(pub))
+        sig_parts.append(bytes(sig))
         msgs.append(bytes(msg))
+    pubs = np.frombuffer(b"".join(pub_parts), dtype=np.uint8)
+    sigs = np.frombuffer(b"".join(sig_parts), dtype=np.uint8)
     data, offsets = _concat(msgs)
     data_p = _as_u8p(data)
 
