@@ -140,9 +140,7 @@ void tm_ed25519_hram_batch(const uint8_t* sigs, const uint8_t* pubs,
 void tm_ed25519_decompress_batch(const uint8_t* pubs, int64_t n,
                                  uint8_t* xy_out /* n*64 */,
                                  uint8_t* ok) {
-  for (int64_t i = 0; i < n; i++)
-    ok[i] = (uint8_t)ed25519_decompress(pubs + 32 * i, xy_out + 64 * i,
-                                        xy_out + 64 * i + 32);
+  ed25519_decompress_batch(pubs, n, xy_out, ok);
 }
 
 }  // extern "C"

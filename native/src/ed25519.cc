@@ -6,6 +6,7 @@
 #include <vector>
 
 #include "hashes.h"
+#include "fe_ifma.h"
 
 // NOTE: <random>/<string>/<unordered_map> are off-limits here — they pull
 // in <wchar.h>, whose global `struct tm` collides with `namespace tm`.
@@ -294,8 +295,18 @@ void ge_double(ge* o, const ge* p) {
   fe_mul(o->T, e, h);
 }
 
-// decompress: returns 1 if s is a valid canonical point encoding
-int ge_from_bytes(ge* p, const uint8_t s[32]) {
+// decompression, staged so the (p-5)/8 power chain — its dominant cost
+// — can run 8-wide over independent points (fe_ifma.h):
+//   prep (scalar):  parse y, compute u, v, v^3 and t_in = u v^7
+//   pow:            t = t_in^((p-5)/8)   [vectorizable]
+//   finish (scalar): x = u v^3 t, sqrt check, sign — ALL accept/reject
+//                    decisions happen here, identically for both paths
+struct DecompPre {
+  fe y, u, v, v3, tin;
+  int sign;
+};
+
+static int decompress_prep(DecompPre* st, const uint8_t s[32]) {
   // reject non-canonical y >= p
   static const uint8_t PBYTES[32] = {
       0xed, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
@@ -303,30 +314,33 @@ int ge_from_bytes(ge* p, const uint8_t s[32]) {
       0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x7f};
   uint8_t ymasked[32];
   std::memcpy(ymasked, s, 32);
-  int sign = ymasked[31] >> 7;
+  st->sign = ymasked[31] >> 7;
   ymasked[31] &= 0x7f;
-  // y >= p?
   int ge_p = 1;
   for (int i = 31; i >= 0; i--) {
     if (ymasked[i] < PBYTES[i]) { ge_p = 0; break; }
     if (ymasked[i] > PBYTES[i]) { ge_p = 1; break; }
   }
   if (ge_p) return 0;
-
-  fe y, y2, u, v, v3, x, vx2, chk;
-  fe_from_bytes(y, ymasked);
-  fe_sq(y2, y);
-  fe one;
+  fe y2, one;
+  fe_from_bytes(st->y, ymasked);
+  fe_sq(y2, st->y);
   fe_one(one);
-  fe_sub(u, y2, one);         // y^2 - 1
-  fe_mul(v, y2, FE_D);
-  fe_add(v, v, one); fe_carry(v);  // d y^2 + 1
-  fe_sq(v3, v); fe_mul(v3, v3, v); // v^3
-  fe t;
-  fe_sq(t, v3); fe_mul(t, t, v);   // v^7
-  fe_mul(t, t, u);                 // u v^7
-  fe_pow2523(t, t);                // (u v^7)^((p-5)/8)
-  fe_mul(x, u, v3); fe_mul(x, x, t);  // u v^3 (u v^7)^((p-5)/8)
+  fe_sub(st->u, y2, one);                    // y^2 - 1
+  fe_mul(st->v, y2, FE_D);
+  fe_add(st->v, st->v, one); fe_carry(st->v);  // d y^2 + 1
+  fe_sq(st->v3, st->v); fe_mul(st->v3, st->v3, st->v);  // v^3
+  fe_sq(st->tin, st->v3); fe_mul(st->tin, st->tin, st->v);  // v^7
+  fe_mul(st->tin, st->tin, st->u);           // u v^7
+  return 1;
+}
+
+static int decompress_finish(ge* p, const DecompPre* st, const fe t_pow) {
+  fe x, vx2, chk;
+  const fe& u = st->u;
+  const fe& v = st->v;
+  int sign = st->sign;
+  fe_mul(x, u, st->v3); fe_mul(x, x, t_pow);  // u v^3 (u v^7)^((p-5)/8)
   fe_sq(vx2, x); fe_mul(vx2, vx2, v); // v x^2
   fe_sub(chk, vx2, u);
   if (!fe_is_zero(chk)) {
@@ -341,10 +355,62 @@ int ge_from_bytes(ge* p, const uint8_t s[32]) {
     fe_sub(x, zero, x);
   }
   fe_copy(p->X, x);
-  fe_copy(p->Y, y);
+  fe_copy(p->Y, st->y);
   fe_one(p->Z);
-  fe_mul(p->T, x, y);
+  fe_mul(p->T, x, st->y);
   return 1;
+}
+
+// decompress: returns 1 if s is a valid canonical point encoding
+int ge_from_bytes(ge* p, const uint8_t s[32]) {
+  DecompPre st;
+  if (!decompress_prep(&st, s)) return 0;
+  fe t;
+  fe_pow2523(t, st.tin);
+  return decompress_finish(p, &st, t);
+}
+
+// batch decompression: out[i] valid iff ok[i]; the power chains run
+// eight points at a time through fe8_pow2523 when IFMA is available,
+// with bit-identical results to the scalar chain (same additions, same
+// radix — only the lane count differs).
+void ge_from_bytes_batch(ge* out, uint8_t* ok,
+                         const uint8_t* const* encs, size_t n) {
+  std::vector<DecompPre> pre(n);
+  for (size_t i = 0; i < n; i++) ok[i] = (uint8_t)decompress_prep(&pre[i], encs[i]);
+  size_t i = 0;
+#ifdef TM_HAVE_FE8
+  // groups of 8 prepped points (skip over prep failures)
+  size_t idx[8];
+  for (;;) {
+    size_t g = 0;
+    size_t scan = i;
+    while (scan < n && g < 8) {
+      if (ok[scan]) idx[g++] = scan;
+      scan++;
+    }
+    if (g < 8) break;  // remainder handled scalar below
+    uint64_t in[8][5], outp[8][5];
+    for (size_t k = 0; k < 8; k++)
+      for (int j = 0; j < 5; j++) in[k][j] = pre[idx[k]].tin[j];
+    fe8 z, t;
+    fe8_load(&z, in);
+    fe8_pow2523(&t, &z);
+    fe8_store(outp, &t);
+    for (size_t k = 0; k < 8; k++) {
+      fe tp;
+      for (int j = 0; j < 5; j++) tp[j] = outp[k][j];
+      ok[idx[k]] = (uint8_t)decompress_finish(&out[idx[k]], &pre[idx[k]], tp);
+    }
+    i = idx[7] + 1;
+  }
+#endif
+  for (; i < n; i++) {
+    if (!ok[i]) continue;
+    fe t;
+    fe_pow2523(t, pre[i].tin);
+    ok[i] = (uint8_t)decompress_finish(&out[i], &pre[i], t);
+  }
 }
 
 void ge_neg(ge* o, const ge* p) {
@@ -827,25 +893,45 @@ int ed25519_verify_batch_rlc(const uint8_t* pubs, const uint8_t* sigs,
   // to the exact per-item loop; see os_random above)
   std::vector<uint8_t> zbuf(16 * (size_t)n);
   if (!os_random(zbuf.data(), zbuf.size())) return 0;
-  // validator keys repeat across a commit: decompress each unique A once
+  // validator keys repeat across a commit: decompress each unique A
+  // once. Decompression targets (every R + each unique A) collect
+  // first, then decompress together so the power chains run 8-wide.
   NegACache neg_a_cache((size_t)n);
+  std::vector<const uint8_t*> encs;
+  encs.reserve((size_t)n + 64);
+  std::vector<size_t> a_slot((size_t)n);
+  std::vector<size_t> uniq_slots;
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t* sig = sigs + 64 * i;
+    if (bytes_ge(sig + 32, LBYTES, 32)) return 0;  // s >= L (strict)
+    encs.push_back(sig);                           // R_i
+  }
+  ge placeholder;
+  ge_identity(&placeholder);
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t* pub = pubs + 32 * i;
+    bool found;
+    size_t slot = neg_a_cache.slot_for(pub, &found);
+    if (!found) {
+      neg_a_cache.put(slot, pub, placeholder);  // filled after decompress
+      uniq_slots.push_back(slot);
+      encs.push_back(pub);
+    }
+    a_slot[i] = slot;
+  }
+  size_t n_pts = encs.size();
+  std::vector<ge> dec(n_pts);
+  std::vector<uint8_t> dec_ok(n_pts);
+  ge_from_bytes_batch(dec.data(), dec_ok.data(), encs.data(), n_pts);
+  for (size_t i = 0; i < n_pts; i++)
+    if (!dec_ok[i]) return 0;  // invalid/non-canonical R or A
+  for (size_t k = 0; k < uniq_slots.size(); k++)
+    ge_neg(&neg_a_cache.vals[uniq_slots[k]], &dec[(size_t)n + k]);
   uint8_t zsum_s[32] = {0};
   for (int64_t i = 0; i < n; i++) {
     const uint8_t* sig = sigs + 64 * i;
     const uint8_t* pub = pubs + 32 * i;
-    if (bytes_ge(sig + 32, LBYTES, 32)) return 0;  // s >= L (strict)
-    ge r;
-    if (!ge_from_bytes(&r, sig)) return 0;  // non-canonical/invalid R
-    bool found;
-    size_t slot = neg_a_cache.slot_for(pub, &found);
-    if (!found) {
-      ge a;
-      if (!ge_from_bytes(&a, pub)) return 0;  // invalid A
-      ge na;
-      ge_neg(&na, &a);
-      neg_a_cache.put(slot, pub, na);
-    }
-    const ge& neg_a = neg_a_cache.vals[slot];
+    const ge& neg_a = neg_a_cache.vals[a_slot[i]];
     uint8_t z[32] = {0};
     std::memcpy(z, zbuf.data() + 16 * i, 16);
     uint8_t z_acc = 0;
@@ -858,7 +944,7 @@ int ed25519_verify_batch_rlc(const uint8_t* pubs, const uint8_t* sigs,
     sc_add_mod_l(zsum_s, zsum_s, zs);
     sc_mul_mod_l(zh, z, h);
     ge nr;
-    ge_neg(&nr, &r);
+    ge_neg(&nr, &dec[i]);
     std::array<uint8_t, 32> za{}, zha{};
     std::memcpy(za.data(), z, 32);
     std::memcpy(zha.data(), zh, 32);
@@ -899,6 +985,20 @@ void ed25519_hram(const uint8_t r[32], const uint8_t pub[32],
   uint8_t digest[64];
   sha512_final(&c, digest);
   sc_reduce64(h_out, digest);
+}
+
+void ed25519_decompress_batch(const uint8_t* pubs, int64_t n,
+                              uint8_t* xy_out, uint8_t* ok) {
+  if (n <= 0) return;
+  std::vector<ge> dec((size_t)n);
+  std::vector<const uint8_t*> encs((size_t)n);
+  for (int64_t i = 0; i < n; i++) encs[i] = pubs + 32 * i;
+  ge_from_bytes_batch(dec.data(), ok, encs.data(), (size_t)n);
+  for (int64_t i = 0; i < n; i++) {
+    if (!ok[i]) continue;
+    fe_to_bytes(xy_out + 64 * i, dec[i].X);
+    fe_to_bytes(xy_out + 64 * i + 32, dec[i].Y);
+  }
 }
 
 int ed25519_decompress(const uint8_t pub[32], uint8_t x_out[32],

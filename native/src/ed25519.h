@@ -19,6 +19,12 @@ int ed25519_verify(const uint8_t pub[32], const uint8_t* msg, uint64_t msg_len,
 
 // Decompress a public key to affine (x, y) field elements serialized as
 // 32-byte little-endian canonical values. Returns 1 on success.
+// Batch variant: xy_out[i] = x||y (2x32 LE bytes), ok[i] = 1 on
+// success. The (p-5)/8 power chains run 8-wide (AVX-512 IFMA) when the
+// host supports it, with bit-identical results to the scalar path.
+void ed25519_decompress_batch(const uint8_t* pubs, int64_t n,
+                              uint8_t* xy_out, uint8_t* ok);
+
 int ed25519_decompress(const uint8_t pub[32], uint8_t x_out[32],
                        uint8_t y_out[32]);
 

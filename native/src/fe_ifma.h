@@ -1,0 +1,177 @@
+// 8-way vectorized GF(2^255-19) arithmetic via AVX-512 IFMA
+// (vpmadd52{lo,hi}uq): limb j of eight independent field elements lives
+// in one zmm register, radix 2^51 exactly like the scalar `fe` type.
+//
+// Used ONLY for the data-parallel (p-5)/8 power chain inside batched
+// point decompression — the dominant per-point cost of RLC batch
+// verification. All acceptance/rejection decisions stay in the scalar
+// code paths, which are the semantic reference.
+//
+// IFMA multiplies the LOW 52 bits of each 64-bit lane; every fe8 input
+// limb must therefore be < 2^52. fe8_mul's outputs are carried to
+// < 2^51 + eps, and the scalar fe_mul/fe_carry producers guarantee the
+// same, so the invariant holds by construction.
+#pragma once
+
+#if defined(__AVX512IFMA__) && defined(__AVX512VL__) && defined(__AVX512DQ__)
+#define TM_HAVE_FE8 1
+
+#include <immintrin.h>
+#include <cstdint>
+
+namespace tm {
+
+struct fe8 {
+  __m512i v[5];
+};
+
+static inline __m512i fe8_mask51() {
+  return _mm512_set1_epi64((1LL << 51) - 1);
+}
+
+// load limb-sliced: in[i] is a scalar fe (uint64_t[5]); lane k of
+// register j gets in[k][j]
+static inline void fe8_load(fe8* o, const uint64_t in[8][5]) {
+  for (int j = 0; j < 5; j++) {
+    alignas(64) uint64_t lane[8];
+    for (int k = 0; k < 8; k++) lane[k] = in[k][j];
+    o->v[j] = _mm512_load_si512((const void*)lane);
+  }
+}
+
+static inline void fe8_store(uint64_t out[8][5], const fe8* a) {
+  for (int j = 0; j < 5; j++) {
+    alignas(64) uint64_t lane[8];
+    _mm512_store_si512((void*)lane, a->v[j]);
+    for (int k = 0; k < 8; k++) out[k][j] = lane[k];
+  }
+}
+
+// o = a * b (schoolbook, columns split into IFMA lo/hi parts).
+// Each 52x52->104 product contributes low52 at its own column weight
+// and high52 doubled at the next column (2^52 = 2*2^51).
+static inline void fe8_mul(fe8* o, const fe8* a, const fe8* b) {
+  __m512i zero = _mm512_setzero_si512();
+  __m512i lo[9], hi[9];
+  for (int k = 0; k < 9; k++) lo[k] = hi[k] = zero;
+  for (int i = 0; i < 5; i++)
+    for (int j = 0; j < 5; j++) {
+      lo[i + j] = _mm512_madd52lo_epu64(lo[i + j], a->v[i], b->v[j]);
+      hi[i + j] = _mm512_madd52hi_epu64(hi[i + j], a->v[i], b->v[j]);
+    }
+  // t[k] = lo[k] + 2*hi[k-1]; bounds: 5*2^52 + 2*5*2^52 < 2^56
+  __m512i t[9];
+  t[0] = lo[0];
+  for (int k = 1; k < 9; k++)
+    t[k] = _mm512_add_epi64(lo[k], _mm512_slli_epi64(hi[k - 1], 1));
+  // fold columns 5..8 down with *19 (2^255 == 19 mod p);
+  // 19*t < 2^61, sums < 2^62 — well inside 64 bits
+  __m512i nineteen = _mm512_set1_epi64(19);
+  for (int k = 5; k < 9; k++)
+    t[k - 5] = _mm512_add_epi64(t[k - 5], _mm512_mullo_epi64(t[k], nineteen));
+  // also fold 2*hi[8] (weight 2^(51*9)): 51*9 = 255 + 51*4 -> column 4, *19
+  t[4] = _mm512_add_epi64(
+      t[4], _mm512_mullo_epi64(_mm512_slli_epi64(hi[8], 1), nineteen));
+  // carry chain to limbs < 2^52
+  __m512i m = fe8_mask51();
+  __m512i c;
+  for (int j = 0; j < 4; j++) {
+    c = _mm512_srli_epi64(t[j], 51);
+    t[j] = _mm512_and_epi64(t[j], m);
+    t[j + 1] = _mm512_add_epi64(t[j + 1], c);
+  }
+  c = _mm512_srli_epi64(t[4], 51);
+  t[4] = _mm512_and_epi64(t[4], m);
+  t[0] = _mm512_add_epi64(t[0], _mm512_mullo_epi64(c, nineteen));
+  c = _mm512_srli_epi64(t[0], 51);
+  t[0] = _mm512_and_epi64(t[0], m);
+  t[1] = _mm512_add_epi64(t[1], c);
+  for (int j = 0; j < 5; j++) o->v[j] = t[j];
+}
+
+// squaring: 15 distinct products (10 off-diagonal doubled + 5 diagonal)
+// instead of fe8_mul's 25. Doubling happens at column combine — the
+// operands themselves must stay < 2^52 for IFMA.
+static inline void fe8_sq(fe8* o, const fe8* a) {
+  __m512i zero = _mm512_setzero_si512();
+  __m512i dlo[9], dhi[9], slo[9], shi[9];
+  for (int k = 0; k < 9; k++) dlo[k] = dhi[k] = slo[k] = shi[k] = zero;
+  for (int i = 0; i < 5; i++) {
+    slo[2 * i] = _mm512_madd52lo_epu64(slo[2 * i], a->v[i], a->v[i]);
+    shi[2 * i] = _mm512_madd52hi_epu64(shi[2 * i], a->v[i], a->v[i]);
+    for (int j = i + 1; j < 5; j++) {
+      dlo[i + j] = _mm512_madd52lo_epu64(dlo[i + j], a->v[i], a->v[j]);
+      dhi[i + j] = _mm512_madd52hi_epu64(dhi[i + j], a->v[i], a->v[j]);
+    }
+  }
+  // t[k] = slo[k] + 2*dlo[k] + 2*shi[k-1] + 4*dhi[k-1]
+  // bounds: 2^52 + 2^54 + 2^53 + 2^55 < 2^56
+  __m512i t[9];
+  t[0] = _mm512_add_epi64(slo[0], _mm512_slli_epi64(dlo[0], 1));
+  for (int k = 1; k < 9; k++) {
+    __m512i cur = _mm512_add_epi64(slo[k], _mm512_slli_epi64(dlo[k], 1));
+    __m512i carry = _mm512_add_epi64(_mm512_slli_epi64(shi[k - 1], 1),
+                                     _mm512_slli_epi64(dhi[k - 1], 2));
+    t[k] = _mm512_add_epi64(cur, carry);
+  }
+  __m512i nineteen = _mm512_set1_epi64(19);
+  for (int k = 5; k < 9; k++)
+    t[k - 5] = _mm512_add_epi64(t[k - 5], _mm512_mullo_epi64(t[k], nineteen));
+  // top hi parts at column 9: 2*shi[8] + 4*dhi[8] -> *19 into column 4
+  __m512i top = _mm512_add_epi64(_mm512_slli_epi64(shi[8], 1),
+                                 _mm512_slli_epi64(dhi[8], 2));
+  t[4] = _mm512_add_epi64(t[4], _mm512_mullo_epi64(top, nineteen));
+  __m512i m = fe8_mask51();
+  __m512i c;
+  for (int j = 0; j < 4; j++) {
+    c = _mm512_srli_epi64(t[j], 51);
+    t[j] = _mm512_and_epi64(t[j], m);
+    t[j + 1] = _mm512_add_epi64(t[j + 1], c);
+  }
+  c = _mm512_srli_epi64(t[4], 51);
+  t[4] = _mm512_and_epi64(t[4], m);
+  t[0] = _mm512_add_epi64(t[0], _mm512_mullo_epi64(c, nineteen));
+  c = _mm512_srli_epi64(t[0], 51);
+  t[0] = _mm512_and_epi64(t[0], m);
+  t[1] = _mm512_add_epi64(t[1], c);
+  for (int j = 0; j < 5; j++) o->v[j] = t[j];
+}
+
+// o = a^(2^252 - 3), the (p-5)/8 exponent — same addition chain as the
+// scalar fe_pow2523, eight elements at a time.
+static inline void fe8_pow2523(fe8* o, const fe8* z) {
+  fe8 t0, t1, t2;
+  fe8_sq(&t0, z);
+  fe8_sq(&t1, &t0); fe8_sq(&t1, &t1);
+  fe8_mul(&t1, z, &t1);
+  fe8_mul(&t0, &t0, &t1);
+  fe8_sq(&t0, &t0);
+  fe8_mul(&t0, &t1, &t0);
+  fe8_sq(&t1, &t0);
+  for (int i = 1; i < 5; i++) fe8_sq(&t1, &t1);
+  fe8_mul(&t0, &t1, &t0);
+  fe8_sq(&t1, &t0);
+  for (int i = 1; i < 10; i++) fe8_sq(&t1, &t1);
+  fe8_mul(&t1, &t1, &t0);
+  fe8_sq(&t2, &t1);
+  for (int i = 1; i < 20; i++) fe8_sq(&t2, &t2);
+  fe8_mul(&t1, &t2, &t1);
+  fe8_sq(&t1, &t1);
+  for (int i = 1; i < 10; i++) fe8_sq(&t1, &t1);
+  fe8_mul(&t0, &t1, &t0);
+  fe8_sq(&t1, &t0);
+  for (int i = 1; i < 50; i++) fe8_sq(&t1, &t1);
+  fe8_mul(&t1, &t1, &t0);
+  fe8_sq(&t2, &t1);
+  for (int i = 1; i < 100; i++) fe8_sq(&t2, &t2);
+  fe8_mul(&t1, &t2, &t1);
+  fe8_sq(&t1, &t1);
+  for (int i = 1; i < 50; i++) fe8_sq(&t1, &t1);
+  fe8_mul(&t0, &t1, &t0);
+  fe8_sq(&t0, &t0); fe8_sq(&t0, &t0);
+  fe8_mul(o, &t0, z);
+}
+
+}  // namespace tm
+
+#endif  // AVX512IFMA
