@@ -794,6 +794,11 @@ static void recode_signed(const std::array<uint8_t, 32>& s, int c, int nwin,
   }
 }
 
+// test seam: 0 = auto (vectorized when wide + IFMA), 1 = force scalar,
+// 2 = force vectorized — differential tests drive both paths via
+// tm_ed25519_msm_path (api.cc)
+int g_msm_path = 0;
+
 // Pippenger bucket MSM with signed digits and mixed (affine-niels)
 // bucket additions. The RLC caller's points are all fresh
 // decompressions (Z == 1); a non-affine input is normalized first.
@@ -841,17 +846,111 @@ void msm(ge* out, const std::vector<std::array<uint8_t, 32>>& scalars,
       fe_mul(p.T, p.X, p.Y);
     }
     fe_add(nls[i].yplusx, p.Y, p.X); fe_carry(nls[i].yplusx);
-    fe_sub(nls[i].yminusx, p.Y, p.X);
+    // carried: the vectorized bucket path broadcasts these limbs into
+    // vpmadd52 operands, which truncate at 52 bits — a loose fe_sub
+    // result would silently lose its 53rd bit there
+    fe_sub(nls[i].yminusx, p.Y, p.X); fe_carry(nls[i].yminusx);
     fe_mul(nls[i].xy2d, p.T, FE_D2);
   }
 
-  std::vector<int16_t> digits((size_t)nwin * m);
-  int top = 0;  // highest window with any nonzero digit
+  // recode with a window stride padded to a multiple of 8 so the
+  // vectorized path can always read 8 digits per point-group
+  int ngroups = (nwin + 7) / 8;
+  int nwinp = ngroups * 8;
+  std::vector<int16_t> digits((size_t)nwinp * m, 0);
+  std::vector<int16_t> maxw(m, -1);  // highest nonzero window per point
+  int top = 0;
   for (size_t i = 0; i < m; i++) {
-    recode_signed(scalars[i], c, nwin, &digits[(size_t)nwin * i]);
-    for (int w = nwin - 1; w > top; w--)
-      if (digits[(size_t)nwin * i + w]) { top = w; break; }
+    recode_signed(scalars[i], c, nwin, &digits[(size_t)nwinp * i]);
+    for (int w = nwin - 1; w >= 0; w--)
+      if (digits[(size_t)nwinp * i + w]) { maxw[i] = (int16_t)w; break; }
+    if (maxw[i] > top) top = maxw[i];
   }
+
+#ifdef TM_HAVE_FE8
+  if (g_msm_path != 1 && (m >= 128 || g_msm_path == 2)) {
+    // one window-group (8 windows' bucket arrays) at a time: per point,
+    // gather the 8 target buckets, one shared-niels signed mixed add
+    // across lanes, masked scatter back. Short scalars (maxw below the
+    // group) skip whole groups.
+    std::vector<ge> S(nwin);
+    std::vector<ge> buckets8((size_t)8 * nb);
+    fe8 d2b;
+    fe8_broadcast(&d2b, FE_D2);
+    for (int g2 = 0; g2 < ngroups; g2++) {
+      int w0 = 8 * g2;
+      if (w0 > top) {
+        for (int l = 0; l < 8 && w0 + l < nwin; l++) ge_identity(&S[w0 + l]);
+        continue;
+      }
+      for (auto& b : buckets8) ge_identity(&b);
+      for (size_t i = 0; i < m; i++) {
+        if (maxw[i] < w0) continue;
+        const int16_t* dp = &digits[(size_t)nwinp * i + w0];
+        alignas(64) uint64_t off_arr[8];
+        __mmask8 act = 0, neg = 0;
+        for (int l = 0; l < 8; l++) {
+          int d = dp[l];
+          if (d) act |= (__mmask8)(1u << l);
+          if (d < 0) { neg |= (__mmask8)(1u << l); d = -d; }
+          size_t idx = d ? (size_t)(d - 1) : 0;
+          off_arr[l] = ((size_t)l * nb + idx) * sizeof(ge);
+        }
+        if (!act) continue;
+        __m512i off = _mm512_load_si512((const void*)off_arr);
+        ge8 cur, res;
+        ge8_gather(&cur, buckets8.data(), off);
+        fe8 ypx, ymx, x2d;
+        fe8_broadcast(&ypx, nls[i].yplusx);
+        fe8_broadcast(&ymx, nls[i].yminusx);
+        fe8_broadcast(&x2d, nls[i].xy2d);
+        ge8_madd_signed(&res, &cur, &ypx, &ymx, &x2d, neg);
+        ge8_mask_scatter(buckets8.data(), act, off, &res);
+      }
+      // suffix-sum aggregation, all 8 windows of the group in lanes
+      ge8 running, sum;
+      {
+        ge id;
+        ge_identity(&id);
+        fe8_broadcast(&running.X, id.X);
+        fe8_broadcast(&running.Y, id.Y);
+        fe8_broadcast(&running.Z, id.Z);
+        fe8_broadcast(&running.T, id.T);
+        sum = running;
+      }
+      alignas(64) uint64_t lane_base[8];
+      for (int l = 0; l < 8; l++)
+        lane_base[l] = (size_t)l * nb * sizeof(ge);
+      __m512i base_off = _mm512_load_si512((const void*)lane_base);
+      for (size_t d = nb; d >= 1; d--) {
+        ge8 bkt;
+        __m512i off = _mm512_add_epi64(
+            base_off, _mm512_set1_epi64((long long)((d - 1) * sizeof(ge))));
+        ge8_gather(&bkt, buckets8.data(), off);
+        ge8_add(&running, &running, &bkt, &d2b);
+        ge8_add(&sum, &sum, &running, &d2b);
+      }
+      // extract the 8 per-window sums
+      alignas(64) uint64_t s_off[8];
+      int live = (nwin - w0 < 8) ? (nwin - w0) : 8;
+      ge spill[8];
+      for (int l = 0; l < 8; l++) s_off[l] = (size_t)l * sizeof(ge);
+      ge8_mask_scatter(spill, (__mmask8)0xFF, _mm512_load_si512((const void*)s_off),
+                       &sum);
+      for (int l = 0; l < live; l++) S[w0 + l] = spill[l];
+    }
+    // Horner combine from the top window down
+    ge acc;
+    ge_identity(&acc);
+    for (int w = top; w >= 0; w--) {
+      if (w != top)
+        for (int k = 0; k < c; k++) ge_double(&acc, &acc);
+      ge_add(&acc, &acc, &S[w]);
+    }
+    *out = acc;
+    return;
+  }
+#endif
 
   std::vector<ge> buckets(nb);
   ge acc;
@@ -861,7 +960,7 @@ void msm(ge* out, const std::vector<std::array<uint8_t, 32>>& scalars,
       for (int k = 0; k < c; k++) ge_double(&acc, &acc);
     for (auto& b : buckets) ge_identity(&b);
     for (size_t i = 0; i < m; i++) {
-      int d = digits[(size_t)nwin * i + w];
+      int d = digits[(size_t)nwinp * i + w];
       if (d > 0) ge_madd(&buckets[d - 1], &buckets[d - 1], &nls[i]);
       else if (d < 0) ge_msub(&buckets[-d - 1], &buckets[-d - 1], &nls[i]);
     }
@@ -986,6 +1085,8 @@ void ed25519_hram(const uint8_t r[32], const uint8_t pub[32],
   sha512_final(&c, digest);
   sc_reduce64(h_out, digest);
 }
+
+void ed25519_set_msm_path(int path) { g_msm_path = path; }
 
 void ed25519_decompress_batch(const uint8_t* pubs, int64_t n,
                               uint8_t* xy_out, uint8_t* ok) {

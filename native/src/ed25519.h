@@ -42,4 +42,10 @@ int ed25519_verify_batch_rlc(const uint8_t* pubs, const uint8_t* sigs,
                              const uint8_t* msgs, const uint64_t* offsets,
                              int64_t n);
 
+// Test seam for the MSM implementation choice: 0 = auto (vectorized
+// when wide and the host has AVX-512 IFMA), 1 = force scalar, 2 = force
+// vectorized. Differential tests drive both paths through it; both
+// compute identical group elements.
+void ed25519_set_msm_path(int path);
+
 }  // namespace tm

@@ -172,6 +172,138 @@ static inline void fe8_pow2523(fe8* o, const fe8* z) {
   fe8_mul(o, &t0, z);
 }
 
+// o = a + b, lane-wise, NO carry: the result can reach 2^53 per limb,
+// which vpmadd52 would silently truncate — callers MUST fe8_carry
+// before using the sum as any fe8_mul/fe8_sq operand (unlike the scalar
+// fe_add/fe_mul pair, whose u128 math tolerates loose limbs).
+static inline void fe8_add(fe8* o, const fe8* a, const fe8* b) {
+  for (int j = 0; j < 5; j++) o->v[j] = _mm512_add_epi64(a->v[j], b->v[j]);
+}
+
+static inline void fe8_carry(fe8* o);
+
+// o = a - b with the same 2p bias as the scalar fe_sub — but ALWAYS
+// carried: vpmadd52 truncates its operands to 52 bits, so unlike the
+// scalar code (whose u128 fe_mul tolerates loose < 2^53 limbs) every
+// fe8 value that can reach a multiply must stay < 2^52.
+static inline void fe8_sub(fe8* o, const fe8* a, const fe8* b) {
+  const __m512i bias0 = _mm512_set1_epi64(0xFFFFFFFFFFFDAULL);
+  const __m512i bias = _mm512_set1_epi64(0xFFFFFFFFFFFFEULL);
+  o->v[0] = _mm512_sub_epi64(_mm512_add_epi64(a->v[0], bias0), b->v[0]);
+  for (int j = 1; j < 5; j++)
+    o->v[j] = _mm512_sub_epi64(_mm512_add_epi64(a->v[j], bias), b->v[j]);
+  fe8_carry(o);
+}
+
+static inline void fe8_carry(fe8* o) {
+  __m512i m = fe8_mask51();
+  __m512i c;
+  for (int j = 0; j < 4; j++) {
+    c = _mm512_srli_epi64(o->v[j], 51);
+    o->v[j] = _mm512_and_epi64(o->v[j], m);
+    o->v[j + 1] = _mm512_add_epi64(o->v[j + 1], c);
+  }
+  c = _mm512_srli_epi64(o->v[4], 51);
+  o->v[4] = _mm512_and_epi64(o->v[4], m);
+  o->v[0] = _mm512_add_epi64(
+      o->v[0], _mm512_mullo_epi64(c, _mm512_set1_epi64(19)));
+  c = _mm512_srli_epi64(o->v[0], 51);
+  o->v[0] = _mm512_and_epi64(o->v[0], m);
+  o->v[1] = _mm512_add_epi64(o->v[1], c);
+}
+
+static inline void fe8_blend(fe8* o, __mmask8 k, const fe8* a,
+                             const fe8* b) {
+  // lane: k ? b : a
+  for (int j = 0; j < 5; j++)
+    o->v[j] = _mm512_mask_blend_epi64(k, a->v[j], b->v[j]);
+}
+
+static inline void fe8_broadcast(fe8* o, const uint64_t a[5]) {
+  for (int j = 0; j < 5; j++) o->v[j] = _mm512_set1_epi64(a[j]);
+}
+
+// 8 independent extended-Edwards points, limb-sliced like fe8
+struct ge8 {
+  fe8 X, Y, Z, T;
+};
+
+// gather/scatter a ge8 from 8 scalar `ge` structs living at byte
+// offsets `off` (per lane) from `base`; ge layout = X[5] Y[5] Z[5] T[5]
+// contiguous uint64, 160 bytes
+static inline void ge8_gather(ge8* o, const void* base, __m512i off) {
+  fe8* f[4] = {&o->X, &o->Y, &o->Z, &o->T};
+  for (int fi = 0; fi < 4; fi++)
+    for (int j = 0; j < 5; j++)
+      f[fi]->v[j] = _mm512_i64gather_epi64(
+          _mm512_add_epi64(off, _mm512_set1_epi64((fi * 5 + j) * 8)),
+          (const long long*)base, 1);
+}
+
+static inline void ge8_mask_scatter(void* base, __mmask8 k, __m512i off,
+                                    const ge8* a) {
+  const fe8* f[4] = {&a->X, &a->Y, &a->Z, &a->T};
+  for (int fi = 0; fi < 4; fi++)
+    for (int j = 0; j < 5; j++)
+      _mm512_mask_i64scatter_epi64(
+          (long long*)base, k,
+          _mm512_add_epi64(off, _mm512_set1_epi64((fi * 5 + j) * 8)),
+          f[fi]->v[j], 1);
+}
+
+// full extended add, 8 lanes (same unified formulas as scalar ge_add);
+// d2 = broadcast of FE_D2
+static inline void ge8_add(ge8* o, const ge8* p, const ge8* q,
+                           const fe8* d2) {
+  fe8 a, b, c, d, e, f, g, h, t;
+  fe8_sub(&a, &p->Y, &p->X);
+  fe8_sub(&t, &q->Y, &q->X);
+  fe8_mul(&a, &a, &t);
+  fe8_add(&b, &p->Y, &p->X); fe8_carry(&b);
+  fe8_add(&t, &q->Y, &q->X); fe8_carry(&t);
+  fe8_mul(&b, &b, &t);
+  fe8_mul(&c, &p->T, &q->T);
+  fe8_mul(&c, &c, d2);
+  fe8_mul(&d, &p->Z, &q->Z);
+  fe8_add(&d, &d, &d); fe8_carry(&d);
+  fe8_sub(&e, &b, &a);
+  fe8_sub(&f, &d, &c);
+  fe8_add(&g, &d, &c); fe8_carry(&g);
+  fe8_add(&h, &b, &a); fe8_carry(&h);
+  fe8_mul(&o->X, &e, &f);
+  fe8_mul(&o->Y, &g, &h);
+  fe8_mul(&o->Z, &f, &g);
+  fe8_mul(&o->T, &e, &h);
+}
+
+// mixed add/sub against ONE shared affine-niels point, with a per-lane
+// sign mask (neg lane k=1 -> subtract): the niels multiplier operands
+// swap and the C term flips sign, exactly the scalar ge_madd/ge_msub
+// pair fused with blends.
+static inline void ge8_madd_signed(ge8* o, const ge8* p,
+                                   const fe8* yplusx, const fe8* yminusx,
+                                   const fe8* xy2d, __mmask8 neg) {
+  fe8 qa, qb, a, b, c, d, e, f, g, h, sum, diff;
+  fe8_blend(&qa, neg, yminusx, yplusx);  // a-mult: pos->y-x, neg->y+x
+  fe8_blend(&qb, neg, yplusx, yminusx);  // b-mult: pos->y+x, neg->y-x
+  fe8_sub(&a, &p->Y, &p->X);
+  fe8_mul(&a, &a, &qa);
+  fe8_add(&b, &p->Y, &p->X); fe8_carry(&b);
+  fe8_mul(&b, &b, &qb);
+  fe8_mul(&c, &p->T, xy2d);
+  fe8_add(&d, &p->Z, &p->Z); fe8_carry(&d);
+  fe8_sub(&e, &b, &a);
+  fe8_add(&sum, &d, &c); fe8_carry(&sum);  // d + c
+  fe8_sub(&diff, &d, &c);                  // d - c
+  fe8_blend(&f, neg, &diff, &sum);  // madd: f = d - c; msub: f = d + c
+  fe8_blend(&g, neg, &sum, &diff);  // madd: g = d + c; msub: g = d - c
+  fe8_add(&h, &b, &a); fe8_carry(&h);
+  fe8_mul(&o->X, &e, &f);
+  fe8_mul(&o->Y, &g, &h);
+  fe8_mul(&o->Z, &f, &g);
+  fe8_mul(&o->T, &e, &h);
+}
+
 }  // namespace tm
 
 #endif  // AVX512IFMA

@@ -131,12 +131,11 @@ def _device_loop(st: _DaemonState, *, accept_cpu: bool, probe_timeout: float,
 
     enable_cache()
     if accept_cpu:
-        # win the override war with the TPU-tunnel plugin, which re-forces
-        # jax_platforms at interpreter startup (see tests/conftest.py) —
-        # a CPU daemon must never dial the tunnel
-        import jax
+        # a CPU daemon must never dial the tunnel; die visibly if the
+        # pin cannot be applied (strict) instead of probing unpinned
+        from tendermint_tpu.ops.gateway import pin_jax_cpu
 
-        jax.config.update("jax_platforms", "cpu")
+        pin_jax_cpu(strict=True)
     while not st.stop.is_set():
         st.status = "probing"
         if accept_cpu:

@@ -118,14 +118,28 @@ def _resolve_platform_locked() -> str | None:
         return _platform_cache["v"]
     p = devd.subprocess_probe(45.0)
     if p is None:
-        try:
-            import jax
-
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:  # noqa: BLE001 — backend may already be up
-            logger.warning("could not pin jax to cpu after failed probe")
+        pin_jax_cpu()
     _platform_cache["v"] = p
     return p
+
+
+def pin_jax_cpu(strict: bool = False) -> None:
+    """Force this process's jax onto the CPU backend. The environment's
+    TPU-tunnel plugin re-forces jax_platforms at interpreter startup,
+    overriding JAX_PLATFORMS=cpu — so any process that must never dial
+    the (possibly wedged) tunnel calls this before its first jnp use.
+
+    strict=True re-raises on failure: callers whose whole safety story
+    is "this process can never touch the tunnel" (the CPU device
+    daemon) must die visibly rather than proceed unpinned."""
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — backend may already be up
+        if strict:
+            raise
+        logger.warning("could not pin jax to cpu")
 
 
 def set_platform(platform: str | None) -> None:

@@ -439,6 +439,60 @@ class TestNativeRLCBatchVerify:
         out = self._check_parity(self._items(128))
         assert out == [True] * 128
 
+    def test_rlc_fast_path_accepts_directly(self):
+        """The combined equation itself must ACCEPT all-valid batches.
+        Verdict-parity tests can't see a silently-broken MSM: a wrong
+        combined point just rejects, and the per-item fallback hides it
+        behind correct (but slow) verdicts. Sizes straddle the
+        vectorized path's group boundaries and its m>=128 gate."""
+        import ctypes
+
+        import numpy as np
+
+        from tendermint_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        lib = native.get_lib()
+
+        def rlc(items):
+            pubs = np.frombuffer(b"".join(p for p, _, _ in items), np.uint8)
+            sigs = np.frombuffer(b"".join(s for _, _, s in items), np.uint8)
+            data, offsets = native._concat([m for _, m, _ in items])
+            return lib.tm_ed25519_verify_batch_rlc(
+                native._as_u8p(pubs), native._as_u8p(sigs),
+                native._as_u8p(data),
+                offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                len(items),
+            )
+
+        try:
+            # 1 = scalar MSM, 2 = vectorized; both must accept every size,
+            # including m < 128 shapes the auto gate never vectorizes
+            for path in (1, 2, 0):
+                lib.tm_ed25519_msm_path(path)
+                for n in (16, 63, 64, 65, 200, 512):
+                    assert rlc(self._items(n)) == 1, (
+                        f"RLC fast path rejected a valid batch "
+                        f"(n={n}, msm_path={path})"
+                    )
+                # soundness through the same forced path: one forged lane
+                # must reject the combined equation. Catches a degenerate
+                # MSM (e.g. buckets never accumulating -> identity), which
+                # the acceptance assertions above cannot see.
+                for n in (64, 200):
+                    items = self._items(n)
+                    sig = bytearray(items[n // 2][2])
+                    sig[5] ^= 0x20
+                    items[n // 2] = (
+                        items[n // 2][0], items[n // 2][1], bytes(sig)
+                    )
+                    assert rlc(items) == 0, (
+                        f"RLC accepted a forged lane (n={n}, msm_path={path})"
+                    )
+        finally:
+            lib.tm_ed25519_msm_path(0)
+
     def test_every_adversarial_lane_shape(self):
         from tendermint_tpu.crypto import ed25519 as ed
 
