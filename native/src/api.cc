@@ -24,8 +24,29 @@ void tm_sha256_batch(const uint8_t* data, const uint64_t* offsets, int64_t n,
 
 void tm_ripemd160_batch(const uint8_t* data, const uint64_t* offsets,
                         int64_t n, uint8_t* out /* n*20 */) {
+#if defined(__AVX512F__)
+  // group equal-length runs and hash 16 per call in SIMD lanes — the
+  // PartSet path (equal 64 KB parts) lands here almost entirely
+  int64_t i = 0;
+  while (i < n) {
+    uint64_t len = offsets[i + 1] - offsets[i];
+    int64_t j = i + 1;
+    while (j < n && offsets[j + 1] - offsets[j] == len) j++;
+    while (j - i >= 16) {
+      const uint8_t* msgs[16];
+      uint8_t lanes[16 * 20];
+      for (int l = 0; l < 16; l++) msgs[l] = data + offsets[i + l];
+      ripemd160_x16(msgs, (size_t)len, lanes);
+      std::memcpy(out + 20 * i, lanes, sizeof(lanes));
+      i += 16;
+    }
+    for (; i < j; i++)
+      ripemd160(data + offsets[i], len, out + 20 * i);
+  }
+#else
   for (int64_t i = 0; i < n; i++)
     ripemd160(data + offsets[i], offsets[i + 1] - offsets[i], out + 20 * i);
+#endif
 }
 
 // ---------------------------------------------------------------------------

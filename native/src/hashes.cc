@@ -2,6 +2,10 @@
 
 #include <cstring>
 
+#if defined(__AVX512F__)
+#include <immintrin.h>
+#endif
+
 namespace tm {
 
 // ---------------------------------------------------------------------------
@@ -292,5 +296,118 @@ void ripemd160(const uint8_t* data, size_t len, uint8_t out[20]) {
     out[4 * i + 3] = uint8_t(h[i] >> 24);
   }
 }
+
+// ---------------------------------------------------------------------------
+// RIPEMD-160, 16 independent equal-length messages per call (AVX-512:
+// 16 uint32 lanes; vprolvd covers the per-step rotate amounts and one
+// vpternlogd covers each round's boolean). The PartSet hot path hashes
+// 64 KB parts — equal lengths, identical block counts and padding
+// layout, so every lane stays in lockstep the whole way.
+// ---------------------------------------------------------------------------
+#if defined(__AVX512F__)
+
+// GCC 12's masked-intrinsic fallback paths in avx512fintrin.h trip
+// -Wmaybe-uninitialized on _mm512_rolv_epi32's pass-through operand —
+// a known header false positive; keep the project build warning-clean
+#pragma GCC diagnostic push
+#pragma GCC diagnostic ignored "-Wmaybe-uninitialized"
+#pragma GCC diagnostic ignored "-Wuninitialized"
+
+namespace {
+
+inline __m512i vf_rmd(int j, __m512i x, __m512i y, __m512i z) {
+  // truth tables for imm8[(x<<2)|(y<<1)|z]
+  switch (j / 16) {
+    case 0: return _mm512_ternarylogic_epi32(x, y, z, 0x96);  // x^y^z
+    case 1: return _mm512_ternarylogic_epi32(x, y, z, 0xCA);  // (x&y)|(~x&z)
+    case 2: return _mm512_ternarylogic_epi32(x, y, z, 0x59);  // (x|~y)^z
+    case 3: return _mm512_ternarylogic_epi32(x, y, z, 0xE4);  // (x&z)|(y&~z)
+    default: return _mm512_ternarylogic_epi32(x, y, z, 0x2D);  // x^(y|~z)
+  }
+}
+
+inline void rmd160_block_x16(__m512i h[5], const uint8_t* const p[16]) {
+  alignas(64) uint32_t xbuf[16][16];  // [word][lane]
+  for (int l = 0; l < 16; l++) {
+    const uint8_t* q = p[l];
+    for (int i = 0; i < 16; i++) {
+      uint32_t w;
+      std::memcpy(&w, q + 4 * i, 4);  // little-endian hosts only (x86)
+      xbuf[i][l] = w;
+    }
+  }
+  __m512i x[16];
+  for (int i = 0; i < 16; i++) x[i] = _mm512_load_si512(&xbuf[i][0]);
+  __m512i al = h[0], bl = h[1], cl = h[2], dl = h[3], el = h[4];
+  __m512i ar = h[0], br = h[1], cr = h[2], dr = h[3], er = h[4];
+  for (int j = 0; j < 80; j++) {
+    __m512i t = _mm512_add_epi32(
+        _mm512_add_epi32(al, vf_rmd(j, bl, cl, dl)),
+        _mm512_add_epi32(x[R1[j]], _mm512_set1_epi32((int)KL[j / 16])));
+    t = _mm512_add_epi32(_mm512_rolv_epi32(t, _mm512_set1_epi32(S1[j])), el);
+    al = el; el = dl; dl = _mm512_rolv_epi32(cl, _mm512_set1_epi32(10));
+    cl = bl; bl = t;
+    t = _mm512_add_epi32(
+        _mm512_add_epi32(ar, vf_rmd(79 - j, br, cr, dr)),
+        _mm512_add_epi32(x[R2[j]], _mm512_set1_epi32((int)KR[j / 16])));
+    t = _mm512_add_epi32(_mm512_rolv_epi32(t, _mm512_set1_epi32(S2[j])), er);
+    ar = er; er = dr; dr = _mm512_rolv_epi32(cr, _mm512_set1_epi32(10));
+    cr = br; br = t;
+  }
+  __m512i t = _mm512_add_epi32(h[1], _mm512_add_epi32(cl, dr));
+  h[1] = _mm512_add_epi32(h[2], _mm512_add_epi32(dl, er));
+  h[2] = _mm512_add_epi32(h[3], _mm512_add_epi32(el, ar));
+  h[3] = _mm512_add_epi32(h[4], _mm512_add_epi32(al, br));
+  h[4] = _mm512_add_epi32(h[0], _mm512_add_epi32(bl, cr));
+  h[0] = t;
+}
+
+}  // namespace
+
+void ripemd160_x16(const uint8_t* const msgs[16], size_t len,
+                   uint8_t* out /* 16*20, lane-major */) {
+  __m512i h[5];
+  static const uint32_t IV[5] = {0x67452301, 0xEFCDAB89, 0x98BADCFE,
+                                 0x10325476, 0xC3D2E1F0};
+  for (int i = 0; i < 5; i++) h[i] = _mm512_set1_epi32((int)IV[i]);
+  size_t full = len / 64;
+  const uint8_t* p[16];
+  for (size_t b = 0; b < full; b++) {
+    for (int l = 0; l < 16; l++) p[l] = msgs[l] + 64 * b;
+    rmd160_block_x16(h, p);
+  }
+  // padded tail: identical layout across lanes (same length)
+  size_t rem = len - 64 * full;
+  size_t padded = (rem + 9 <= 64) ? 64 : 128;
+  uint8_t tails[16][128];
+  uint64_t bits = uint64_t(len) * 8;
+  for (int l = 0; l < 16; l++) {
+    std::memcpy(tails[l], msgs[l] + 64 * full, rem);
+    tails[l][rem] = 0x80;
+    std::memset(tails[l] + rem + 1, 0, padded - rem - 1 - 8);
+    for (int i = 0; i < 8; i++)
+      tails[l][padded - 8 + i] = uint8_t(bits >> (8 * i));
+  }
+  for (int l = 0; l < 16; l++) p[l] = tails[l];
+  rmd160_block_x16(h, p);
+  if (padded == 128) {
+    for (int l = 0; l < 16; l++) p[l] = tails[l] + 64;
+    rmd160_block_x16(h, p);
+  }
+  alignas(64) uint32_t hs[5][16];
+  for (int i = 0; i < 5; i++) _mm512_store_si512(&hs[i][0], h[i]);
+  for (int l = 0; l < 16; l++)
+    for (int i = 0; i < 5; i++) {
+      uint32_t v = hs[i][l];
+      out[20 * l + 4 * i] = uint8_t(v);
+      out[20 * l + 4 * i + 1] = uint8_t(v >> 8);
+      out[20 * l + 4 * i + 2] = uint8_t(v >> 16);
+      out[20 * l + 4 * i + 3] = uint8_t(v >> 24);
+    }
+}
+
+#pragma GCC diagnostic pop
+
+#endif  // __AVX512F__
 
 }  // namespace tm

@@ -12,6 +12,12 @@ void sha256(const uint8_t* data, size_t len, uint8_t out[32]);
 void sha512(const uint8_t* data, size_t len, uint8_t out[64]);
 void ripemd160(const uint8_t* data, size_t len, uint8_t out[20]);
 
+#if defined(__AVX512F__)
+// 16 equal-length messages hashed in lockstep (one uint32 lane each);
+// out is lane-major, 16*20 bytes. Bit-identical to 16 scalar calls.
+void ripemd160_x16(const uint8_t* const msgs[16], size_t len, uint8_t* out);
+#endif
+
 // streaming sha512 for H(R || A || M) without concatenation copies
 struct Sha512Ctx {
   uint64_t h[8];

@@ -580,8 +580,12 @@ class Hasher:
     integer rotate/xor — no MXU help), so the device's only parallel
     axis is across parts, 16-256 wide at production shapes — far under
     VPU width. Modeled local-chip ceiling is O(one CPU core); OpenSSL
-    already sustains ~200 MB/s/core with zero transfer cost. Unlike the
-    signature Verifier (11x on TPU), hashing stays on CPU.
+    already sustains ~200 MB/s/core with zero transfer cost, and the
+    host exploits the same across-parts axis directly: the CPU leaf
+    path batches equal-length parts 16 to an AVX-512 call (native
+    ripemd160_x16, ~1.2 GB/s — benches/bench_partset.py: 4.9x the
+    sequential loop). Unlike the signature Verifier, hashing stays on
+    the host — which is where the parallelism pays.
     TENDERMINT_TPU_HASHES=1 (or use_tpu=True) remains for chip-rich/
     core-poor hosts and genuinely wide batches (e.g. 16k+ small
     leaves, where the measured gap narrows to 6x)."""
@@ -618,10 +622,19 @@ class Hasher:
             except Exception:
                 logger.exception("TPU part hashing failed; falling back to CPU")
                 self._tpu_ok = False
-        from tendermint_tpu.crypto.hashing import ripemd160
-
         with self._mtx:
             self._stats["cpu_leaves"] += len(chunks)
+        from tendermint_tpu import native
+
+        # ready(), not available(): this sits on the consensus hot path,
+        # and available() may synchronously run a ~minutes-long native
+        # build on a fresh checkout (same rule as the verify fallback)
+        if len(chunks) >= 2 and native.ready():
+            # 16 equal-length parts per SIMD call (native ripemd160_x16):
+            # ~5x the per-part OpenSSL loop at production shapes
+            return native.ripemd160_batch(chunks)
+        from tendermint_tpu.crypto.hashing import ripemd160
+
         return [ripemd160(c) for c in chunks]
 
     def tx_merkle_root(self, txs: list[bytes]) -> bytes:

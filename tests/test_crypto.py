@@ -149,6 +149,26 @@ class TestHashing:
         data = bytes(range(256)) * 300
         assert _ripemd160_py(data) == ripemd160(data)
 
+    def test_ripemd160_native_batch_parity(self):
+        """The native batch (16-lane SIMD groups + scalar remainder —
+        the PartSet leaf-hash path) must be bit-identical to the scalar
+        reference at every padding shape and across mixed-length
+        grouping boundaries."""
+        import random
+
+        from tendermint_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        rng = random.Random(71)
+        msgs = []
+        for ln in (0, 1, 55, 56, 63, 64, 65, 119, 120, 127, 128, 4096):
+            # 17 per length: one full 16-lane group plus a scalar leftover
+            msgs.extend(rng.randbytes(ln) for _ in range(17))
+        rng.shuffle(msgs)
+        got = native.ripemd160_batch(msgs)
+        assert got == [ripemd160(m) for m in msgs]
+
     def test_sha256(self):
         assert (
             sha256(b"abc").hex()
