@@ -129,10 +129,7 @@ void tm_merkle_root(const uint8_t* leaf_digests, int64_t n,
 void tm_ed25519_verify_batch(const uint8_t* pubs, const uint8_t* sigs,
                              const uint8_t* msgs, const uint64_t* offsets,
                              int64_t n, uint8_t* out) {
-  for (int64_t i = 0; i < n; i++)
-    out[i] = (uint8_t)ed25519_verify(
-        pubs + 32 * i, msgs + offsets[i], offsets[i + 1] - offsets[i],
-        sigs + 64 * i);
+  ed25519_verify_batch_items(pubs, sigs, msgs, offsets, n, out);
 }
 
 // random-linear-combination batch verification: 1 iff ALL n signatures

@@ -979,6 +979,18 @@ void msm(ge* out, const std::vector<std::array<uint8_t, 32>>& scalars,
 
 }  // namespace
 
+// defined with the per-item verification family below
+static int cheap_sig_checks(const uint8_t sig[64]);
+static void collect_unique_a(const uint8_t* pubs, int64_t n,
+                             const uint8_t* lane_live, NegACache& cache,
+                             std::vector<size_t>& a_slot,
+                             std::vector<size_t>& uniq_slots,
+                             std::vector<const uint8_t*>& encs);
+static void backfill_neg_a(NegACache& cache,
+                           const std::vector<size_t>& uniq_slots,
+                           const ge* dec, const uint8_t* dec_ok,
+                           std::vector<uint8_t>& slot_ok);
+
 int ed25519_verify_batch_rlc(const uint8_t* pubs, const uint8_t* sigs,
                              const uint8_t* msgs, const uint64_t* offsets,
                              int64_t n) {
@@ -992,40 +1004,28 @@ int ed25519_verify_batch_rlc(const uint8_t* pubs, const uint8_t* sigs,
   // to the exact per-item loop; see os_random above)
   std::vector<uint8_t> zbuf(16 * (size_t)n);
   if (!os_random(zbuf.data(), zbuf.size())) return 0;
-  // validator keys repeat across a commit: decompress each unique A
-  // once. Decompression targets (every R + each unique A) collect
-  // first, then decompress together so the power chains run 8-wide.
+  // cheap byte-range rejects before ANY curve work (the per-item floor
+  // does the same, so malformed floods never reach a power chain)
+  for (int64_t i = 0; i < n; i++)
+    if (!cheap_sig_checks(sigs + 64 * i)) return 0;
+  // decompression targets — every R plus each unique A (validator keys
+  // repeat across a commit) — collect into ONE batch call so the 8-wide
+  // power-chain groups stay full even for tiny commits
   NegACache neg_a_cache((size_t)n);
-  std::vector<const uint8_t*> encs;
-  encs.reserve((size_t)n + 64);
-  std::vector<size_t> a_slot((size_t)n);
-  std::vector<size_t> uniq_slots;
-  for (int64_t i = 0; i < n; i++) {
-    const uint8_t* sig = sigs + 64 * i;
-    if (bytes_ge(sig + 32, LBYTES, 32)) return 0;  // s >= L (strict)
-    encs.push_back(sig);                           // R_i
-  }
-  ge placeholder;
-  ge_identity(&placeholder);
-  for (int64_t i = 0; i < n; i++) {
-    const uint8_t* pub = pubs + 32 * i;
-    bool found;
-    size_t slot = neg_a_cache.slot_for(pub, &found);
-    if (!found) {
-      neg_a_cache.put(slot, pub, placeholder);  // filled after decompress
-      uniq_slots.push_back(slot);
-      encs.push_back(pub);
-    }
-    a_slot[i] = slot;
-  }
-  size_t n_pts = encs.size();
-  std::vector<ge> dec(n_pts);
-  std::vector<uint8_t> dec_ok(n_pts);
-  ge_from_bytes_batch(dec.data(), dec_ok.data(), encs.data(), n_pts);
-  for (size_t i = 0; i < n_pts; i++)
-    if (!dec_ok[i]) return 0;  // invalid/non-canonical R or A
-  for (size_t k = 0; k < uniq_slots.size(); k++)
-    ge_neg(&neg_a_cache.vals[uniq_slots[k]], &dec[(size_t)n + k]);
+  std::vector<const uint8_t*> encs((size_t)n);
+  for (int64_t i = 0; i < n; i++) encs[i] = sigs + 64 * i;  // R_i
+  std::vector<size_t> a_slot, uniq_slots;
+  collect_unique_a(pubs, n, nullptr, neg_a_cache, a_slot, uniq_slots, encs);
+  std::vector<ge> dec(encs.size());
+  std::vector<uint8_t> dec_ok(encs.size());
+  ge_from_bytes_batch(dec.data(), dec_ok.data(), encs.data(), encs.size());
+  for (int64_t i = 0; i < n; i++)
+    if (!dec_ok[i]) return 0;  // invalid R
+  std::vector<uint8_t> slot_ok;
+  backfill_neg_a(neg_a_cache, uniq_slots, dec.data() + n, dec_ok.data() + n,
+                 slot_ok);
+  for (int64_t i = 0; i < n; i++)
+    if (!slot_ok[a_slot[i]]) return 0;  // invalid A
   uint8_t zsum_s[32] = {0};
   for (int64_t i = 0; i < n; i++) {
     const uint8_t* sig = sigs + 64 * i;
@@ -1111,32 +1111,120 @@ int ed25519_decompress(const uint8_t pub[32], uint8_t x_out[32],
   return 1;
 }
 
+// byte-range rejects that need no curve arithmetic: s < L (strict
+// RFC 8032) and canonical R.y (matches crypto/ed25519.verify). These
+// run BEFORE any decompression on every path, so a flood of malformed
+// signatures costs two 32-byte compares per lane, never a power chain.
+static int cheap_sig_checks(const uint8_t sig[64]) {
+  if (bytes_ge(sig + 32, LBYTES, 32)) return 0;  // s >= L
+  uint8_t rm[32];
+  std::memcpy(rm, sig, 32);
+  rm[31] &= 0x7f;
+  static const uint8_t PB[32] = {
+      0xed, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+      0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+      0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x7f};
+  return !bytes_ge(rm, PB, 32);  // non-canonical R.y
+}
+
+// Unique-pubkey dedup bookkeeping, shared by the RLC verifier and the
+// per-item batch: append each first-seen pubkey among the live lanes to
+// `encs` (so the CALLER can pack them into one ge_from_bytes_batch call
+// alongside any other points — the RLC path adds all its R points to
+// the same call, keeping the 8-wide groups full even for tiny commits)
+// and record lane -> cache slot. backfill_neg_a then consumes the
+// decompression results for the appended range.
+static void collect_unique_a(const uint8_t* pubs, int64_t n,
+                             const uint8_t* lane_live, NegACache& cache,
+                             std::vector<size_t>& a_slot,
+                             std::vector<size_t>& uniq_slots,
+                             std::vector<const uint8_t*>& encs) {
+  a_slot.assign((size_t)n, 0);
+  ge placeholder;
+  ge_identity(&placeholder);
+  for (int64_t i = 0; i < n; i++) {
+    if (lane_live && !lane_live[i]) continue;
+    const uint8_t* pub = pubs + 32 * i;
+    bool found;
+    size_t slot = cache.slot_for(pub, &found);
+    if (!found) {
+      cache.put(slot, pub, placeholder);  // filled by backfill_neg_a
+      uniq_slots.push_back(slot);
+      encs.push_back(pub);
+    }
+    a_slot[i] = slot;
+  }
+}
+
+// dec/dec_ok point at the decompression results for collect_unique_a's
+// appended range (in order); negates each valid key into the cache and
+// records per-slot validity.
+static void backfill_neg_a(NegACache& cache,
+                           const std::vector<size_t>& uniq_slots,
+                           const ge* dec, const uint8_t* dec_ok,
+                           std::vector<uint8_t>& slot_ok) {
+  slot_ok.assign(cache.vals.size(), 0);
+  for (size_t k = 0; k < uniq_slots.size(); k++) {
+    slot_ok[uniq_slots[k]] = dec_ok[k];
+    if (dec_ok[k]) ge_neg(&cache.vals[uniq_slots[k]], &dec[k]);
+  }
+}
+
+// shared tail of single and batch per-item verification: everything
+// after the cheap checks pass and A is decompressed and negated
+static int verify_with_neg_a(const ge* neg_a, const uint8_t* pub,
+                             const uint8_t* msg, uint64_t msg_len,
+                             const uint8_t sig[64]) {
+  uint8_t h[32];
+  ed25519_hram(sig, pub, msg, msg_len, h);
+  ge p;
+  ge_double_scalarmult(&p, sig + 32, neg_a, h);  // [s]B + [h](-A)
+  uint8_t out[32];
+  ge_to_bytes(out, &p);
+  return std::memcmp(out, sig, 32) == 0;
+}
+
 int ed25519_verify(const uint8_t pub[32], const uint8_t* msg, uint64_t msg_len,
                    const uint8_t sig[64]) {
-  // reject s >= L (strict RFC 8032)
-  if (bytes_ge(sig + 32, LBYTES, 32)) return 0;
-  // reject non-canonical R.y (matches crypto/ed25519.verify semantics)
-  {
-    uint8_t rm[32];
-    std::memcpy(rm, sig, 32);
-    rm[31] &= 0x7f;
-    static const uint8_t PB[32] = {
-        0xed, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
-        0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
-        0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x7f};
-    if (bytes_ge(rm, PB, 32)) return 0;
-  }
+  if (!cheap_sig_checks(sig)) return 0;
   ge a;
   if (!ge_from_bytes(&a, pub)) return 0;
   ge neg_a;
   ge_neg(&neg_a, &a);
-  uint8_t h[32];
-  ed25519_hram(sig, pub, msg, msg_len, h);
-  ge p;
-  ge_double_scalarmult(&p, sig + 32, &neg_a, h);  // [s]B + [h](-A)
-  uint8_t out[32];
-  ge_to_bytes(out, &p);
-  return std::memcmp(out, sig, 32) == 0;
+  return verify_with_neg_a(&neg_a, pub, msg, msg_len, sig);
+}
+
+// per-item verdicts for a whole batch: identical lane semantics to n
+// ed25519_verify calls, but the A decompressions dedupe across repeated
+// validator keys and run 8-wide (ge_from_bytes_batch). This is the
+// exact-verdict floor under the RLC bisection — i.e. the adversarial
+// dense-flood path — so its constant factor bounds flood cost; lanes
+// failing the byte-range checks never contribute curve work at all.
+void ed25519_verify_batch_items(const uint8_t* pubs, const uint8_t* sigs,
+                                const uint8_t* msgs, const uint64_t* offsets,
+                                int64_t n, uint8_t* out) {
+  if (n <= 0) return;
+  std::vector<uint8_t> live((size_t)n);
+  for (int64_t i = 0; i < n; i++) {
+    live[i] = (uint8_t)cheap_sig_checks(sigs + 64 * i);
+    out[i] = 0;
+  }
+  NegACache cache((size_t)n);
+  std::vector<const uint8_t*> encs;
+  std::vector<size_t> a_slot, uniq_slots;
+  collect_unique_a(pubs, n, live.data(), cache, a_slot, uniq_slots, encs);
+  std::vector<ge> dec(encs.size());
+  std::vector<uint8_t> dec_ok(encs.size());
+  if (!encs.empty())
+    ge_from_bytes_batch(dec.data(), dec_ok.data(), encs.data(), encs.size());
+  std::vector<uint8_t> slot_ok;
+  backfill_neg_a(cache, uniq_slots, dec.data(), dec_ok.data(), slot_ok);
+  for (int64_t i = 0; i < n; i++) {
+    if (!live[i] || !slot_ok[a_slot[i]]) continue;  // verdict stays 0
+    out[i] = (uint8_t)verify_with_neg_a(
+        &cache.vals[a_slot[i]], pubs + 32 * i, msgs + offsets[i],
+        offsets[i + 1] - offsets[i], sigs + 64 * i);
+  }
 }
 
 }  // namespace tm

@@ -17,6 +17,13 @@ namespace tm {
 int ed25519_verify(const uint8_t pub[32], const uint8_t* msg, uint64_t msg_len,
                    const uint8_t sig[64]);
 
+// Per-item verdicts for a batch — lane-identical to n ed25519_verify
+// calls, with A decompressions deduped across repeated keys and run
+// 8-wide when the host has AVX-512 IFMA.
+void ed25519_verify_batch_items(const uint8_t* pubs, const uint8_t* sigs,
+                                const uint8_t* msgs, const uint64_t* offsets,
+                                int64_t n, uint8_t* out);
+
 // Decompress a public key to affine (x, y) field elements serialized as
 // 32-byte little-endian canonical values. Returns 1 on success.
 // Batch variant: xy_out[i] = x||y (2x32 LE bytes), ok[i] = 1 on
