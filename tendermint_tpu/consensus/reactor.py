@@ -130,11 +130,18 @@ class PeerState:
                 ba.set_index(index, True)
 
     def _get_vote_bit_array(self, height: int, round_: int, type_: int) -> BitArray | None:
-        """reactor.go:813-850."""
+        """reactor.go:813-850 — except the round-equal branch must not
+        SHADOW the catchup branch with a None: for a peer lagging far
+        behind, nothing ever ensures bit arrays at the PEER's height
+        (gossip ensures them at OUR heights), so prs.precommits is None
+        there and the stored-commit catchup picker would never find a
+        tracking array — the round-4 chaos-soak stall."""
         prs = self.prs
         if prs.height == height:
             if prs.round_ == round_:
-                return prs.prevotes if type_ == VOTE_TYPE_PREVOTE else prs.precommits
+                ba = prs.prevotes if type_ == VOTE_TYPE_PREVOTE else prs.precommits
+                if ba is not None:
+                    return ba
             if prs.catchup_commit_round == round_ and type_ == VOTE_TYPE_PRECOMMIT:
                 return prs.catchup_commit
             if prs.proposal_pol_round == round_ and type_ == VOTE_TYPE_PREVOTE:
@@ -170,8 +177,14 @@ class PeerState:
             if prs.catchup_commit_round == round_:
                 return
             prs.catchup_commit_round = round_
+            # alias the live precommit array only when it EXISTS; a
+            # far-behind peer's mirror has none at its own height, and
+            # aliasing None here left the catchup picker with no
+            # tracking array at all (it must be a fresh BitArray then)
             prs.catchup_commit = (
-                prs.precommits if prs.round_ == round_ else BitArray(num_validators)
+                prs.precommits
+                if prs.round_ == round_ and prs.precommits is not None
+                else BitArray(num_validators)
             )
 
     def pick_vote_to_send(self, vote_set) -> object | None:

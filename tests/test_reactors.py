@@ -230,6 +230,67 @@ def test_fast_sync_catches_up_and_switches():
         stop_net([node_a, node_b], switches)
 
 
+def test_consensus_catchup_of_behind_peer_on_live_chain():
+    """A node far behind that is ALREADY in consensus mode (no fast
+    sync) must catch up through the gossip catch-up branches — block
+    parts from the peer's store (reactor.go:494-535) and stored-commit
+    precommits (reactor.go:637-645) — while the chain KEEPS MOVING.
+    This is the safety net under fast-sync's racy IsCaughtUp
+    switchover: a restart that flips to consensus mode too early (seen
+    in round-4 chaos soaks) must still converge, not stall."""
+    doc, pvs = make_genesis(1)
+    node_a = make_node(doc, pvs[0])
+    node_b = make_node(doc, None)  # non-validator observer
+
+    def init(i, sw):
+        node = (node_a, node_b)[i]
+        con_r = ConsensusReactor(node.cs, fast_sync=False)
+        con_r.set_event_switch(node.evsw)
+        sw.add_reactor("CONSENSUS", con_r)
+        from tendermint_tpu.p2p.node_info import NodeInfo, default_version
+
+        sw.set_node_info(
+            NodeInfo(
+                pub_key=sw.node_priv_key.pub_key(),
+                moniker=f"node{i}",
+                network=TEST_CHAIN_ID,
+                version=default_version("test"),
+            )
+        )
+        return sw
+
+    node_a.subscribe_blocks()
+    node_b.subscribe_blocks()
+    from tendermint_tpu.p2p import Switch, connect2_switches
+
+    switches = [init(i, Switch()) for i in range(2)]
+    for sw in switches:
+        sw.start()
+    try:
+        # A builds a head start alone — and KEEPS COMMITTING throughout
+        assert wait_until(lambda: node_a.store.height() >= 6, timeout=60)
+        connect2_switches(switches, 0, 1)
+        # Phase 1 — live chain: B must make sustained catch-up progress
+        # (the round-4 chaos stall was ZERO progress). A at test cadence
+        # commits far faster than any real chain, so convergence isn't
+        # asserted here — only that catch-up keeps moving.
+        assert wait_until(
+            lambda: node_b.store.height() >= 30, timeout=60
+        ), f"B stalled at {node_b.store.height()}, A at {node_a.store.height()}"
+        # Phase 2 — production pauses (real chains commit ~1/s; catch-up
+        # is ~10x that): B must fully converge to A's tip.
+        node_a.cs.stop()
+        target = node_a.store.height()
+        assert wait_until(
+            lambda: node_b.store.height() >= target, timeout=120
+        ), f"B stalled at {node_b.store.height()}, target {target}"
+        got = node_b.store.load_block(3)
+        want = node_a.store.load_block(3)
+        assert got is not None and got.hash() == want.hash()
+    finally:
+        stop_net([node_a, node_b], switches)
+
+
 def test_fast_sync_rides_the_tpu_gateway(monkeypatch):
     """Regression: fast sync with the gateway wired (as node/node.py wires
     it) must actually route commit signatures AND part hashing through the
