@@ -395,10 +395,14 @@ class Switch(BaseService):
 
 
 def make_connected_switches(
-    n: int, init_switch, connect=None
+    n: int, init_switch, connect=None, switch_factory=None
 ) -> list[Switch]:
-    """n started switches wired pairwise over in-process pipes."""
-    switches = [init_switch(i, Switch()) for i in range(n)]
+    """n started switches wired pairwise over in-process pipes.
+    switch_factory overrides plain Switch() construction (e.g. to set a
+    PeerConfig with transport fuzzing, switch.go:502-547's variants)."""
+    if switch_factory is None:
+        switch_factory = Switch
+    switches = [init_switch(i, switch_factory()) for i in range(n)]
     for sw in switches:
         sw.start()
     if connect is None:

@@ -87,7 +87,7 @@ def make_node(doc: GenesisDoc, pv, app=None) -> Node:
     return Node(cs, evsw, mempool, store, state)
 
 
-def start_consensus_net(n: int, app_factory=None):
+def start_consensus_net(n: int, app_factory=None, switch_factory=None):
     doc, pvs = make_genesis(n)
     nodes = [make_node(doc, pvs[i], app_factory() if app_factory else None)
              for i in range(n)]
@@ -113,7 +113,7 @@ def start_consensus_net(n: int, app_factory=None):
         )
         return sw
 
-    switches = make_connected_switches(n, init)
+    switches = make_connected_switches(n, init, switch_factory=switch_factory)
     return nodes, switches
 
 
@@ -228,6 +228,32 @@ def test_fast_sync_catches_up_and_switches():
         assert wait_until(lambda: not con_r_b.fast_sync, timeout=30)
     finally:
         stop_net([node_a, node_b], switches)
+
+
+@pytest.mark.slow
+def test_reactor_net_commits_under_fuzzed_transport():
+    """4 validators whose every p2p stream is wrapped in the chaos fuzz
+    layer (random per-op delays, p2p/fuzz.py — the reference's
+    FuzzedConnection): consensus must still commit and agree. Guards the
+    timeout schedule and gossip against a slow, jittery transport."""
+    from tendermint_tpu.p2p import Switch
+    from tendermint_tpu.p2p.peer import PeerConfig
+
+    def fuzzy_switch():
+        return Switch(peer_config=PeerConfig(
+            fuzz=True,
+            fuzz_config={"prob_sleep": 0.2, "max_delay": 0.03, "seed": 7},
+        ))
+
+    nodes, switches = start_consensus_net(4, switch_factory=fuzzy_switch)
+    try:
+        assert wait_until(
+            lambda: all(len(nd.blocks) >= 3 for nd in nodes), timeout=90
+        ), [len(nd.blocks) for nd in nodes]
+        h2 = [nd.store.load_block(2).hash() for nd in nodes]
+        assert len(set(h2)) == 1
+    finally:
+        stop_net(nodes, switches)
 
 
 def test_consensus_catchup_of_behind_peer_on_live_chain():
