@@ -87,8 +87,12 @@ def make_node(doc: GenesisDoc, pv, app=None) -> Node:
     return Node(cs, evsw, mempool, store, state)
 
 
-def start_consensus_net(n: int, app_factory=None, switch_factory=None):
-    doc, pvs = make_genesis(n)
+def start_consensus_net(n: int, app_factory=None, switch_factory=None,
+                        genesis=None):
+    """genesis=(doc, pvs) overrides make_genesis(n) — e.g. a doc whose
+    validator set covers only some of the n nodes (the rest run as full
+    nodes until a val-tx adds them)."""
+    doc, pvs = genesis if genesis is not None else make_genesis(n)
     nodes = [make_node(doc, pvs[i], app_factory() if app_factory else None)
              for i in range(n)]
     for node in nodes:
@@ -252,6 +256,69 @@ def test_reactor_net_commits_under_fuzzed_transport():
         ), [len(nd.blocks) for nd in nodes]
         h2 = [nd.store.load_block(2).hash() for nd in nodes]
         assert len(set(h2)) == 1
+    finally:
+        stop_net(nodes, switches)
+
+
+@pytest.mark.slow
+def test_validator_set_change_on_live_net():
+    """reference consensus/reactor_test.go:82+ (TestValidatorSetChanges),
+    end to end over real reactors: a val-tx through the persistent
+    kvstore app adds a live full node to the validator set (EndBlock
+    diff -> state.set_block_and_validators, effective next height); the
+    new validator starts SIGNING (a later commit carries 3 precommits);
+    a power-0 val-tx removes it again and the chain keeps going."""
+    from tendermint_tpu.abci.apps.kvstore import PersistentKVStoreApp
+
+    pvs = [PrivValidatorFS(gen_priv_key_ed25519(), None) for _ in range(3)]
+    pvs.sort(key=lambda pv: pv.get_address())
+    # nodes 0,1 validate (power 10 each); node 2 is a full node whose key
+    # joins later with power 4 — quorum (>2/3 of 24 = >16) stays
+    # reachable by the two genesis validators, so a lagging newcomer can
+    # slow rounds but never halt the chain
+    doc = GenesisDoc(
+        genesis_time_ns=time.time_ns(),
+        chain_id=TEST_CHAIN_ID,
+        validators=[
+            GenesisValidator(pvs[i].get_pub_key(), 10, f"v{i}") for i in range(2)
+        ],
+    )
+    nodes, switches = start_consensus_net(
+        3,
+        app_factory=lambda: PersistentKVStoreApp(
+            tempfile.mkdtemp(prefix="valchg-")
+        ),
+        genesis=(doc, pvs),
+    )
+    try:
+        assert wait_until(lambda: nodes[0].store.height() >= 2, timeout=30)
+        pub_hex = pvs[2].get_pub_key().raw.hex().upper()
+        nodes[0].mempool.check_tx(b"val:" + pub_hex.encode() + b"/4")
+        # the set grows to 3 on every node's state
+        assert wait_until(
+            lambda: all(n.cs.state.validators.size() == 3 for n in nodes),
+            timeout=30,
+        ), [n.cs.state.validators.size() for n in nodes]
+        # ... and the newcomer actually signs: some later commit carries
+        # all 3 precommits
+        def newcomer_signed():
+            h = nodes[0].store.height()
+            for height in range(max(2, h - 5), h + 1):
+                blk = nodes[0].store.load_block(height)
+                if blk is not None and sum(
+                    1 for pc in blk.last_commit.precommits if pc is not None
+                ) == 3:
+                    return True
+            return False
+        assert wait_until(newcomer_signed, timeout=60)
+        # remove it again; the chain keeps committing with the original 2
+        nodes[1].mempool.check_tx(b"val:" + pub_hex.encode() + b"/0")
+        assert wait_until(
+            lambda: all(n.cs.state.validators.size() == 2 for n in nodes),
+            timeout=30,
+        ), [n.cs.state.validators.size() for n in nodes]
+        h_after = nodes[0].store.height()
+        assert wait_until(lambda: nodes[0].store.height() >= h_after + 2, timeout=30)
     finally:
         stop_net(nodes, switches)
 
