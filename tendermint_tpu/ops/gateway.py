@@ -64,14 +64,22 @@ def _cpu_verify_batch(items: list[Item]) -> list[bool]:
 #   f32    92.2k sigs/s  fp32 radix-2^8 depthwise-conv field mults
 #   int32  50.0k sigs/s  int32 radix-2^15 jnp limb vectors (VPU)
 #   pallas 32.6k sigs/s  int32 radix-2^15 single-pallas_call ladder
+# Round 5 adds "comb" (ops/ed25519_comb.py): doubling-free verify from
+# per-validator device-resident comb tables + a fixed-base MXU comb —
+# ~3x fewer VPU ops/lane than f32p once a key's table is built (keys
+# repeat every block in consensus); first-sight lanes ride the f32
+# ladder inside the same call. The device daemon bakes comb off against
+# f32p at claim time and serves the measured winner.
 KERNELS = {
+    "comb": "tendermint_tpu.ops.ed25519_comb",
     "f32p": "tendermint_tpu.ops.ed25519_f32p",
     "f32": "tendermint_tpu.ops.ed25519_f32",
     "int32": "tendermint_tpu.ops.ed25519",
     "pallas": "tendermint_tpu.ops.ed25519_pallas",
     # not a kernel: socket IPC to the device daemon (devd.py), which runs
-    # f32p/f32 on the device it holds. The automatic default whenever a
-    # daemon is serving — see kernel_name().
+    # its claim-time bake-off winner (comb vs f32p on TPU; f32 on CPU) on
+    # the device it holds. The automatic default whenever a daemon is
+    # serving — see kernel_name().
     "devd": "tendermint_tpu.ops.devd_backend",
 }
 
@@ -167,8 +175,10 @@ def kernel_name() -> str:
     1. a serving device daemon (devd.available) -> "devd": the daemon
        owns the chip, this process stays off the tunnel entirely (the
        wedge-proof path — see tendermint_tpu/devd.py);
-    2. real TPU hardware -> "f32p" (the pallas ladder, the measured
-       winner);
+    2. real TPU hardware -> "comb" (doubling-free comb kernel; its
+       first-sight lanes internally ride the f32 ladder, so a cold
+       process is never worse than the f32 baseline and steady-state
+       consensus batches skip all 254 doublings per signature);
     3. otherwise "f32" — the pallas kernel only runs in slow interpret
        mode on CPU backends, while the conv-composed f32 kernel compiles
        natively everywhere.
@@ -180,7 +190,7 @@ def kernel_name() -> str:
 
         if devd.available() is not None:
             return "devd"
-        return "f32p" if on_tpu() else "f32"
+        return "comb" if on_tpu() else "f32"
     if name not in KERNELS:
         raise ValueError(
             f"TENDERMINT_TPU_KERNEL={name!r}: expected one of {sorted(KERNELS)}"
@@ -288,7 +298,9 @@ class Verifier:
             _platform_cache.pop("v", None)
             platform = resolve_platform()
             if platform in ("tpu", "axon"):
-                self._kernel = "f32p"
+                # same policy as kernel_name()'s hardware default: the
+                # comb kernel (its cold lanes self-route to the ladder)
+                self._kernel = "comb"
                 logger.warning("devd dead; direct %s kernel", self._kernel)
                 return
             if platform is not None:

@@ -1,0 +1,222 @@
+"""Tests for the doubling-free comb Ed25519 kernel (ops/ed25519_comb.py)
+— per-validator device-resident comb tables + fixed-base MXU comb.
+
+Same coverage discipline as test_ops_f32.py (the kernel contract is
+identical: strict cofactorless RFC 8032, lane-for-lane parity with
+crypto/ed25519.verify), plus the pool mechanics that are new here:
+slot reuse across batches, LRU eviction, capacity growth, and the
+PoolExhausted -> ladder fallback.
+
+Reference hot paths: types/vote_set.go:175,
+types/validator_set.go:247-250, blockchain/reactor.go:235.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import ed25519 as ed
+from tendermint_tpu.ops import ed25519_comb as comb
+
+
+@pytest.fixture(autouse=True)
+def _fresh_pool(monkeypatch):
+    # build tables on first sight so every test below exercises the comb
+    # path; the second-sight production default gets its own test
+    monkeypatch.setenv("TENDERMINT_TPU_COMB_MIN_SIGHT", "1")
+    comb.reset_default_pool()
+    yield
+    comb.reset_default_pool()
+
+
+def _keypair(rng):
+    sk = rng.bytes(32)
+    return sk, ed.public_key(sk)
+
+
+def _signed(rng, sk, pk, n=1, msg_len=40):
+    out = []
+    for _ in range(n):
+        m = rng.bytes(msg_len)
+        out.append((pk, m, ed.sign(sk, m)))
+    return out
+
+
+class TestVerifyParity:
+    def test_rfc8032_vectors(self):
+        # RFC 8032 section 7.1 test vectors 1-3
+        vecs = [
+            (
+                "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+                b"",
+            ),
+            (
+                "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+                bytes([0x72]),
+            ),
+            (
+                "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+                bytes([0xAF, 0x82]),
+            ),
+        ]
+        items = []
+        for sk_hex, msg in vecs:
+            sk = bytes.fromhex(sk_hex)
+            pk = ed.public_key(sk)
+            items.append((pk, msg, ed.sign(sk, msg)))
+        assert list(comb.verify_batch(items)) == [True, True, True]
+
+    def test_parity_with_cpu_reference_mixed_batch(self):
+        """Random valid sigs from several keys, tampered sig/msg/pub,
+        non-canonical s, bad-length rows — lane-for-lane identical to
+        crypto/ed25519.verify."""
+        rng = np.random.default_rng(11)
+        pairs = [_keypair(rng) for _ in range(4)]
+        items = []
+        for i in range(24):
+            sk, pk = pairs[i % 4]
+            m = rng.bytes(32 + i)
+            sig = ed.sign(sk, m)
+            if i % 6 == 1:  # tamper sig
+                b = bytearray(sig)
+                b[10] ^= 0x40
+                sig = bytes(b)
+            elif i % 6 == 2:  # tamper msg
+                m = m[:-1] + bytes([m[-1] ^ 1])
+            elif i % 6 == 3:  # wrong pubkey
+                pk = pairs[(i + 1) % 4][1]
+            elif i % 6 == 4:  # non-canonical s (s + L)
+                s_int = int.from_bytes(sig[32:], "little") + ed.L
+                sig = sig[:32] + s_int.to_bytes(32, "little")
+            items.append((pk, m, sig))
+        items.append((b"\x00" * 31, b"m", b"\x00" * 64))  # bad pub length
+        items.append((pairs[0][1], b"m", b"\x00" * 63))  # bad sig length
+        expect = [ed.verify(p, m, s) for p, m, s in items]
+        assert list(comb.verify_batch(items)) == expect
+
+    def test_empty_and_single(self):
+        rng = np.random.default_rng(3)
+        sk, pk = _keypair(rng)
+        assert list(comb.verify_batch([])) == []
+        (it,) = _signed(rng, sk, pk)
+        assert list(comb.verify_batch([it])) == [True]
+
+    def test_agrees_with_f32_kernel(self):
+        from tendermint_tpu.ops import ed25519_f32 as f32
+
+        rng = np.random.default_rng(7)
+        pairs = [_keypair(rng) for _ in range(3)]
+        items = []
+        for i in range(12):
+            sk, pk = pairs[i % 3]
+            m = rng.bytes(20)
+            sig = ed.sign(sk, m)
+            if i % 4 == 3:
+                sig = sig[:63] + bytes([sig[63] ^ 2])
+            items.append((pk, m, sig))
+        assert list(comb.verify_batch(items)) == list(f32.verify_batch(items))
+
+
+class TestPool:
+    def test_slot_reuse_across_batches(self):
+        rng = np.random.default_rng(5)
+        sk, pk = _keypair(rng)
+        comb.verify_batch(_signed(rng, sk, pk, 3))
+        pool = comb.default_pool()
+        assert pool.stats["build_keys"] == 1
+        comb.verify_batch(_signed(rng, sk, pk, 3))
+        assert pool.stats["build_keys"] == 1  # no rebuild on reuse
+
+    def test_growth_and_eviction(self):
+        pool = comb.CombPool(capacity=2, max_capacity=4)
+        comb.set_default_pool(pool)
+        rng = np.random.default_rng(9)
+        pairs = [_keypair(rng) for _ in range(5)]
+        assert pool.capacity == 2  # starts small
+        for sk, pk in pairs[:3]:
+            assert list(comb.verify_batch(_signed(rng, sk, pk))) == [True]
+        assert pool.capacity == pool.cap == 4  # grew (slot 0 reserved)
+        assert pool.stats["grows"] == 1
+        # 2 more distinct keys -> evictions, results still correct
+        for sk, pk in pairs[3:]:
+            assert list(comb.verify_batch(_signed(rng, sk, pk))) == [True]
+        assert pool.stats["evictions"] >= 1
+        # the evicted first key still verifies correctly after re-lease
+        sk, pk = pairs[0]
+        assert list(comb.verify_batch(_signed(rng, sk, pk))) == [True]
+
+    def test_second_sight_policy(self, monkeypatch):
+        """Production default: a key's table is built only on its second
+        batch appearance — first sight rides the ladder (one-shot mempool
+        keys never pay the ~13-verify build; validator keys, which sign
+        every block, are all-comb from block two)."""
+        monkeypatch.setenv("TENDERMINT_TPU_COMB_MIN_SIGHT", "2")
+        comb.reset_default_pool()
+        rng = np.random.default_rng(21)
+        sk, pk = _keypair(rng)
+        pool = comb.default_pool()
+        assert list(comb.verify_batch(_signed(rng, sk, pk))) == [True]
+        assert pool.stats["build_keys"] == 0  # first sight: ladder
+        assert list(comb.verify_batch(_signed(rng, sk, pk))) == [True]
+        assert pool.stats["build_keys"] == 1  # second sight: built
+        assert list(comb.verify_batch(_signed(rng, sk, pk))) == [True]
+        assert pool.stats["build_keys"] == 1  # reused thereafter
+
+    def test_pool_exhausted_falls_back_to_ladder(self, monkeypatch):
+        monkeypatch.setenv("TENDERMINT_TPU_COMB_CAP", "2")
+        comb.reset_default_pool()
+        rng = np.random.default_rng(13)
+        pairs = [_keypair(rng) for _ in range(3)]
+        items = []
+        for sk, pk in pairs:  # 3 distinct keys > 1 usable slot (cap=2)
+            items.extend(_signed(rng, sk, pk))
+        out = comb.verify_batch(items)  # must not raise
+        assert list(out) == [True, True, True]
+        # round-5 review regression: the aborted lease must be rolled
+        # back — a follow-up batch with one of those keys must not ride a
+        # never-built (garbage) slot table and reject a valid signature
+        for sk, pk in pairs:
+            assert list(comb.verify_batch(_signed(rng, sk, pk))) == [True]
+
+    def test_eviction_never_steals_from_current_batch(self, monkeypatch):
+        """Round-5 design bug guard: assigning slots for one batch must
+        not evict a slot already leased to an earlier lane of the SAME
+        batch (the earlier lane would verify against the wrong table)."""
+        monkeypatch.setenv("TENDERMINT_TPU_COMB_CAP", "4")
+        comb.reset_default_pool()
+        rng = np.random.default_rng(17)
+        # 3 distinct keys fill the 3 usable slots in one batch; then a
+        # 4th-key batch triggers eviction of an out-of-batch slot only
+        pairs = [_keypair(rng) for _ in range(4)]
+        items = []
+        for sk, pk in pairs[:3]:
+            items.extend(_signed(rng, sk, pk, 2))
+        assert all(comb.verify_batch(items))
+        items2 = []
+        for sk, pk in pairs[1:]:  # keys 1,2 pinned + new key 3
+            items2.extend(_signed(rng, sk, pk, 2))
+        assert all(comb.verify_batch(items2))
+
+
+class TestBTable:
+    def test_b_table_first_window_matches_reference(self):
+        tab = comb.b_table()
+        # entry [0][1] is 1*B: niels rows of the base point
+        bx, by = ed.B[0], ed.B[1]
+        want = comb._niels_rows_np(bx, by)
+        assert np.array_equal(tab[0, 1], want)
+        # entry [p][0] is the identity in niels form
+        ident = np.zeros(96, dtype=np.float32)
+        ident[0] = 1.0
+        ident[32] = 1.0
+        assert np.array_equal(tab[5, 0], ident)
+
+    def test_b_table_window_weights(self):
+        tab = comb.b_table()
+        # entry [1][1] must be 16*B
+        acc = ed.B
+        for _ in range(4):
+            acc = ed.point_double(acc)
+        x, y = comb.base._affine(acc)
+        assert np.array_equal(tab[1, 1], comb._niels_rows_np(x, y))

@@ -295,7 +295,7 @@ class TestKernelRegistry:
 
         monkeypatch.delenv("TENDERMINT_TPU_KERNEL", raising=False)
         want = (
-            "tendermint_tpu.ops.ed25519_f32p"
+            "tendermint_tpu.ops.ed25519_comb"
             if gw.on_tpu()
             else "tendermint_tpu.ops.ed25519_f32"
         )
