@@ -18,10 +18,34 @@ _DEFAULT_DIR = os.path.join(
 )
 
 
+def _machine_tag() -> str:
+    """Stable per-machine cache key from the CPU feature flags. XLA:CPU
+    AOT artifacts bake in the compile machine's features; loading them on
+    a different host spews cpu_aot_loader feature-mismatch errors (and
+    risks SIGILL) — seen as the stderr noise in MULTICHIP_r04.json when
+    the driver machine reloaded this builder's cache. Scoping the cache
+    dir by feature-set keeps every machine's artifacts separate."""
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return hashlib.sha1(line.encode()).hexdigest()[:12]
+    except OSError:
+        pass
+    import platform
+
+    return platform.machine() or "unknown"
+
+
 def enable(cache_dir: str | None = None) -> None:
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", cache_dir or _DEFAULT_DIR)
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(cache_dir or _DEFAULT_DIR, _machine_tag()),
+    )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 

@@ -393,7 +393,7 @@ def verify_batch_async(items: list[tuple[bytes, bytes, bytes]]):
     args, valid, n = marshal_device_args(items)
     fn = _get_verify(S_TILE, not _on_tpu())
     ok = fn(*args)
-    return lambda: (np.asarray(ok).reshape(-1)[:n] != 0) & valid[:n]
+    return lambda: materialize_verdicts(ok, valid, n)
 
 
 def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
@@ -490,13 +490,17 @@ def make_sharded_verify(mesh, on_tpu: bool):
     return fn
 
 
-def sharded_verify_batch(items, mesh, on_tpu: bool) -> np.ndarray:
-    """Marshal + run a batch through make_sharded_verify. Buckets to the
-    smallest power of two >= n that divides into equal per-device shards
-    (compile count stays bounded at log2(maxN) shapes per mesh)."""
+def sharded_verify_arrays(items, mesh, on_tpu: bool):
+    """Marshal + dispatch a batch through make_sharded_verify, returning
+    (ok_device_array, valid_mask, n) with the result STILL on device and
+    sharded over the mesh — callers can inspect `.addressable_shards` to
+    assert the per-device layout (dryrun_multichip does) before
+    materializing. Buckets to the smallest power of two >= n that divides
+    into equal per-device shards (compile count stays bounded at
+    log2(maxN) shapes per mesh)."""
     n = len(items)
     if n == 0:
-        return np.zeros(0, dtype=bool)
+        return None, np.zeros(0, dtype=bool), 0
     q = lane_quantum(mesh.size, on_tpu)
     bucket = q
     while bucket < n:
@@ -512,4 +516,20 @@ def sharded_verify_batch(items, mesh, on_tpu: bool) -> np.ndarray:
         jnp.asarray(s8.reshape(32, s_total, 128)),
         jnp.asarray(h8.reshape(32, s_total, 128)),
     )
+    return ok, valid, n
+
+
+def materialize_verdicts(ok, valid, n: int) -> np.ndarray:
+    """Fetch a device verdict array and mask to per-item booleans — the
+    ONE masking tail every batched-verify exit shares (gateway sharded
+    paths included), so accept/reject coercion can never drift between
+    call sites."""
+    if n == 0:
+        return np.zeros(0, dtype=bool)
     return (np.asarray(ok).reshape(-1)[:n] != 0) & valid[:n]
+
+
+def sharded_verify_batch(items, mesh, on_tpu: bool) -> np.ndarray:
+    """Materialized form of sharded_verify_arrays (the gateway's entry)."""
+    ok, valid, n = sharded_verify_arrays(items, mesh, on_tpu)
+    return materialize_verdicts(ok, valid, n)

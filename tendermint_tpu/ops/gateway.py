@@ -205,6 +205,20 @@ def kernel_module():
     return importlib.import_module(KERNELS[kernel_name()])
 
 
+def shard_layout(arr) -> list[tuple[int, int]]:
+    """(device_id, lanes) per addressable shard of a device array, sorted
+    by device — measured proof that a dispatch actually landed sharded
+    (dryrun_multichip asserts it covers every mesh device evenly)."""
+    try:
+        return sorted(
+            (s.device.id, int(np.prod(s.data.shape)))
+            for s in arr.addressable_shards
+        )
+    except Exception:  # noqa: BLE001 — layout capture must never fail a verify
+        logger.exception("shard layout capture failed")
+        return []
+
+
 def _split_by_key_type(items: list[Item]):
     """(ed25519 items, their positions, other items, their positions).
     The kernel is ed25519-only; secp256k1 (33-byte pubkeys) and anything
@@ -490,6 +504,9 @@ class ShardedVerifier(Verifier):
 
         self.mesh = mesh
         self._n_dev = mesh.size
+        # (device_id, lanes) per shard of the most recent sharded
+        # dispatch — None until one runs (see shard_layout)
+        self.last_shard_layout: list[tuple[int, int]] | None = None
         batch_last = NamedSharding(mesh, PS(None, "batch"))
         vec = NamedSharding(mesh, PS("batch"))
         self._verify = jax.jit(
@@ -539,7 +556,11 @@ class ShardedVerifier(Verifier):
             if self._kernel == "f32p":
                 from tendermint_tpu.ops import ed25519_f32p as ops_f32p
 
-                oks = ops_f32p.sharded_verify_batch(items, self.mesh, on_tpu())
+                ok_dev, valid, _n = ops_f32p.sharded_verify_arrays(
+                    items, self.mesh, on_tpu()
+                )
+                self.last_shard_layout = shard_layout(ok_dev)
+                oks = ops_f32p.materialize_verdicts(ok_dev, valid, n)
                 with self._mtx:
                     self._stats["tpu_batches"] += 1
                     self._stats["tpu_sigs"] += n
@@ -560,6 +581,7 @@ class ShardedVerifier(Verifier):
                 jnp.asarray(ax), jnp.asarray(ay), jnp.asarray(ry),
                 jnp.asarray(rs), jnp.asarray(s8), jnp.asarray(h8),
             )
+            self.last_shard_layout = shard_layout(ok)
             with self._mtx:
                 self._stats["tpu_batches"] += 1
                 self._stats["tpu_sigs"] += n

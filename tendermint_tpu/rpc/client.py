@@ -9,6 +9,7 @@ which is what tests and in-node tooling use.
 from __future__ import annotations
 
 import base64
+import http.client
 import json
 import os
 import queue
@@ -24,14 +25,51 @@ class RPCClientError(Exception):
     pass
 
 
+class _UnixHTTPConnection(http.client.HTTPConnection):
+    """http.client connection whose transport is an AF_UNIX socket —
+    the client half of the reference's unix-socket RPC transport
+    (rpc/lib/rpc_test.go:40-75 exercises both)."""
+
+    def __init__(self, path: str, timeout: float):
+        super().__init__("unix", timeout=timeout)
+        self._path = path
+
+    def connect(self):
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(self.timeout)
+        s.connect(self._path)
+        self.sock = s
+
+
 class HTTPClient:
     def __init__(self, addr: str, timeout: float = 30.0):
-        # addr: "host:port" or "http://host:port"
+        # addr: "host:port", "http://host:port", or "unix:///path.sock"
+        self.timeout = timeout
+        self._id = 0
+        if addr.startswith("unix://"):
+            self.unix_path: str | None = addr[len("unix://"):]
+            self.addr = addr
+            return
+        self.unix_path = None
         if not addr.startswith("http"):
             addr = "http://" + addr
         self.addr = addr.rstrip("/")
-        self.timeout = timeout
-        self._id = 0
+
+    def _call_unix(self, data: bytes) -> dict:
+        conn = _UnixHTTPConnection(self.unix_path, self.timeout)
+        try:
+            conn.request(
+                "POST", "/", body=data,
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            raw = resp.read()
+        finally:
+            conn.close()
+        try:
+            return json.loads(raw.decode())
+        except ValueError as exc:
+            raise RPCClientError(f"HTTP {resp.status}") from exc
 
     def call(self, method: str, **params):
         self._id += 1
@@ -42,6 +80,11 @@ class HTTPClient:
             "params": params,
         }
         data = json.dumps(req).encode()
+        if self.unix_path:
+            body = self._call_unix(data)
+            if body.get("error"):
+                raise RPCClientError(body["error"])
+            return body["result"]
         r = urllib.request.Request(
             self.addr + "/",
             data=data,
@@ -92,12 +135,21 @@ class WSClient:
     and an event queue for subscriptions."""
 
     def __init__(self, addr: str, timeout: float = 30.0):
-        host, _, port = addr.replace("http://", "").replace("ws://", "").rpartition(":")
-        self.sock = socket.create_connection((host, int(port)), timeout=timeout)
+        if addr.startswith("unix://"):
+            self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self.sock.settimeout(timeout)
+            self.sock.connect(addr[len("unix://"):])
+            host_hdr = "unix"
+        else:
+            host, _, port = (
+                addr.replace("http://", "").replace("ws://", "").rpartition(":")
+            )
+            self.sock = socket.create_connection((host, int(port)), timeout=timeout)
+            host_hdr = f"{host}:{port}"
         key = base64.b64encode(os.urandom(16)).decode()
         self.sock.sendall(
             (
-                f"GET /websocket HTTP/1.1\r\nHost: {host}:{port}\r\n"
+                f"GET /websocket HTTP/1.1\r\nHost: {host_hdr}\r\n"
                 "Upgrade: websocket\r\nConnection: Upgrade\r\n"
                 f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
             ).encode()

@@ -7,6 +7,11 @@ One ThreadingHTTPServer serves all three transports:
 - GET  /websocket   RFC6455 upgrade; JSON-RPC frames + subscribe/
                     unsubscribe methods that stream node events
                     (handlers.go:351-630)
+
+The listen address may be TCP ("host:port", "tcp://host:port") or a unix
+socket ("unix:///path.sock", or a bare filesystem path) — the reference
+rpc/lib serves and tests both (rpc/lib/server/http_server.go:20-40,
+rpc/lib/rpc_test.go:40-75); all three transports ride either listener.
 """
 
 from __future__ import annotations
@@ -25,6 +30,45 @@ from tendermint_tpu.rpc.core.handlers import RPCError
 from tendermint_tpu.rpc.core.routes import build_routes
 
 _WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+def is_unix_laddr(laddr: str) -> bool:
+    """Is this listen address a unix-socket path? Accepts the explicit
+    unix:// scheme and bare filesystem paths (what node._parse_laddr
+    yields after stripping the scheme)."""
+    return laddr.startswith("unix://") or (
+        "/" in laddr and ":" not in laddr
+    )
+
+
+class _UnixThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer over AF_UNIX. HTTPServer.server_bind assumes a
+    (host, port) address tuple and BaseHTTPRequestHandler.address_string
+    indexes client_address — both break on unix sockets, so bind and
+    accept are overridden to present tuple-shaped addresses."""
+
+    address_family = socket.AF_UNIX
+
+    def server_bind(self):
+        import os as _os
+        import stat as _stat
+
+        # reclaim a stale socket from a previous run — but never delete a
+        # NON-socket: a mistyped laddr pointing at a real file must fail
+        # at bind, not silently destroy the file
+        try:
+            st = _os.stat(self.server_address)
+            if _stat.S_ISSOCK(st.st_mode):
+                _os.unlink(self.server_address)
+        except (FileNotFoundError, TypeError):
+            pass
+        self.socket.bind(self.server_address)
+        self.server_name = "unix"
+        self.server_port = 0
+
+    def get_request(self):
+        conn, _ = self.socket.accept()
+        return conn, ("unix", 0)
 
 
 def _json_default(obj):
@@ -52,7 +96,6 @@ def _coerce_params(params: dict, known: list[str]) -> dict:
 class RPCServer(BaseService):
     def __init__(self, laddr: str, ctx, unsafe: bool = False):
         super().__init__(name="rpc.server")
-        host, _, port = laddr.rpartition(":")
         self.ctx = ctx
         self.routes = build_routes(unsafe)
         server = self
@@ -152,9 +195,17 @@ class RPCServer(BaseService):
                 WSConnection(server, self.connection).run()
                 self.close_connection = True
 
-        self._httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)), Handler)
+        if is_unix_laddr(laddr):
+            path = laddr.split("://", 1)[-1]
+            self._httpd = _UnixThreadingHTTPServer(path, Handler)
+            self.port = 0
+            self.unix_path: str | None = path
+        else:
+            host, _, port = laddr.split("://", 1)[-1].rpartition(":")
+            self._httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)), Handler)
+            self.port = self._httpd.server_address[1]
+            self.unix_path = None
         self._httpd.daemon_threads = True
-        self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None
 
     def on_start(self) -> None:
@@ -162,11 +213,21 @@ class RPCServer(BaseService):
             target=self._httpd.serve_forever, daemon=True, name="rpc.httpd"
         )
         self._thread.start()
-        self.logger.info("RPC server listening on port %d", self.port)
+        if self.unix_path:
+            self.logger.info("RPC server listening on unix://%s", self.unix_path)
+        else:
+            self.logger.info("RPC server listening on port %d", self.port)
 
     def on_stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self.unix_path:
+            import os as _os
+
+            try:
+                _os.unlink(self.unix_path)
+            except FileNotFoundError:
+                pass
 
 
 class WSConnection:

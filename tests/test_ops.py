@@ -332,3 +332,57 @@ class TestShardedVerifier:
         mesh = Mesh(np.array(jax.devices()), ("batch",))
         with pytest.raises(ValueError, match="shards the f32/f32p"):
             gateway.ShardedVerifier(mesh)
+
+    def test_sharded_fast_sync_commit(self):
+        """Fast sync's VerifyCommit driven end-to-end through the sharded
+        verifier: real ValidatorSet commits (the quorum math of
+        types/validator_set.go:220-264) grouped exactly as the blockchain
+        reactor groups them (validator_set.verify_commits_async — the
+        call blockchain/reactor._dispatch_speculative makes, replacing
+        the reference's per-block loop at blockchain/reactor.go:235-236),
+        with the signature batch sharded over the 8-device CPU mesh.
+        Asserts verdicts AND the measured per-device shard layout."""
+        from jax.sharding import Mesh
+
+        from tendermint_tpu.types.validator_set import CommitError
+        from tests.test_types import BLOCK_ID, make_val_set, signed_vote
+        from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT
+        from tendermint_tpu.types.vote_set import VoteSet
+
+        vs, privs = make_val_set(8, power=1)
+        entries = []
+        for height in (1, 2, 3):
+            voteset = VoteSet(
+                "test-chain", height, 0, VOTE_TYPE_PRECOMMIT, vs
+            )
+            for p in privs:
+                voteset.add_vote(
+                    signed_vote(p, vs, height, 0, VOTE_TYPE_PRECOMMIT, BLOCK_ID)
+                )
+            entries.append((BLOCK_ID, height, voteset.make_commit()))
+        # tamper height 2's first signature: its finisher (and ONLY its
+        # finisher) must raise, as the reactor's bad-block path expects
+        from tendermint_tpu.crypto.keys import SignatureEd25519
+
+        bad = entries[1][2]
+        bad.precommits[0] = bad.precommits[0].with_signature(
+            SignatureEd25519(b"\x07" * 64)
+        )
+
+        mesh = Mesh(np.array(jax.devices()), ("batch",))
+        v = gateway.ShardedVerifier(mesh, min_tpu_batch=1)
+        finishers = vs.verify_commits_async(
+            "test-chain", entries, v.verify_batch_async
+        )
+        assert len(finishers) == 3
+        finishers[0]()
+        with pytest.raises(CommitError):
+            finishers[1]()
+        finishers[2]()
+        # one grouped dispatch, 24 signatures, sharded over all 8 devices
+        assert v.stats()["tpu_batches"] == 1
+        assert v.stats()["tpu_sigs"] == 24
+        layout = v.last_shard_layout
+        assert layout is not None and len(layout) == 8, layout
+        assert len({d for d, _ in layout}) == 8, layout
+        assert len({sz for _, sz in layout}) == 1, layout
