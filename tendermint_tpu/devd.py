@@ -224,15 +224,28 @@ def _device_loop(st: _DaemonState, *, accept_cpu: bool, probe_timeout: float,
                 # second-sight policy the first pass at a shape may still
                 # route lanes to the ladder and the second pays table
                 # builds + compile — neither may land inside the timed
-                # region or the bake-off picks the wrong winner
+                # region or the bake-off picks the wrong winner.
+                # The timed region is PIPELINED (several batches in
+                # flight via verify_batch_async): serving throughput is
+                # what the daemon exists for, and a single synchronous
+                # batch is dominated by the tunnel round trip — it ranks
+                # kernels by RTT, not by device rate (the r5 bake-off
+                # initially picked on 1-batch numbers 4-7x below the
+                # pipelined rate).
                 full = make_full(max(warm_shapes))
                 for _ in range(2):
                     v.verify_batch(full)
+                n_pipe = 6
                 t0 = time.time()
-                v.verify_batch(full)
+                resolvers = [v.verify_batch_async(full) for _ in range(n_pipe)]
+                for r in resolvers:
+                    r()
                 dt = time.time() - t0
-                rate = len(full) / dt if dt > 0 else 0.0
-                logger.info("kernel %s: %.0f sigs/s at %d", kname, rate, len(full))
+                rate = n_pipe * len(full) / dt if dt > 0 else 0.0
+                logger.info(
+                    "kernel %s: %.0f sigs/s sustained (%d x %d pipelined)",
+                    kname, rate, n_pipe, len(full),
+                )
                 if best is None or dt < best[0]:
                     best = (dt, kname)
                     verifier = v
@@ -249,6 +262,10 @@ def _device_loop(st: _DaemonState, *, accept_cpu: bool, probe_timeout: float,
             st.status = "waiting-for-device"
             if st.stop.wait(retry_s):
                 return
+
+
+# one bench at a time daemon-wide (see the bench op)
+_bench_gate = threading.Lock()
 
 
 def _handle_conn(conn: socket.socket, st: _DaemonState) -> None:
@@ -288,6 +305,78 @@ def _handle_conn(conn: socket.socket, st: _DaemonState) -> None:
                         _send_frame(conn, {"ok": True, "results": [bool(b) for b in oks]})
                 elif op == "stats":
                     _send_frame(conn, {"ok": True, "stats": held_stats()})
+                elif op == "bench":
+                    # In-daemon pipelined throughput measurement: the one
+                    # number free of ALL client-side confounds (IPC
+                    # marshal, socket hops, client thread scheduling) —
+                    # how fast the held device verifies when its queue is
+                    # kept full. Items are synthesized daemon-side with
+                    # the warm-set key-reuse shape (64 keys cycled, a
+                    # real commit's profile). MAINTENANCE op: it queues
+                    # ~n_batches*batch lanes on the shared serving
+                    # verifier, so concurrent verify traffic both stalls
+                    # and skews it — benches are serialized against each
+                    # other here, and callers should run it on an
+                    # otherwise idle daemon.
+                    v = st.verifier
+                    if v is None:
+                        _send_frame(conn, {
+                            "ok": False,
+                            "error": f"device not held (status: {st.status})",
+                        })
+                    elif not _bench_gate.acquire(blocking=False):
+                        _send_frame(conn, {
+                            "ok": False,
+                            "error": "bench already running (serialized)",
+                        })
+                    else:
+                        try:
+                            batch = int(req.get("batch", 8192))
+                            n_batches = int(req.get("n_batches", 8))
+                            from tendermint_tpu.crypto import ed25519 as _ed
+
+                            seeds = [
+                                bytes([5, k]) + b"\x05" * 30 for k in range(64)
+                            ]
+                            base_items = [
+                                (
+                                    _ed.public_key(seeds[i % 64]),
+                                    b"dbench-%d" % i,
+                                    _ed.sign(seeds[i % 64], b"dbench-%d" % i),
+                                )
+                                for i in range(min(batch, 256))
+                            ]
+                            items = [
+                                base_items[i % len(base_items)]
+                                for i in range(batch)
+                            ]
+                            for _ in range(2):  # tables/compile off-clock
+                                v.verify_batch(items)
+                            t0 = time.time()
+                            resolvers = [
+                                v.verify_batch_async(items)
+                                for _ in range(n_batches)
+                            ]
+                            # resolve EVERY batch before stopping the
+                            # clock — short-circuiting on a failed batch
+                            # would leave device work in flight and
+                            # inflate the rate
+                            results = [r() for r in resolvers]
+                            dt = time.time() - t0
+                            all_ok = all(all(res) for res in results)
+                        finally:
+                            _bench_gate.release()
+                        _send_frame(conn, {
+                            "ok": True,
+                            "sigs_per_sec": (
+                                batch * n_batches / dt if dt > 0 else 0.0
+                            ),
+                            "elapsed_s": dt,
+                            "batch": batch,
+                            "n_batches": n_batches,
+                            "all_ok": all_ok,
+                            "kernel": os.environ.get("TENDERMINT_TPU_KERNEL", ""),
+                        })
                 elif op == "shutdown":
                     _send_frame(conn, {"ok": True})
                     st.stop.set()
@@ -487,6 +576,17 @@ class DevdClient:
         if not rep.get("ok"):
             raise DevdError(rep.get("error", "stats failed"))
         return rep["stats"]
+
+    def bench(self, batch: int = 8192, n_batches: int = 8,
+              timeout: float = 600.0) -> dict:
+        """In-daemon pipelined device rate (see the bench op)."""
+        rep = self.request(
+            {"op": "bench", "batch": batch, "n_batches": n_batches},
+            timeout=timeout,
+        )
+        if not rep.get("ok"):
+            raise DevdError(rep.get("error", "bench failed"))
+        return rep
 
     def shutdown(self) -> None:
         self.request({"op": "shutdown"})

@@ -80,6 +80,39 @@ def probe_device(timeout_s: float = 90.0) -> str | None:
     return out[0] if out else None
 
 
+def probe_rtt_ms(timeout_s: float = 60.0) -> float | None:
+    """Measured device dispatch round trip: min of 3 tiny synchronous
+    ops after one warm-up, or None if the device never answered within
+    the bound. Same hang discipline as probe_device (daemon-thread dial);
+    same ownership caveat — run it in a THROWAWAY subprocess from any
+    process that must stay usable (ops/gateway.device_rtt_ms does)."""
+    import threading
+    import time
+
+    out: list = []
+
+    def probe():
+        try:
+            import jax.numpy as jnp
+
+            x = jnp.zeros((8, 128))
+            x.sum().block_until_ready()  # compile outside the clock
+            best = None
+            for _ in range(3):
+                t0 = time.perf_counter()
+                x.sum().block_until_ready()
+                dt = (time.perf_counter() - t0) * 1e3
+                best = dt if best is None else min(best, dt)
+            out.append(best)
+        except Exception:  # noqa: BLE001 — unreachable counts as absent
+            pass
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return out[0] if out else None
+
+
 def platform_label() -> str:
     """Backend platform name for bench output, WITHOUT risking a hang or
     contending with a device daemon that holds the chip: an explicit
