@@ -72,7 +72,12 @@ def _signed_scenario() -> dict:
         cfg.root_dir = tempfile.mkdtemp(prefix="bench-mempool-sig-")
         app = SignedKVStoreApp(verify_in_app=False)
         verifier = Verifier(min_tpu_batch=32)
-        batcher = SigBatcher(verifier, parse_sig_tx, max_batch=4096,
+        # max_batch at half the burst: batches fill (so the linger never
+        # idles the full bound) and the drain thread's verify of batch k
+        # overlaps the producer's intake of batch k+1 — measured faster
+        # than one full-burst batch on BOTH clean (less serial latency)
+        # and adversarial (smaller per-batch exact-floor passes) shapes
+        batcher = SigBatcher(verifier, parse_sig_tx, max_batch=2048,
                              max_wait_s=0.02)
         mp = Mempool(cfg, AppConnMempool(LocalClient(app, threading.RLock())),
                      sig_batcher=batcher)
@@ -92,23 +97,34 @@ def _signed_scenario() -> dict:
         return el, stats
 
     good_txs = [t for i, t in enumerate(txs) if i % 97 != 0]
+    # best-of-2 per scenario: this box is single-core, so any background
+    # work (e.g. the device daemon's periodic reclaim probe) lands
+    # wholly on the bench; min-time damps it
     # clean burst: the RLC fast path decides whole batches — the gate's
     # happy-path rate
-    clean_s, clean_stats = run_gated(good_txs, len(good_txs))
-    # adversarial burst (forged lanes sprinkled): bisection + the exact
-    # per-item floor decide — the gate's flood-resistance rate
-    gated_s, stats = run_gated(txs, n_good)
+    clean_s, clean_stats = min(
+        (run_gated(good_txs, len(good_txs)) for _ in range(2)),
+        key=lambda r: r[0],
+    )
+    # adversarial burst (forged lanes sprinkled): one failed RLC routes
+    # each batch to the exact 8-wide per-item floor — the gate's
+    # flood-resistance rate
+    gated_s, stats = min(
+        (run_gated(txs, n_good) for _ in range(2)), key=lambda r: r[0]
+    )
 
     # -- reference shape: the app verifies per tx on CPU ------------------
-    cfg2 = test_config().mempool
-    cfg2.root_dir = tempfile.mkdtemp(prefix="bench-mempool-sig-")
-    app2 = SignedKVStoreApp(verify_in_app=True)
-    mp2 = Mempool(cfg2, AppConnMempool(LocalClient(app2, threading.RLock())))
-    t0 = time.perf_counter()
-    for tx in txs:
-        mp2.check_tx(tx)
-    assert drain(mp2, n_good), f"in-app drain stalled at {mp2.size()}/{n_good}"
-    in_app_s = time.perf_counter() - t0
+    in_app_s = float("inf")
+    for _ in range(2):
+        cfg2 = test_config().mempool
+        cfg2.root_dir = tempfile.mkdtemp(prefix="bench-mempool-sig-")
+        app2 = SignedKVStoreApp(verify_in_app=True)
+        mp2 = Mempool(cfg2, AppConnMempool(LocalClient(app2, threading.RLock())))
+        t0 = time.perf_counter()
+        for tx in txs:
+            mp2.check_tx(tx)
+        assert drain(mp2, n_good), f"in-app drain stalled at {mp2.size()}/{n_good}"
+        in_app_s = min(in_app_s, time.perf_counter() - t0)
 
     return {
         "signed_txs": N_SIGNED,
@@ -165,21 +181,31 @@ def main() -> None:
     cycle_s = time.perf_counter() - t0
     assert mp.size() == N_TXS - len(reaped)
 
+    signed = _signed_scenario()
+    # Headline (round 5, VERDICT r4 #5): the SIGNED scenario — the
+    # framework's accelerated dimension (batched sig gate vs the
+    # reference shape of one in-app verify per CheckTx,
+    # mempool/mempool.go:166-205) — with vs_baseline = the clean-burst
+    # gate speedup. The unsigned 50k burst stays in detail: it measures
+    # host-path machinery with no reference number to compare against.
     print(
         json.dumps(
             {
-                "metric": "mempool_checktx_per_sec",
-                "value": round(N_TXS / burst_s, 1),
+                "metric": "mempool_signed_checktx_per_sec",
+                "value": signed["gated_clean_sigs_per_sec"],
                 "unit": "txs/s",
-                "vs_baseline": 1.0,  # host-path bench: no reference numbers exist
+                "vs_baseline": signed["gate_speedup_clean"],
                 "detail": {
-                    "burst_txs": N_TXS,
-                    "burst_s": round(burst_s, 3),
-                    "dup_reject_per_sec": round(REAP / dup_s, 1),
-                    "reap_update_s": round(cycle_s, 3),
-                    "reaped": len(reaped),
-                    "app": "counter(local)",
-                    "signed": _signed_scenario(),
+                    "signed": signed,
+                    "unsigned_burst": {
+                        "burst_txs": N_TXS,
+                        "checktx_per_sec": round(N_TXS / burst_s, 1),
+                        "burst_s": round(burst_s, 3),
+                        "dup_reject_per_sec": round(REAP / dup_s, 1),
+                        "reap_update_s": round(cycle_s, 3),
+                        "reaped": len(reaped),
+                        "app": "counter(local)",
+                    },
                 },
             }
         )

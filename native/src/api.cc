@@ -145,6 +145,10 @@ int tm_ed25519_verify_batch_rlc(const uint8_t* pubs, const uint8_t* sigs,
 // 2 vectorized) so differential tests can drive both paths
 void tm_ed25519_msm_path(int path) { ed25519_set_msm_path(path); }
 
+// test seam: force the per-item ladder implementation (0 auto, 1 scalar,
+// 2 8-wide IFMA) so differential tests can drive both paths
+void tm_ed25519_items8_path(int path) { ed25519_set_items8_path(path); }
+
 // batch h = SHA512(R || A || M) mod L for the TPU-kernel marshal
 // (the per-item host cost the Python loop can't vectorize; one FFI call
 // per batch, no per-item overhead). sigs n*64 (R = first 32 bytes),

@@ -54,5 +54,7 @@ int ed25519_verify_batch_rlc(const uint8_t* pubs, const uint8_t* sigs,
 // vectorized. Differential tests drive both paths through it; both
 // compute identical group elements.
 void ed25519_set_msm_path(int path);
+// test seam for the 8-wide per-item ladder (0 auto, 1 scalar, 2 8-wide)
+void ed25519_set_items8_path(int path);
 
 }  // namespace tm

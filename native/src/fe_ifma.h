@@ -283,6 +283,27 @@ static inline void ge8_add(ge8* o, const ge8* p, const ge8* q,
   fe8_mul(&o->T, &e, &h);
 }
 
+// extended-coords doubling, 8 lanes (same formula as scalar ge_double:
+// 4S + 4M). Carry discipline mirrors ge8_add: sums that feed a mul are
+// carried explicitly, fe8_sub outputs are mul-safe by construction.
+static inline void ge8_dbl(ge8* o, const ge8* p) {
+  fe8 a, b, c, e, f, g, h, t;
+  fe8_sq(&a, &p->X);
+  fe8_sq(&b, &p->Y);
+  fe8_sq(&c, &p->Z);
+  fe8_add(&c, &c, &c); fe8_carry(&c);
+  fe8_add(&h, &a, &b); fe8_carry(&h);
+  fe8_add(&t, &p->X, &p->Y); fe8_carry(&t);
+  fe8_sq(&t, &t);
+  fe8_sub(&e, &h, &t);
+  fe8_sub(&g, &a, &b);
+  fe8_add(&f, &c, &g); fe8_carry(&f);
+  fe8_mul(&o->X, &e, &f);
+  fe8_mul(&o->Y, &g, &h);
+  fe8_mul(&o->Z, &f, &g);
+  fe8_mul(&o->T, &e, &h);
+}
+
 // mixed add/sub against ONE shared affine-niels point, with a per-lane
 // sign mask (neg lane k=1 -> subtract): the niels multiplier operands
 // swap and the C term flips sign, exactly the scalar ge_madd/ge_msub

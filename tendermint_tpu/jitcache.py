@@ -83,9 +83,11 @@ def probe_device(timeout_s: float = 90.0) -> str | None:
 def probe_rtt_ms(timeout_s: float = 60.0) -> float | None:
     """Measured device dispatch round trip: min of 3 tiny synchronous
     ops after one warm-up, or None if the device never answered within
-    the bound. Same hang discipline as probe_device (daemon-thread dial);
-    same ownership caveat — run it in a THROWAWAY subprocess from any
-    process that must stay usable (ops/gateway.device_rtt_ms does)."""
+    the bound. Same hang discipline as probe_device (daemon-thread
+    dial): a wedged tunnel parks the probe thread and returns None
+    instead of hanging the caller. Only call from a process that is (or
+    may become) the device's owner — ops/gateway.device_rtt_ms guards
+    this with the no-daemon-socket check."""
     import threading
     import time
 

@@ -13,7 +13,7 @@ interleaves with state transition (state/execution.py commit path).
 
 from __future__ import annotations
 
-import queue
+import logging
 import threading
 import time
 from collections import OrderedDict
@@ -23,6 +23,8 @@ from tendermint_tpu.libs.autofile import Group
 from tendermint_tpu.libs.clist import CList
 
 CACHE_SIZE = 100_000
+
+logger = logging.getLogger("mempool")
 
 
 class SigBatcher:
@@ -38,6 +40,14 @@ class SigBatcher:
     the gate (the app decides). Runs its own drain thread; submit() is
     called under the mempool lock and never blocks on the device.
 
+    Results are delivered BATCHED: `on_results([(ctx, ok), ...])` is
+    called once per verified batch on the drain thread, so the consumer
+    can amortize its own per-item costs (the mempool admits a whole
+    batch through one app-lock round trip — check_tx_many_async; per-tx
+    callbacks measured ~15us each, capping a 4k burst at ~67k tx/s
+    regardless of verify speed). `on_results` defaults unset; the
+    Mempool wires itself in at construction.
+
     The intake queue is BOUNDED (`max_backlog`): a peer flooding unique
     signed txs faster than the verifier drains must get refusals, not an
     unbounded in-memory backlog — the same end-to-end-bound rule the
@@ -47,50 +57,74 @@ class SigBatcher:
     the tx retriably."""
 
     def __init__(self, verifier, parse, max_batch: int = 512,
-                 max_wait_s: float = 0.002, max_backlog: int = 8192):
+                 max_wait_s: float = 0.002, max_backlog: int = 8192,
+                 on_results=None):
         self.verifier = verifier
         self.parse = parse
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
+        self.max_backlog = max_backlog
+        self.on_results = on_results
         self.dropped = 0
-        self._q: queue.Queue = queue.Queue(maxsize=max_backlog)
+        # Intake is a plain list under a condition variable, swapped out
+        # wholesale by the drain thread — NOT a queue.Queue: at burst
+        # rates the per-item timed gets (one condition wait each) cost
+        # more than the verification they feed (measured ~40 ms of a
+        # 119 ms 4k-tx gated burst). submit() is one append under the
+        # lock; the drain thread takes the whole buffer in one swap and
+        # sleeps at most once per linger window.
+        self._buf: list = []
+        self._cv = threading.Condition()
+        self._stopped = False
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="mempool.sigbatch"
         )
         self._thread.start()
 
-    def submit(self, item, ok_cb, bad_cb) -> bool:
-        """Enqueue for the next batch; False if the gate is saturated
-        (caller must reject the tx without app dispatch)."""
-        try:
-            self._q.put_nowait((item, ok_cb, bad_cb))
-            return True
-        except queue.Full:
-            self.dropped += 1
-            return False
+    def submit(self, item, ctx) -> bool:
+        """Enqueue for the next batch (ctx rides to on_results with the
+        verdict); False if the gate is saturated (caller must reject the
+        tx without app dispatch)."""
+        with self._cv:
+            if len(self._buf) >= self.max_backlog:
+                self.dropped += 1
+                return False
+            self._buf.append((item, ctx))
+            # wake the drain thread when work appears or a full batch is
+            # ready; intermediate appends don't pay a notify
+            if len(self._buf) == 1 or len(self._buf) == self.max_batch:
+                self._cv.notify()
+        return True
 
     def stop(self) -> None:
-        self._q.put(None)
+        with self._cv:
+            self._stopped = True
+            self._cv.notify()
+
+    def _take_batch(self) -> list | None:
+        """Block until work or stop; linger up to max_wait_s for the
+        burst to fill a batch; swap out up to max_batch items."""
+        with self._cv:
+            while not self._buf and not self._stopped:
+                self._cv.wait()
+            if not self._buf and self._stopped:
+                return None
+            if len(self._buf) < self.max_batch and not self._stopped:
+                deadline = time.monotonic() + self.max_wait_s
+                while len(self._buf) < self.max_batch and not self._stopped:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+            batch = self._buf[: self.max_batch]
+            del self._buf[: self.max_batch]
+            return batch
 
     def _run(self) -> None:
         while True:
-            first = self._q.get()
-            if first is None:
+            batch = self._take_batch()
+            if batch is None:
                 return
-            batch = [first]
-            deadline = time.monotonic() + self.max_wait_s
-            while len(batch) < self.max_batch:
-                wait = deadline - time.monotonic()
-                if wait <= 0:
-                    break
-                try:
-                    nxt = self._q.get(timeout=wait)
-                except queue.Empty:
-                    break
-                if nxt is None:
-                    self._q.put(None)  # re-arm stop for after this batch
-                    break
-                batch.append(nxt)
             try:
                 oks = self.verifier.verify_batch([b[0] for b in batch])
             except Exception:  # noqa: BLE001 — fail OPEN: the gate is an
@@ -99,13 +133,16 @@ class SigBatcher:
                 # verifier bug may admit junk to the pool but never to a
                 # block; failing closed would drop valid txs instead
                 oks = None
-            for (item, ok_cb, bad_cb), ok in zip(
-                batch, oks if oks is not None else [True] * len(batch)
-            ):
-                try:
-                    (ok_cb if ok else bad_cb)()
-                except Exception:  # noqa: BLE001 — one bad cb must not stall the gate
-                    pass
+            results = [
+                (ctx, bool(ok))
+                for (_item, ctx), ok in zip(
+                    batch, oks if oks is not None else [True] * len(batch)
+                )
+            ]
+            try:
+                self.on_results(results)
+            except Exception:  # noqa: BLE001 — a bad sink must not stall the gate
+                logger.exception("sig gate result sink failed")
 
 
 class TxInCacheError(Exception):
@@ -159,6 +196,10 @@ class Mempool:
         self.config = config
         self.proxy_app_conn = proxy_app_conn
         self.sig_batcher = sig_batcher
+        if sig_batcher is not None and sig_batcher.on_results is None:
+            # the mempool is the gate's result sink: whole batches admit
+            # through one lock round trip (see SigBatcher docstring)
+            sig_batcher.on_results = self._sig_gate_results
         self.txs = CList()
         self.counter = 0
         self.height = 0
@@ -237,11 +278,7 @@ class Mempool:
             if self.sig_batcher is not None:
                 item = self.sig_batcher.parse(tx)
                 if item is not None:
-                    if not self.sig_batcher.submit(
-                        item,
-                        ok_cb=lambda: self._dispatch_preverified(tx, cb),
-                        bad_cb=lambda: self._reject_bad_sig(tx, cb),
-                    ):
+                    if not self.sig_batcher.submit(item, (tx, cb)):
                         # gate saturated: refuse retriably, never grow an
                         # unbounded backlog off a peer-driven path
                         self.cache.remove(tx)
@@ -255,12 +292,34 @@ class Mempool:
             if cb is not None:
                 reqres.set_callback(lambda res: cb(res))
 
-    def _dispatch_preverified(self, tx: bytes, cb) -> None:
-        """Signature held: forward to the app (batcher thread)."""
+    def _sig_gate_results(self, results) -> None:
+        """Gate verdicts for one verified batch (batcher thread).
+        Signature-held txs admit to the app in ONE grouped dispatch
+        (check_tx_many_async — one mempool-lock and one app-lock round
+        trip for the whole batch); failures reject without app dispatch,
+        same cache semantics as an app-rejected tx
+        (mempool/mempool.go:231)."""
+        ok_entries = [ctx for ctx, ok in results if ok]
+        for tx, cb in (ctx for ctx, ok in results if not ok):
+            try:
+                self._reject_bad_sig(tx, cb)
+            except Exception:  # noqa: BLE001 — one raising reject callback
+                # (e.g. a dead RPC response writer) must not abort the
+                # batch: the remaining verdicts still have to be
+                # delivered or their txs are stranded in the dedup cache
+                logger.exception("bad-sig reject callback failed")
+        if not ok_entries:
+            return
         with self._mtx:
-            reqres = self.proxy_app_conn.check_tx_async(tx)
+            rrs = self.proxy_app_conn.check_tx_many_async(
+                [tx for tx, _cb in ok_entries]
+            )
+        for (_tx, cb), rr in zip(ok_entries, rrs):
             if cb is not None:
-                reqres.set_callback(lambda res: cb(res))
+                try:
+                    rr.set_callback(cb)
+                except Exception:  # noqa: BLE001 — same isolation rule
+                    logger.exception("check_tx callback failed")
 
     def _reject_bad_sig(self, tx: bytes, cb) -> None:
         """Signature failed the batch gate: reject without app dispatch —

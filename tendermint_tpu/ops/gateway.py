@@ -600,60 +600,48 @@ class ShardedVerifier(Verifier):
 
 
 def device_rtt_ms() -> float | None:
-    """Measured device dispatch round trip (jitcache.probe_rtt_ms run in
-    a bounded THROWAWAY subprocess), cached per process. This is the
-    transport probe the Hasher policy keys on: a locally attached chip
-    answers in <5 ms, the axon tunnel in 85-150 ms.
+    """Measured device dispatch round trip (jitcache.probe_rtt_ms),
+    cached per process under the platform lock (double-checked, like
+    resolve_platform — two concurrent Hasher constructions must not
+    race two probes at an exclusive device). This is the transport
+    probe the Hasher policy keys on: a locally attached chip answers in
+    <5 ms, the axon tunnel in 85-150 ms.
 
-    Device-discipline rules (devd.py postmortems) shape the mechanics:
-    - never dial in-process — a wedged tunnel would hang this process
-      forever and poison jax's backend-init lock, and even a successful
-      dial leaves lifelong device state in a process that might be
-      killed (which wedges the tunnel for the whole machine);
-    - never contend with a device daemon — the probe is skipped whenever
-      a devd SOCKET exists, serving or not: a daemon mid-claim has no
-      ping answer yet, but racing it for the chip is exactly the
-      one-owner violation the socket's existence warns about.
-    Returns None when no accelerator is reachable, a daemon (possibly
-    nascent) is present, or the probe fails."""
+    Ownership reasoning (devd.py one-owner discipline): the probe runs
+    ONLY when the bounded platform resolution says an accelerator
+    answers AND no devd socket exists — serving or mid-claim, a
+    daemon's socket means the chip is (about to be) someone else's.
+    What remains is exactly the direct-kernel topology, where THIS
+    process is the device's owner: the Verifier's kernels dial
+    in-process on this path anyway, so an in-process probe adds no new
+    ownership and reuses the already-initialized backend (near-instant
+    when a kernel has run; one bounded dial otherwise). The dial is
+    bounded by probe_rtt_ms's daemon-thread join — a wedged tunnel
+    parks a thread instead of hanging the node, the same residual risk
+    the direct-kernel path already accepts.
+
+    A failed probe caches as None (CPU hashing) for the process
+    lifetime; TENDERMINT_TPU_HASHES=1 is the operator override."""
     if "rtt" in _platform_cache:
         return _platform_cache["rtt"]
-    rtt: float | None = None
-    try:
-        from tendermint_tpu import devd
+    with _platform_lock:
+        if "rtt" in _platform_cache:
+            return _platform_cache["rtt"]
+        rtt: float | None = None
+        try:
+            from tendermint_tpu import devd
 
-        if on_tpu() and not os.path.exists(devd.sock_path()):
-            import subprocess
-            import sys
+            if on_tpu() and not os.path.exists(devd.sock_path()):
+                from tendermint_tpu.jitcache import probe_rtt_ms
 
-            code = (
-                "from tendermint_tpu.jitcache import probe_rtt_ms;"
-                "r = probe_rtt_ms(60.0);"
-                "print('' if r is None else r, end='')"
-            )
-            repo_root = os.path.dirname(
-                os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-            )
-            proc = subprocess.Popen(
-                [sys.executable, "-c", code],
-                stdout=subprocess.PIPE,
-                stderr=subprocess.DEVNULL,
-                cwd=repo_root,
-            )
-            try:
-                out, _ = proc.communicate(timeout=120)
-            except subprocess.TimeoutExpired:
-                # never kill a process mid-device-op; let it finish alone
-                logger.warning("rtt probe subprocess overran; leaving it")
-                out = b""
-            if proc.returncode == 0 and out:
-                rtt = float(out)
-                logger.info("device rtt: %.1f ms (subprocess probe)", rtt)
-    except Exception:  # noqa: BLE001 — probe failure means no offload
-        logger.exception("device rtt probe failed")
-        rtt = None
-    _platform_cache["rtt"] = rtt
-    return rtt
+                rtt = probe_rtt_ms(30.0)
+                if rtt is not None:
+                    logger.info("device rtt: %.1f ms", rtt)
+        except Exception:  # noqa: BLE001 — probe failure means no offload
+            logger.exception("device rtt probe failed")
+            rtt = None
+        _platform_cache["rtt"] = rtt
+        return rtt
 
 
 # Above this measured dispatch round-trip the hash offload can't win at

@@ -51,6 +51,9 @@ class AppConnMempool:
     def check_tx_async(self, tx: bytes) -> ReqRes:
         return self._client.check_tx_async(tx)
 
+    def check_tx_many_async(self, txs: list[bytes]) -> list[ReqRes]:
+        return self._client.check_tx_many_async(txs)
+
     def flush_async(self) -> ReqRes:
         return self._client.flush_async()
 
