@@ -1,0 +1,168 @@
+"""RSS soak harness (VERDICT r4 #7): run a 4-node localnet under
+continuous tx load, sample per-node RSS, and assert a ~flat post-warmup
+slope. Periodically captures heap profiles through the unsafe RPC route
+(rpc/core/handlers.unsafe_write_heap_profile — the reference's
+rpc/core/dev.go:24-38 equivalent) so any residual growth is NAMED, not
+just measured.
+
+Usage:  python scripts/soak_rss.py [--minutes 60] [--nodes 4]
+Writes: <outdir>/soak_rss.json  (samples, slope, top heap growers)
+
+Slope methodology: least-squares on RSS(t) for t past the warmup cutoff
+(first 25% of the run), per node, in KB/min. "Flat" is < 1% of final
+RSS per 10 minutes — caches (tx LRU, addrbook, block store index) fill
+early and must then hold steady.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+# localnet lives beside the scenarios (same import style scenarios.py
+# uses — the stdlib `test` package shadows a `test.p2p` package path)
+sys.path.insert(0, os.path.join(_REPO, "test", "p2p"))
+
+from localnet import Localnet  # noqa: E402
+
+
+def rss_kb(pid: int) -> int:
+    with open(f"/proc/{pid}/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1])
+    return 0
+
+
+def slope_kb_per_min(samples: list[tuple[float, int]]) -> float:
+    """Least-squares slope of (t_seconds, rss_kb) -> KB/min."""
+    n = len(samples)
+    if n < 2:
+        return 0.0
+    ts = [s[0] / 60.0 for s in samples]
+    ys = [float(s[1]) for s in samples]
+    tm = sum(ts) / n
+    ym = sum(ys) / n
+    denom = sum((t - tm) ** 2 for t in ts)
+    if denom == 0:
+        return 0.0
+    return sum((t - tm) * (y - ym) for t, y in zip(ts, ys)) / denom
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=60.0)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--sample-s", type=float, default=15.0)
+    ap.add_argument("--outdir", default=None)
+    args = ap.parse_args()
+
+    root = args.outdir or tempfile.mkdtemp(prefix="soak-rss-")
+    # own port range: the 46900 default collides with other harness runs
+    net = Localnet(args.nodes, root, base_port=47300)
+    # unsafe routes on so the heap profiler is reachable mid-soak
+    for nd in net.nodes:
+        nd.start(seeds=net.seeds_for(nd.index), extra=["--rpc.unsafe"])
+    print(f"soak: {args.nodes} nodes under {root}, {args.minutes} min")
+    if not net.wait_height(1, timeout=180):
+        print("FATAL: net never reached height 1")
+        net.stop_all()
+        return 1
+
+    t0 = time.monotonic()
+    end = t0 + args.minutes * 60
+    samples: dict[int, list[tuple[float, int]]] = {
+        nd.index: [] for nd in net.nodes
+    }
+    heights: list[tuple[float, int]] = []
+    tx_n = 0
+    heap_paths: list[str] = []
+    next_heap = t0 + args.minutes * 60 * 0.5  # one mid-run heap profile
+    while time.monotonic() < end:
+        # continuous light tx load round-robins the nodes
+        for _ in range(16):
+            nd = net.nodes[tx_n % len(net.nodes)]
+            try:
+                nd.rpc(
+                    "broadcast_tx_async",
+                    {"tx": (b"soak%08d=x" % tx_n).hex()},
+                    timeout=10,
+                )
+            except Exception:  # noqa: BLE001 — a busy node skips a beat
+                pass
+            tx_n += 1
+        now = time.monotonic()
+        for nd in net.nodes:
+            if nd.alive():
+                try:
+                    samples[nd.index].append((now - t0, rss_kb(nd.proc.pid)))
+                except OSError:
+                    pass
+        heights.append((now - t0, max(nd.height() for nd in net.nodes)))
+        if now >= next_heap:
+            next_heap = float("inf")
+            for nd in net.nodes[:1]:  # one node's heap is representative
+                p = os.path.join(root, f"heap-mid-node{nd.index}.txt")
+                try:
+                    nd.rpc("unsafe_write_heap_profile", {"filename": p})
+                    heap_paths.append(p)
+                    print(f"  heap profile written: {p}")
+                except Exception as exc:  # noqa: BLE001
+                    print(f"  heap profile failed: {exc}")
+        time.sleep(max(0.0, args.sample_s - (time.monotonic() - now)))
+
+    # end-of-run heap profile for the same node (diffable against mid)
+    for nd in net.nodes[:1]:
+        p = os.path.join(root, f"heap-end-node{nd.index}.txt")
+        try:
+            nd.rpc("unsafe_write_heap_profile", {"filename": p})
+            heap_paths.append(p)
+        except Exception as exc:  # noqa: BLE001
+            print(f"  end heap profile failed: {exc}")
+    net.stop_all()
+
+    warm_cut = args.minutes * 60 * 0.25
+    report: dict = {
+        "minutes": args.minutes,
+        "nodes": args.nodes,
+        "txs_sent": tx_n,
+        "final_height": heights[-1][1] if heights else 0,
+        "heap_profiles": heap_paths,
+        "per_node": {},
+    }
+    ok = True
+    for idx, ss in samples.items():
+        post = [s for s in ss if s[0] >= warm_cut]
+        sl = slope_kb_per_min(post)
+        final = ss[-1][1] if ss else 0
+        # flat = < 1% of final RSS per 10 min of post-warmup runtime
+        limit = 0.001 * final  # KB/min
+        flat = abs(sl) < max(limit, 50.0)
+        ok = ok and flat
+        report["per_node"][idx] = {
+            "final_rss_kb": final,
+            "post_warmup_slope_kb_per_min": round(sl, 1),
+            "flat_limit_kb_per_min": round(max(limit, 50.0), 1),
+            "flat": flat,
+            "samples": len(ss),
+        }
+        print(
+            f"node{idx}: final {final/1024:.0f} MB, post-warmup slope "
+            f"{sl:+.1f} KB/min ({'flat' if flat else 'GROWING'})"
+        )
+    report["flat"] = ok
+    out = os.path.join(root, "soak_rss.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(f"report: {out}  flat={ok}")
+    return 0 if ok else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,6 +76,8 @@ def cmd_node(args) -> int:
         cfg.rpc.laddr = args.rpc_laddr
     if args.grpc_laddr:
         cfg.rpc.grpc_laddr = args.grpc_laddr
+    if args.rpc_unsafe:
+        cfg.rpc.unsafe = True
     if args.seeds:
         cfg.p2p.seeds = args.seeds
     if args.pex:
@@ -243,6 +245,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--p2p.laddr", dest="p2p_laddr", default=None)
     sp.add_argument("--rpc.laddr", dest="rpc_laddr", default=None)
     sp.add_argument("--rpc.grpc_laddr", dest="grpc_laddr", default=None)
+    sp.add_argument(
+        "--rpc.unsafe", dest="rpc_unsafe", action="store_true",
+        help="enable unsafe RPC routes (profiler, dial_seeds, flush "
+        "mempool — rpc/core/routes.go:37-46 equivalent)",
+    )
     sp.add_argument("--seeds", default=None, help="comma-separated host:port")
     sp.add_argument("--pex", action="store_true")
     sp.add_argument(

@@ -95,6 +95,12 @@ class ConsensusState(BaseService):
         self.done_height = threading.Event()  # pulses on each commit (tests)
         self.n_steps = 0
 
+        # duplicate-vote evidence (beyond reference: state.go:1438-1447
+        # punts with a TODO; we record validated pairs — types/evidence)
+        from tendermint_tpu.types.evidence import EvidencePool
+
+        self.evidence_pool = EvidencePool()
+
         self.evsw: EventSwitch | None = None
 
         # test seams (consensus/state.go:222-226)
@@ -1037,12 +1043,42 @@ class ConsensusState(BaseService):
                     vote.height, vote.round_, vote.type_,
                 )
                 return
-            # TODO evidence pool hand-off (reference punts too, state.go:1443)
+            # Reference punts here with a TODO (state.go:1443); we
+            # validate + record the pair so byzantine drills and the
+            # `evidence` RPC can assert double-signing was seen.
             self.logger.warning("found conflicting vote: %r vs %r", e.vote_a, e.vote_b)
+            self._record_duplicate_vote_evidence(e.vote_a, e.vote_b)
         except UnexpectedStepError:
             pass  # vote for an old height/step — harmless
         except VoteError as e:
             self.logger.warning("bad vote from %s: %s", peer_id or "self", e)
+
+    def _record_duplicate_vote_evidence(self, vote_a: Vote, vote_b: Vote) -> None:
+        """Validate and pool a conflicting-vote pair (never raises — the
+        vote path must survive malformed evidence)."""
+        try:
+            from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+            # a late precommit for the previous height conflicts inside
+            # rs.last_commit (add_vote's height-1 branch) — its signer
+            # lives in LAST height's validator set, which may no longer
+            # contain it (exit-then-double-sign); looking it up in the
+            # current set would silently drop provable evidence
+            vals = self.rs.validators
+            if vote_a.height == self.rs.height - 1 and self.rs.last_validators:
+                vals = self.rs.last_validators
+            _idx, val = vals.get_by_address(vote_a.validator_address)
+            if val is None:
+                return
+            ev = DuplicateVoteEvidence.new(val.pub_key, vote_a, vote_b)
+            if self.evidence_pool.add(ev, self.state.chain_id):
+                self.logger.warning(
+                    "recorded duplicate-vote evidence: val %s at %d/%d/%d",
+                    vote_a.validator_address.hex()[:12], vote_a.height,
+                    vote_a.round_, vote_a.type_,
+                )
+        except Exception:  # noqa: BLE001
+            self.logger.exception("evidence recording failed")
 
     def add_vote(self, vote: Vote, peer_id: str) -> bool:
         """consensus/state.go:1459-1565."""

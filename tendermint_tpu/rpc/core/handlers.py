@@ -415,6 +415,15 @@ def unsafe_write_heap_profile(ctx, filename) -> dict:
     return {"log": f"heap profile written to {filename}"}
 
 
+def evidence(ctx) -> dict:
+    """Recorded duplicate-vote evidence (beyond reference: v0.11 detects
+    conflicts and punts, consensus/state.go:1438-1447 — this surfaces
+    what the node's pool has validated; types/evidence.py)."""
+    pool = getattr(ctx.consensus_state, "evidence_pool", None)
+    evs = pool.list() if pool is not None else []
+    return {"count": len(evs), "evidence": [e.to_json() for e in evs]}
+
+
 ROUTES_TABLE = {
     # info API
     "status": (status, []),
@@ -425,6 +434,7 @@ ROUTES_TABLE = {
     "commit": (commit, ["height"]),
     "validators": (validators, ["height"]),
     "dump_consensus_state": (dump_consensus_state, []),
+    "evidence": (evidence, []),
     "metrics": (metrics, []),
     "tx": (tx, ["hash", "prove"]),
     "unconfirmed_txs": (unconfirmed_txs, []),

@@ -1,0 +1,131 @@
+"""Duplicate-vote evidence: proof a validator signed two conflicting
+votes at the same (height, round, type).
+
+BEYOND the reference: Tendermint v0.11 detects conflicting votes and
+punts with a TODO (consensus/state.go:1438-1447, "TODO: catch these
+and punish"; VoteSet surfaces them as ErrVoteConflictingVotes,
+types/vote_set.go:137-172). Here the detection site hands the pair to an
+EvidencePool so byzantine drills (and operators, via the `evidence` RPC)
+can assert that double-signing was SEEN — slashing/punishment remains
+application policy, exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from tendermint_tpu.crypto.hashing import ripemd160
+from tendermint_tpu.types.vote import Vote
+
+
+class EvidenceError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class DuplicateVoteEvidence:
+    """Two votes by one validator for the same (H, R, type) but
+    different blocks. vote_a/vote_b are stored in canonical order
+    (sorted by block-id key) so the same conflict always hashes the
+    same regardless of arrival order."""
+
+    pub_key: object  # PubKeyEd25519 | PubKeySecp256k1 (crypto/keys.py)
+    vote_a: Vote
+    vote_b: Vote
+
+    @staticmethod
+    def new(pub_key, vote_a: Vote, vote_b: Vote) -> "DuplicateVoteEvidence":
+        if vote_b.block_id.key() < vote_a.block_id.key():
+            vote_a, vote_b = vote_b, vote_a
+        return DuplicateVoteEvidence(pub_key, vote_a, vote_b)
+
+    @property
+    def height(self) -> int:
+        return self.vote_a.height
+
+    @property
+    def address(self) -> bytes:
+        return self.vote_a.validator_address
+
+    def validate(self, chain_id: str) -> None:
+        """Raise EvidenceError unless this really is a double-sign: same
+        validator/H/R/type, DIFFERENT blocks, both signatures valid
+        under pub_key for this chain. Anyone can forge an unvalidated
+        pair; a validated one is cryptographic proof."""
+        a, b = self.vote_a, self.vote_b
+        if (
+            a.validator_address != b.validator_address
+            or a.height != b.height
+            or a.round_ != b.round_
+            or a.type_ != b.type_
+        ):
+            raise EvidenceError("votes are not for the same (val, H, R, type)")
+        if a.block_id.key() == b.block_id.key():
+            raise EvidenceError("votes agree — no conflict")
+        if self.pub_key.address() != a.validator_address:
+            raise EvidenceError("pub_key does not match validator address")
+        for v in (a, b):
+            if v.signature is None or not self.pub_key.verify_bytes(
+                v.sign_bytes(chain_id), v.signature
+            ):
+                raise EvidenceError("invalid signature on evidence vote")
+
+    def hash(self) -> bytes:
+        return ripemd160(
+            self.vote_a.sign_bytes("") + b"/" + self.vote_b.sign_bytes("")
+        )
+
+    def to_json(self):
+        return {
+            "type": "duplicate_vote",
+            "height": self.height,
+            "round": self.vote_a.round_,
+            "vote_type": self.vote_a.type_,
+            "validator_address": self.address.hex().upper(),
+            "vote_a": self.vote_a.to_json(),
+            "vote_b": self.vote_b.to_json(),
+        }
+
+
+class EvidencePool:
+    """Bounded, deduplicated store of validated evidence. Thread-safe:
+    the consensus receive routine adds, the RPC thread lists."""
+
+    def __init__(self, max_size: int = 1024):
+        self._max = max_size
+        self._by_hash: dict[bytes, DuplicateVoteEvidence] = {}
+        self._order: list[bytes] = []
+        self._mtx = threading.Lock()
+
+    def add(self, ev: DuplicateVoteEvidence, chain_id: str) -> bool:
+        """Validate + insert; False if duplicate or invalid (invalid
+        evidence is dropped, not raised — the vote path must not die on
+        a malformed pair). Dedup runs BEFORE validation: a peer
+        re-gossiping a known conflict must cost a hash, not two ed25519
+        verifies per replay."""
+        h = ev.hash()
+        with self._mtx:
+            if h in self._by_hash:
+                return False
+        try:
+            ev.validate(chain_id)
+        except EvidenceError:
+            return False
+        with self._mtx:
+            if h in self._by_hash:
+                return False
+            if len(self._order) >= self._max:
+                old = self._order.pop(0)
+                self._by_hash.pop(old, None)
+            self._by_hash[h] = ev
+            self._order.append(h)
+            return True
+
+    def list(self) -> list[DuplicateVoteEvidence]:
+        with self._mtx:
+            return [self._by_hash[h] for h in self._order]
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._order)

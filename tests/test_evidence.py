@@ -1,0 +1,148 @@
+"""Duplicate-vote evidence tests (beyond reference: v0.11 logs
+conflicting votes and punts, consensus/state.go:1438-1447; here the
+byzantine drill can assert the recorded pair — VERDICT r4 #9)."""
+
+from __future__ import annotations
+
+import pytest
+
+from tendermint_tpu.types import BlockID, PartSetHeader
+from tendermint_tpu.types.evidence import (
+    DuplicateVoteEvidence,
+    EvidencePool,
+)
+from tendermint_tpu.types.vote import VOTE_TYPE_PREVOTE, Vote
+from tests.test_types import make_val_set
+
+BLOCK_A = BlockID(b"\xaa" * 20, PartSetHeader(2, b"\xbb" * 20))
+BLOCK_B = BlockID(b"\xcc" * 20, PartSetHeader(2, b"\xdd" * 20))
+
+
+def _byz_signed_vote(priv, vs, height, round_, block_id, chain_id="test-chain"):
+    """Sign bypassing the PrivValidatorFS double-sign guard (which
+    correctly refuses the second conflicting vote — a real byzantine
+    signer uses the raw key, like test_byzantine.ByzantinePrivValidator)."""
+    idx, _ = vs.get_by_address(priv.get_address())
+    vote = Vote(
+        validator_address=priv.get_address(),
+        validator_index=idx,
+        height=height,
+        round_=round_,
+        type_=VOTE_TYPE_PREVOTE,
+        block_id=block_id,
+    )
+    return vote.with_signature(priv.priv_key.sign(vote.sign_bytes(chain_id)))
+
+
+def _conflicting_pair(priv, vs, height=1, round_=0, chain_id="test-chain"):
+    va = _byz_signed_vote(priv, vs, height, round_, BLOCK_A, chain_id)
+    vb = _byz_signed_vote(priv, vs, height, round_, BLOCK_B, chain_id)
+    return va, vb
+
+
+class TestDuplicateVoteEvidence:
+    def test_valid_pair_validates(self):
+        vs, privs = make_val_set(4)
+        va, vb = _conflicting_pair(privs[0], vs)
+        ev = DuplicateVoteEvidence.new(privs[0].get_pub_key(), va, vb)
+        ev.validate("test-chain")  # no raise
+        assert ev.address == privs[0].get_address()
+        # canonical order: same hash regardless of construction order
+        ev2 = DuplicateVoteEvidence.new(privs[0].get_pub_key(), vb, va)
+        assert ev.hash() == ev2.hash()
+
+    def test_agreeing_votes_rejected(self):
+        vs, privs = make_val_set(4)
+        va = _byz_signed_vote(privs[0], vs, 1, 0, BLOCK_A)
+        ev = DuplicateVoteEvidence.new(privs[0].get_pub_key(), va, va)
+        with pytest.raises(Exception, match="no conflict"):
+            ev.validate("test-chain")
+
+    def test_wrong_pubkey_rejected(self):
+        vs, privs = make_val_set(4)
+        va, vb = _conflicting_pair(privs[0], vs)
+        ev = DuplicateVoteEvidence.new(privs[1].get_pub_key(), va, vb)
+        with pytest.raises(Exception, match="does not match"):
+            ev.validate("test-chain")
+
+    def test_forged_signature_rejected(self):
+        vs, privs = make_val_set(4)
+        va, vb = _conflicting_pair(privs[0], vs)
+        from tendermint_tpu.crypto.keys import SignatureEd25519
+        from dataclasses import replace
+
+        vb = replace(vb, signature=SignatureEd25519(b"\x01" * 64))
+        ev = DuplicateVoteEvidence.new(privs[0].get_pub_key(), va, vb)
+        with pytest.raises(Exception, match="invalid signature"):
+            ev.validate("test-chain")
+
+    def test_wrong_chain_id_rejected(self):
+        vs, privs = make_val_set(4)
+        va, vb = _conflicting_pair(privs[0], vs)
+        ev = DuplicateVoteEvidence.new(privs[0].get_pub_key(), va, vb)
+        with pytest.raises(Exception, match="invalid signature"):
+            ev.validate("other-chain")
+
+
+class TestEvidencePool:
+    def test_add_dedup_and_invalid_dropped(self):
+        vs, privs = make_val_set(4)
+        pool = EvidencePool()
+        va, vb = _conflicting_pair(privs[0], vs)
+        ev = DuplicateVoteEvidence.new(privs[0].get_pub_key(), va, vb)
+        assert pool.add(ev, "test-chain")
+        assert not pool.add(ev, "test-chain")  # dedup
+        # arrival-order-swapped pair is the SAME evidence
+        ev2 = DuplicateVoteEvidence.new(privs[0].get_pub_key(), vb, va)
+        assert not pool.add(ev2, "test-chain")
+        # invalid evidence silently refused
+        bad = DuplicateVoteEvidence.new(privs[1].get_pub_key(), va, vb)
+        assert not pool.add(bad, "test-chain")
+        assert pool.size() == 1
+
+    def test_bounded(self):
+        vs, privs = make_val_set(4)
+        pool = EvidencePool(max_size=2)
+        for r in range(3):
+            va, vb = _conflicting_pair(privs[0], vs, round_=r)
+            assert pool.add(
+                DuplicateVoteEvidence.new(privs[0].get_pub_key(), va, vb),
+                "test-chain",
+            )
+        assert pool.size() == 2  # oldest evicted
+
+
+def test_byzantine_double_vote_recorded_and_served():
+    """The byzantine drill's assertion (VERDICT r4 #9): a validator's
+    conflicting prevotes arriving at a live ConsensusState are detected
+    (the same ConflictingVotesError site the reference logs-and-punts
+    at, state.go:1438-1447), validated against the validator's real key,
+    recorded in the pool, and served by the `evidence` RPC route."""
+    from tests.test_reactors import make_genesis, make_node
+
+    doc, pvs = make_genesis(2)
+    node = make_node(doc, pvs[0])
+    cs = node.cs
+    vs = cs.rs.validators
+    # the OTHER validator double-signs height 1 prevotes
+    byz = pvs[1]
+    va, vb = _conflicting_pair(byz, vs, chain_id=doc.chain_id)
+    cs.try_add_vote(va, "peer1")
+    cs.try_add_vote(vb, "peer1")
+    assert cs.evidence_pool.size() == 1
+    ev = cs.evidence_pool.list()[0]
+    assert ev.address == byz.get_address()
+    assert {ev.vote_a.block_id.key(), ev.vote_b.block_id.key()} == {
+        BLOCK_A.key(), BLOCK_B.key()
+    }
+
+    # the RPC route serves it
+    from tendermint_tpu.rpc.core.handlers import evidence as evidence_route
+
+    class _Ctx:
+        consensus_state = cs
+
+    rep = evidence_route(_Ctx())
+    assert rep["count"] == 1
+    assert rep["evidence"][0]["validator_address"] == byz.get_address().hex().upper()
+    assert rep["evidence"][0]["type"] == "duplicate_vote"
