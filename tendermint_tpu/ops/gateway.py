@@ -124,6 +124,40 @@ def _resolve_platform_locked() -> str | None:
     if rep is not None:
         _platform_cache["v"] = rep.get("platform")
         return _platform_cache["v"]
+    # A daemon mid-claim/warm holds (or is about to hold) the chip:
+    # probing now would contend with its exclusive session — the
+    # one-owner violation the devd discipline exists to prevent — and
+    # latch this process onto the CPU path minutes before the daemon
+    # starts serving. Wait it out (bounded; 0 disables). A daemon whose
+    # own probes fail reports waiting-for-device — then the tunnel is
+    # down for everyone and the bounded subprocess probe below settles
+    # this process honestly.
+    wait_s = float(os.environ.get("TENDERMINT_DEVD_RESOLVE_WAIT_S", "600"))
+    if wait_s > 0 and os.path.exists(devd.sock_path()):
+        import time
+
+        deadline = time.monotonic() + wait_s
+        try:
+            client = devd.DevdClient(devd.sock_path())
+            while time.monotonic() < deadline:
+                ping = client.ping(timeout=3.0)
+                if ping.get("held"):
+                    devd.bust_avail_cache()
+                    rep = devd.available()
+                    break
+                if ping.get("status") == "waiting-for-device":
+                    break
+                logger.info(
+                    "device daemon %r; waiting for it to serve",
+                    ping.get("status"),
+                )
+                time.sleep(5.0)
+            client.close()
+        except Exception:  # noqa: BLE001 — socket died; no daemon after all
+            pass
+        if rep is not None:
+            _platform_cache["v"] = rep.get("platform")
+            return _platform_cache["v"]
     p = devd.subprocess_probe(45.0)
     if p is None:
         pin_jax_cpu()
