@@ -426,5 +426,10 @@ class Mempool:
     def _recheck_txs(self, good_elements: list) -> None:
         self.recheck_cursor = good_elements[0]
         self.recheck_end = good_elements[-1]
-        for el in good_elements:
-            self.proxy_app_conn.check_tx_async(el.value.tx)
+        # grouped dispatch: one app-lock round trip for the whole
+        # survivor set; responses arrive in order, which the recheck
+        # cursor depends on (both the local client's many-path and the
+        # base per-tx loop preserve submission order)
+        self.proxy_app_conn.check_tx_many_async(
+            [el.value.tx for el in good_elements]
+        )
