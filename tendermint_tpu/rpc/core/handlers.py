@@ -340,6 +340,9 @@ def metrics(ctx) -> dict:
     out["consensus_step"] = int(rs.step)
     out["blockstore_height"] = ctx.block_store.height()
     out["consensus_peer_msg_drops"] = ctx.consensus_state.peer_msg_drops
+    pool = getattr(ctx.consensus_state, "evidence_pool", None)
+    if pool is not None:
+        out["evidence_count"] = pool.size()
     out["mempool_size"] = ctx.mempool.size()
     batcher = getattr(ctx.mempool, "sig_batcher", None)
     if batcher is not None:
