@@ -1077,6 +1077,7 @@ class ConsensusState(BaseService):
                     vote_a.validator_address.hex()[:12], vote_a.height,
                     vote_a.round_, vote_a.type_,
                 )
+                self._fire(tev.EVENT_EVIDENCE, ev.to_json())
         except Exception:  # noqa: BLE001
             self.logger.exception("evidence recording failed")
 

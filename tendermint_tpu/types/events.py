@@ -26,6 +26,10 @@ EVENT_RELOCK = "Relock"
 EVENT_TIMEOUT_WAIT = "TimeoutWait"
 EVENT_VOTE = "Vote"
 EVENT_PROPOSAL_HEARTBEAT = "ProposalHeartbeat"
+# beyond reference: fired when duplicate-vote evidence is validated and
+# pooled (types/evidence.py; the reference detects conflicts and punts,
+# consensus/state.go:1438-1447)
+EVENT_EVIDENCE = "Evidence"
 
 
 def event_string_tx(tx_hash: bytes) -> str:

@@ -127,9 +127,17 @@ def test_byzantine_double_vote_recorded_and_served():
     # the OTHER validator double-signs height 1 prevotes
     byz = pvs[1]
     va, vb = _conflicting_pair(byz, vs, chain_id=doc.chain_id)
+    fired: list = []
+    if cs.evsw is not None:
+        from tendermint_tpu.types import events as tev
+
+        cs.evsw.add_listener_for_event(
+            "ev-test", tev.EVENT_EVIDENCE, fired.append
+        )
     cs.try_add_vote(va, "peer1")
     cs.try_add_vote(vb, "peer1")
     assert cs.evidence_pool.size() == 1
+    assert fired and fired[0]["type"] == "duplicate_vote"
     ev = cs.evidence_pool.list()[0]
     assert ev.address == byz.get_address()
     assert {ev.vote_a.block_id.key(), ev.vote_b.block_id.key()} == {
