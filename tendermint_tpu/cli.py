@@ -261,7 +261,7 @@ def build_parser() -> argparse.ArgumentParser:
         "loopback testnets; p2p/addrbook.py routability)",
     )
     sp.add_argument("--log_level", default="info")
-    sp.add_argument("--db_backend", default=None, help="memdb | filedb")
+    sp.add_argument("--db_backend", default=None, help="sqlite | filedb | memdb")
     sp.set_defaults(fn=cmd_node)
 
     sp = sub.add_parser("testnet", help="initialize files for an N-node testnet")

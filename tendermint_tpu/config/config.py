@@ -33,13 +33,17 @@ class BaseConfig:
     fast_sync: bool = True
     filter_peers: bool = False
     tx_index: str = "kv"  # kv | null
-    # filedb (crash-safe journal, the LevelDB-default equivalent) so a
-    # restarted node resumes its chain; memdb is for tests (the kill_all
-    # localnet scenario catches a non-persistent default). NOTE: homes
-    # initialized before this default changed carry an explicit
-    # `db_backend = "memdb"` in config.toml and must edit it by hand —
-    # the loader honors whatever the file says.
-    db_backend: str = "filedb"  # filedb | memdb
+    # sqlite (bounded-RAM persistent store, the LevelDB-default
+    # equivalent) so a restarted node resumes its chain AND steady-state
+    # RSS stays flat as the chain grows — the round-5 soak measured
+    # filedb's in-memory key index growing ~90 KB/min at test cadence
+    # (libs/db.py SqliteDB docstring). filedb (crash-safe journal,
+    # offset-indexed, r4 default) remains selectable; memdb is for tests
+    # (the kill_all localnet scenario catches a non-persistent default).
+    # NOTE: homes initialized before this default changed carry the OLD
+    # explicit backend in config.toml and must edit it by hand — the
+    # loader honors whatever the file says.
+    db_backend: str = "sqlite"  # sqlite | filedb | memdb
     db_path: str = "data"
 
     def genesis_file(self) -> str:
