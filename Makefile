@@ -14,9 +14,22 @@
 # tier (test/p2p/run_docker.sh) remains for docker-capable hosts.
 
 PY ?= python
+# tier1 uses bash process features (PIPESTATUS); everything else is sh-safe
+SHELL := /bin/bash
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# The ROADMAP.md tier-1 verify command, verbatim — the bar every PR must
+# hold (dots no worse than the seed).
+tier1:
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+# Chip-free bench smoke: every BASELINE config on the pinned CPU backend,
+# so a transport/serving-path regression fails fast without hardware
+# (bench_devd_stream asserts the streamed-vs-single-shot win).
+bench-smoke:
+	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu $(PY) benches/run_all.py
 
 test_race:
 	$(PY) -m pytest tests/test_race.py -q
@@ -30,4 +43,4 @@ test_slow:
 native:
 	$(MAKE) -C native
 
-.PHONY: test test_race test_integrations test_slow native
+.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke

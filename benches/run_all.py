@@ -5,7 +5,9 @@ Configs (BASELINE.md):
   2 headline  — VerifyCommit microbench (repo-root bench.py, driver-run)
   3 partset   — 1MB/64KB PartSet Merkle + proofs
   4 fastsync  — pipelined catch-up replay, 1000 validators
-  5 mempool   — 50k-tx CheckTx burst
+  5 mempool   — 50k-tx CheckTx burst + signed-tx gated burst
+  6 devd_stream — serving-path transport: single-shot vs streamed devd
+                  (writes BENCH_r06.json; asserts the streamed win)
 
 Each bench is its own process (the TPU is exclusive per process).
 Usage: python benches/run_all.py [--skip testnet,...]
@@ -27,6 +29,7 @@ BENCHES = {
     "3_partset": [sys.executable, "benches/bench_partset.py"],
     "4_fastsync": [sys.executable, "benches/bench_fastsync.py"],
     "5_mempool": [sys.executable, "benches/bench_mempool.py"],
+    "6_devd_stream": [sys.executable, "benches/bench_devd_stream.py"],
 }
 
 

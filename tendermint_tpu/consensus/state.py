@@ -479,7 +479,11 @@ class ConsensusState(BaseService):
             seen.add(item)
             items.append(item)
         if len(items) >= 2:
-            self.verifier.prime_cache(items)
+            # async prime: the batch is ON the device (streamed chunks
+            # when the devd backend serves) while this thread gets on
+            # with VoteSet bookkeeping; the first add_vote needing a
+            # verdict blocks inside its verify_one pop
+            self.verifier.prime_cache_async(items)
 
     def handle_msg(self, mi: MsgInfo) -> None:
         """consensus/state.go:662-698."""

@@ -58,13 +58,19 @@ class SigBatcher:
 
     def __init__(self, verifier, parse, max_batch: int = 512,
                  max_wait_s: float = 0.002, max_backlog: int = 8192,
-                 on_results=None):
+                 on_results=None, max_inflight: int = 2):
         self.verifier = verifier
         self.parse = parse
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
         self.max_backlog = max_backlog
         self.on_results = on_results
+        # pipelined pre-verify (round 6): up to max_inflight batches are
+        # dispatched via verify_batch_async — batch k's verdicts resolve
+        # while batch k+1's txs are already marshaling toward the device
+        # (streamed chunks on the devd backend), so intake never idles
+        # behind one synchronous verify round trip
+        self.max_inflight = max(1, max_inflight)
         self.dropped = 0
         # Intake is a plain list under a condition variable, swapped out
         # wholesale by the drain thread — NOT a queue.Queue: at burst
@@ -101,15 +107,19 @@ class SigBatcher:
             self._stopped = True
             self._cv.notify()
 
-    def _take_batch(self) -> list | None:
-        """Block until work or stop; linger up to max_wait_s for the
-        burst to fill a batch; swap out up to max_batch items."""
+    def _take_batch(self, wait: bool = True) -> list | None:
+        """Swap out up to max_batch items. wait=True blocks until work or
+        stop, lingering up to max_wait_s for the burst to fill a batch;
+        wait=False (a verify batch is already in flight) grabs whatever
+        accumulated during the last device round trip and returns [] if
+        nothing did. None means stopped AND drained."""
         with self._cv:
-            while not self._buf and not self._stopped:
-                self._cv.wait()
-            if not self._buf and self._stopped:
-                return None
-            if len(self._buf) < self.max_batch and not self._stopped:
+            if wait:
+                while not self._buf and not self._stopped:
+                    self._cv.wait()
+            if not self._buf:
+                return None if self._stopped else []
+            if wait and len(self._buf) < self.max_batch and not self._stopped:
                 deadline = time.monotonic() + self.max_wait_s
                 while len(self._buf) < self.max_batch and not self._stopped:
                     remaining = deadline - time.monotonic()
@@ -121,28 +131,46 @@ class SigBatcher:
             return batch
 
     def _run(self) -> None:
+        from collections import deque
+
+        pending: deque = deque()  # (batch, resolver|None) FIFO
         while True:
-            batch = self._take_batch()
-            if batch is None:
+            batch = self._take_batch(wait=not pending)
+            if batch is None and not pending:
                 return
-            try:
-                oks = self.verifier.verify_batch([b[0] for b in batch])
-            except Exception:  # noqa: BLE001 — fail OPEN: the gate is an
-                # optimization, not the security boundary (DeliverTx
-                # re-verifies unconditionally — apps/signedkv.py), so a
-                # verifier bug may admit junk to the pool but never to a
-                # block; failing closed would drop valid txs instead
-                oks = None
-            results = [
-                (ctx, bool(ok))
-                for (_item, ctx), ok in zip(
-                    batch, oks if oks is not None else [True] * len(batch)
-                )
-            ]
-            try:
-                self.on_results(results)
-            except Exception:  # noqa: BLE001 — a bad sink must not stall the gate
-                logger.exception("sig gate result sink failed")
+            if batch:
+                try:
+                    resolver = self.verifier.verify_batch_async(
+                        [b[0] for b in batch]
+                    )
+                except Exception:  # noqa: BLE001 — fail OPEN at delivery
+                    # (see _deliver); dispatch failures must not stall
+                    # the intake side of the pipeline
+                    logger.exception("sig gate dispatch failed")
+                    resolver = None
+                pending.append((batch, resolver))
+            if pending and (not batch or len(pending) >= self.max_inflight):
+                self._deliver(*pending.popleft())
+
+    def _deliver(self, batch: list, resolver) -> None:
+        try:
+            oks = resolver() if resolver is not None else None
+        except Exception:  # noqa: BLE001 — fail OPEN: the gate is an
+            # optimization, not the security boundary (DeliverTx
+            # re-verifies unconditionally — apps/signedkv.py), so a
+            # verifier bug may admit junk to the pool but never to a
+            # block; failing closed would drop valid txs instead
+            oks = None
+        results = [
+            (ctx, bool(ok))
+            for (_item, ctx), ok in zip(
+                batch, oks if oks is not None else [True] * len(batch)
+            )
+        ]
+        try:
+            self.on_results(results)
+        except Exception:  # noqa: BLE001 — a bad sink must not stall the gate
+            logger.exception("sig gate result sink failed")
 
 
 class TxInCacheError(Exception):

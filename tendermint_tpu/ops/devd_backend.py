@@ -7,6 +7,15 @@ the accelerator tunnel: the daemon (tendermint_tpu/devd.py) owns the
 device; this module is pure socket IPC. That is the wedge-proofing: the
 only process with device state is one that is never killed mid-op.
 
+Transport policy (round 6): batches at or above TENDERMINT_DEVD_STREAM_MIN
+lanes (default 256) ride the STREAMED protocol — fixed-width binary chunk
+frames submitted while the daemon verifies earlier chunks, verdicts
+streaming back per chunk (devd.DevdClient.verify_stream_async; protocol
+in tendermint_tpu/devd.py / docs/streaming-devd.md). Below the threshold
+the single-shot pickle op wins: one small frame beats stream setup. A
+daemon that rejects verify_stream (version skew) latches the single-shot
+path for the process lifetime.
+
 Same contract as the kernel modules (ops/ed25519_f32.py): verify_batch
 returns an array-like of bools; verify_batch_async returns a zero-arg
 resolver. Failures raise — the gateway's existing CPU-fallback handling
@@ -16,6 +25,7 @@ dead device.
 
 from __future__ import annotations
 
+import os
 import threading
 
 import numpy as np
@@ -24,6 +34,9 @@ from tendermint_tpu import devd
 
 _client: devd.DevdClient | None = None
 _mtx = threading.Lock()
+# False once the serving daemon rejected verify_stream — don't pay a
+# doomed stream attempt per batch against a pre-streaming daemon
+_stream_ok = True
 
 
 def _get_client() -> devd.DevdClient:
@@ -34,10 +47,56 @@ def _get_client() -> devd.DevdClient:
         return _client
 
 
+def _stream_min() -> int:
+    try:
+        return int(os.environ.get("TENDERMINT_DEVD_STREAM_MIN", "256"))
+    except ValueError:  # a typo'd env var must not latch the CPU path
+        return 256
+
+
+def _use_stream(n: int) -> bool:
+    return _stream_ok and n >= _stream_min()
+
+
 def verify_batch(items) -> np.ndarray:
-    return np.asarray(_get_client().verify_batch(items), dtype=bool)
+    items = list(items)
+    c = _get_client()
+    if _use_stream(len(items)):
+        try:
+            return np.asarray(c.verify_stream(items), dtype=bool)
+        except devd.DevdError as exc:
+            if "too old" not in str(exc):
+                raise
+            _latch_single_shot()
+    return np.asarray(c.verify_batch(items), dtype=bool)
 
 
 def verify_batch_async(items):
-    resolve = _get_client().verify_batch_async(items)
+    items = list(items)
+    c = _get_client()
+    if _use_stream(len(items)):
+        resolve = c.verify_stream_async(items)
+
+        def resolve_stream() -> np.ndarray:
+            try:
+                return np.asarray(resolve(), dtype=bool)
+            except devd.DevdError as exc:
+                if "too old" not in str(exc):
+                    raise
+                _latch_single_shot()
+                return np.asarray(c.verify_batch(items), dtype=bool)
+
+        return resolve_stream
+    resolve = c.verify_batch_async(items)
     return lambda: np.asarray(resolve(), dtype=bool)
+
+
+def _latch_single_shot() -> None:
+    global _stream_ok
+    _stream_ok = False
+
+
+def stream_stats() -> dict:
+    """Client-side streamed-transport counters; Verifier.stats() exposes
+    them so the serving path is observable from the node process too."""
+    return _get_client().stream_stats()

@@ -22,6 +22,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 
 import numpy as np
 
@@ -269,6 +270,42 @@ def _split_by_key_type(items: list[Item]):
     return ed_items, ed_pos, other_items, other_pos
 
 
+class _PendingBatch:
+    """An in-flight prime_cache_async dispatch. Each primed item maps to
+    the shared handle; a background thread materializes the verdicts the
+    moment the device answers — so the transport is ALWAYS drained (a
+    devd stream whose resolver never ran would strand its connection and
+    the daemon's sender), even when no verify_one ever pops an item
+    (FIFO eviction, re-primed duplicates). result_for just waits."""
+
+    __slots__ = ("_done", "_event")
+
+    def __init__(self, items: list[Item], resolve):
+        self._done: dict[Item, bool] = {}
+        self._event = threading.Event()
+
+        def materialize() -> None:
+            try:
+                self._done.update(
+                    (it, bool(ok)) for it, ok in zip(items, resolve())
+                )
+            except Exception:  # noqa: BLE001 — resolver fallbacks should
+                # make this unreachable; unprimed items re-verify on CPU
+                logger.exception("async prime resolve failed")
+            finally:
+                self._event.set()
+
+        threading.Thread(
+            target=materialize, daemon=True, name="gateway-prime"
+        ).start()
+
+    def result_for(self, item: Item) -> bool | None:
+        """The primed verdict, or None if the batch failed to resolve
+        (caller re-verifies on CPU — never reject on transport loss)."""
+        self._event.wait()
+        return self._done.get(item)
+
+
 class Verifier:
     """Batch signature verifier with TPU acceleration and CPU fallback."""
 
@@ -470,6 +507,9 @@ class Verifier:
         pluggable callable."""
         with self._mtx:
             primed = self._primed.pop((pubkey, msg, sig), None)
+        if isinstance(primed, _PendingBatch):
+            # wait OUTSIDE the mutex: this blocks on the device
+            primed = primed.result_for((pubkey, msg, sig))
         if primed is not None:
             return primed
         with self._mtx:
@@ -491,9 +531,39 @@ class Verifier:
             while len(self._primed) > self._primed_cap:
                 self._primed.pop(next(iter(self._primed)))
 
+    def prime_cache_async(self, items: list[Item]) -> None:
+        """Pipelined prime_cache: dispatch the batch to the device NOW
+        (verify_batch_async — streamed chunks on the devd backend) and
+        park a pending handle per item; the first verify_one to pop one
+        blocks for the batch verdicts. The caller's host work between
+        dispatch and first pop (vote-set bookkeeping, canonical-dup
+        checks in consensus/state._prime_vote_batch) overlaps marshal,
+        IPC, and device compute instead of serializing behind them."""
+        if not items:
+            return
+        pending = _PendingBatch(items, self.verify_batch_async(items))
+        with self._mtx:
+            for it in items:
+                self._primed[it] = pending
+            while len(self._primed) > self._primed_cap:
+                self._primed.pop(next(iter(self._primed)))
+
     def stats(self) -> dict:
         with self._mtx:
-            return dict(self._stats)
+            out = dict(self._stats)
+        if self._kernel == "devd":
+            # serving-path observability: fold the streamed-transport
+            # counters in so a node's stats() shows the data plane.
+            # FLAT numeric keys — the metrics RPC (rpc/core/handlers.py)
+            # exports stats() as scalar gauges
+            try:
+                from tendermint_tpu.ops import devd_backend
+
+                for k, val in devd_backend.stream_stats().items():
+                    out[k if k.startswith("stream") else f"stream_{k}"] = val
+            except Exception:  # noqa: BLE001 — stats must never raise
+                pass
+        return out
 
     # -- adapters for the call sites --------------------------------------
 
@@ -729,11 +799,26 @@ class Hasher:
         self._stats = {
             "tpu_part_batches": 0, "tpu_leaves": 0,
             "tpu_tx_roots": 0, "cpu_leaves": 0,
+            # batch-shape observability (same spirit as the verify
+            # stream counters): bytes through the batched hash path and
+            # the last/EWMA per-batch latency, so a misbehaving hash
+            # transport is measurable in production, not just in benches
+            "batch_bytes": 0, "batch_ms_last": 0.0, "batch_ms_avg": 0.0,
         }
 
     def stats(self) -> dict:
         with self._mtx:
             return dict(self._stats)
+
+    def _note_batch(self, n_bytes: int, dt_s: float) -> None:
+        ms = dt_s * 1000.0
+        with self._mtx:
+            s = self._stats
+            s["batch_bytes"] += n_bytes
+            s["batch_ms_last"] = round(ms, 3)
+            s["batch_ms_avg"] = round(
+                0.8 * s["batch_ms_avg"] + 0.2 * ms, 3
+            ) if s["batch_ms_avg"] else round(ms, 3)
 
     def part_leaf_hashes(self, chunks: list[bytes]) -> list[bytes]:
         """Part.Hash batch — for PartSet.from_data(hasher=...)."""
@@ -741,7 +826,11 @@ class Hasher:
             try:
                 from tendermint_tpu.ops import merkle as ops_merkle
 
+                t0 = time.perf_counter()
                 out = ops_merkle.part_leaf_hashes(chunks)
+                self._note_batch(
+                    sum(len(c) for c in chunks), time.perf_counter() - t0
+                )
                 with self._mtx:
                     self._stats["tpu_part_batches"] += 1
                     self._stats["tpu_leaves"] += len(chunks)
@@ -772,8 +861,12 @@ class Hasher:
             try:
                 from tendermint_tpu.ops import merkle as ops_merkle
 
+                t0 = time.perf_counter()
                 out = ops_merkle.merkle_root_from_leaf_digests(
                     ops_merkle.leaf_hashes(txs)
+                )
+                self._note_batch(
+                    sum(len(t) for t in txs), time.perf_counter() - t0
                 )
                 with self._mtx:
                     self._stats["tpu_tx_roots"] += 1

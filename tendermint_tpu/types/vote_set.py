@@ -6,7 +6,12 @@ memory-bounding argument are preserved).
 The per-vote Ed25519 verify here (reference types/vote_set.go:175) is a TPU
 hot path: `add_vote` takes an optional single-item verifier, and the
 consensus layer batches votes through ops.gateway before insertion; the
-observable accept/reject behavior is identical either way.
+observable accept/reject behavior is identical either way. Since round 6
+the drained-vote batch is primed ASYNCHRONOUSLY
+(gateway.Verifier.prime_cache_async from consensus/state._prime_vote_batch):
+the signatures stream to the device daemon in chunks while this module's
+bookkeeping for the leading votes runs, and the first add_vote whose
+verifier pop needs a verdict blocks for the batch.
 """
 
 from __future__ import annotations
