@@ -21,15 +21,24 @@ test:
 	$(PY) -m pytest tests/ -q
 
 # The ROADMAP.md tier-1 verify command, verbatim — the bar every PR must
-# hold (dots no worse than the seed).
-tier1:
+# hold (dots no worse than the seed) — plus the chip-free hash-stream
+# smoke (the two asserted BENCH_r07 rows: streamed hash offload >= 1.3x
+# single-shot on the sim transport, flat host builder >= 1.5x recursive).
+tier1: hash-stream-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Chip-free bench smoke: every BASELINE config on the pinned CPU backend,
 # so a transport/serving-path regression fails fast without hardware
-# (bench_devd_stream asserts the streamed-vs-single-shot win).
+# (bench_devd_stream asserts the streamed-vs-single-shot win;
+# bench_partset asserts the hash-stream + flat-builder wins).
 bench-smoke:
 	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu $(PY) benches/run_all.py
+
+# Hash-plane smoke, chip-free and fast (~30 s): only bench_partset's two
+# asserted rows — sim-transport hash_stream and the flat host builder —
+# with no jax offload compile. Runs as part of `make tier1`.
+hash-stream-smoke:
+	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_PARTSET_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_partset.py
 
 test_race:
 	$(PY) -m pytest tests/test_race.py -q
@@ -43,4 +52,4 @@ test_slow:
 native:
 	$(MAKE) -C native
 
-.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke
+.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke

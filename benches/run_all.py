@@ -3,7 +3,11 @@
 Configs (BASELINE.md):
   1 testnet   — 4-validator kvstore net, commit-hash parity
   2 headline  — VerifyCommit microbench (repo-root bench.py, driver-run)
-  3 partset   — 1MB/64KB PartSet Merkle + proofs
+  3 partset   — 1MB/64KB PartSet Merkle + proofs, plus the r7 hash-plane
+                rows: sim-transport streamed-vs-single-shot hash offload
+                (asserted >= 1.3x) and flat-vs-recursive host proofs
+                builder (asserted >= 1.5x); writes BENCH_r07.json with
+                per-row platform, chip-free
   4 fastsync  — pipelined catch-up replay, 1000 validators
   5 mempool   — 50k-tx CheckTx burst + signed-tx gated burst
   6 devd_stream — serving-path transport: single-shot vs streamed devd

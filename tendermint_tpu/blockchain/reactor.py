@@ -58,6 +58,7 @@ class BlockchainReactor(Reactor, BaseService):
         batch_verifier=None,
         async_batch_verifier=None,
         part_hasher=None,
+        part_tree_hasher=None,
         status_update_interval: float = STATUS_UPDATE_INTERVAL,
         pipeline_depth: int = 8,
         group_sig_target: int = 4096,
@@ -77,6 +78,7 @@ class BlockchainReactor(Reactor, BaseService):
         self.batch_verifier = batch_verifier
         self.async_batch_verifier = async_batch_verifier
         self.part_hasher = part_hasher
+        self.part_tree_hasher = part_tree_hasher
         # speculative verify pipeline (see _dispatch_speculative): device
         # batches in flight keyed by block hash -> (valset_hash, finish),
         # plus the part sets hashed ahead for those blocks.
@@ -255,6 +257,11 @@ class BlockchainReactor(Reactor, BaseService):
             return block.make_part_set(
                 self.state.params().block_gossip.block_part_size_bytes,
                 hasher=self.part_hasher,
+                # one-pass leaf digests + proof tree when the offload
+                # path serves (devd hash_stream tree frame) — fast-sync
+                # rebuilds a part set per synced block, the heaviest
+                # part-set-construction path in the system
+                tree_hasher=self.part_tree_hasher,
             )
         finally:
             self.stage_s["part_hash"] += time.perf_counter() - t0

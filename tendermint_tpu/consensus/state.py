@@ -711,6 +711,10 @@ class ConsensusState(BaseService):
             app_hash=self.state.app_hash,
             part_size=self.state.params().block_gossip.block_part_size_bytes,
             part_hasher=self.part_hasher.part_leaf_hashes,
+            # proposal part sets: leaf digests + the whole proof tree in
+            # one offload pass when the hash plane serves (devd
+            # hash_stream tree frame); None -> the flat host builder
+            part_tree_hasher=self.part_hasher.part_set_tree,
         )
 
     # -- step: prevote -----------------------------------------------------

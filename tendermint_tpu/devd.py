@@ -25,8 +25,8 @@ TPU-native replacement: one chip, one owner, many client processes.
 
 Wire protocol (trusted local IPC, socket mode 0600, root-only box):
 4-byte big-endian length + pickled dict. Requests: {"op": "ping" |
-"verify" | "verify_stream" | "stats" | "status" | "shutdown", ...}.
-Replies: {"ok": bool, ...}.
+"verify" | "verify_stream" | "hash" | "hash_stream" | "stats" |
+"status" | "bench" | "shutdown", ...}. Replies: {"ok": bool, ...}.
 
 Streaming transport (round 6 — docs/streaming-devd.md): the single-shot
 "verify" op serializes the WHOLE batch into one pickle frame and blocks
@@ -47,6 +47,20 @@ TENDERMINT_DEVD_STREAM_DEPTH chunks in flight. A malformed chunk frame
 answers with an error result frame (status 1) and closes the stream —
 never a hang. Accept/reject semantics are lane-for-lane identical to
 the single-shot op (same Verifier underneath).
+
+Hash plane (round 7 — same doc): the "hash" / "hash_stream" ops extend
+the chunked data plane to the Merkle workload that BENCHES.json
+`3_partset` showed losing 90x through single monolithic round trips
+(offload 2.28 vs CPU 205 MB/s). A hash chunk frame carries contiguous
+leaf planes (lengths + packed bytes, np.frombuffer decode), each chunk
+dispatches to the batched RIPEMD-160 kernel as it decodes, and 20-byte
+digests stream back per chunk in order under the same in-flight bound
+and malformed-frame semantics. With "tree": true the daemon runs the
+vectorized tree kernel over the accumulated leaf digests after the last
+chunk and appends ONE tree frame carrying every internal node
+(postorder — merkle.simple.FlatTree slot order), so part-set proofs
+cost the host zero hashing. Digests are byte-identical to
+crypto.hashing.ripemd160 / merkle.simple (parity-tested).
 """
 
 from __future__ import annotations
@@ -123,6 +137,13 @@ def _recv_frame(conn: socket.socket):
 
 STREAM_OK = 0
 STREAM_ERR = 1
+# hash_stream only: the post-chunk frame carrying the tree's internal
+# nodes (postorder) when the request asked for "tree": true
+STREAM_TREE = 2
+
+# hash modes: "part" = raw ripemd160 per item (Part.Hash), "leaf" =
+# ripemd160 of the length-prefixed item (merkle.simple.leaf_hash)
+HASH_MODES = ("part", "leaf")
 
 
 def _pack_chunk(items) -> bytes:
@@ -201,6 +222,71 @@ def _send_result_frame(conn: socket.socket, index: int, oks) -> None:
     conn.sendall(struct.pack(">I", len(payload)) + payload)
 
 
+# -- hash chunk codec ---------------------------------------------------------
+#
+# One hash chunk frame carries n leaf payloads as two contiguous planes —
+#   u32 n | lens u32*n | payload bytes concatenated
+# — decoded daemon-side with ONE np.frombuffer for the lengths plus
+# C-level bytes slicing for the payloads (no per-item pickling). Digest
+# result frames:
+#   status u8 (0=ok) | index u32 | n u32 | digests 20*n
+#   status u8 (1=err) | index u32 | utf-8 error message
+#   status u8 (2=tree) | count u32 | internal nodes 20*count  (postorder;
+#            sent once, after the last chunk's digests, iff "tree": true)
+# Error semantics match the verify stream: an error frame terminates the
+# stream and the daemon closes the connection.
+
+
+def _pack_hash_chunk(items) -> bytes:
+    """items: [bytes] -> one hash chunk frame payload (lengths plane +
+    packed bytes; list-join C-loop work, mirroring _pack_chunk)."""
+    import numpy as np
+
+    n = len(items)
+    lens = np.fromiter(map(len, items), dtype="<u4", count=n)
+    return b"".join((struct.pack("<I", n), lens.tobytes(), b"".join(items)))
+
+
+def _unpack_hash_chunk(payload: bytes) -> list:
+    """Inverse of _pack_hash_chunk; raises ValueError on any malformed
+    frame (same validation discipline as _unpack_chunk)."""
+    import numpy as np
+
+    if len(payload) < 4:
+        raise ValueError("hash chunk frame shorter than its item count")
+    (n,) = struct.unpack_from("<I", payload, 0)
+    if n > _MAX_CHUNK_LANES:
+        raise ValueError(f"hash chunk claims {n} items (max {_MAX_CHUNK_LANES})")
+    fixed = 4 + n * 4
+    if fixed > len(payload):
+        raise ValueError(
+            f"hash chunk truncated: {len(payload)} bytes < {fixed} length plane"
+        )
+    lens_arr = np.frombuffer(payload, dtype="<u4", count=n, offset=4)
+    if fixed + int(lens_arr.sum()) != len(payload):
+        raise ValueError(
+            f"hash chunk size mismatch: {len(payload)} != "
+            f"{fixed + int(lens_arr.sum())}"
+        )
+    items, off = [], fixed
+    for ln in lens_arr.tolist():
+        items.append(payload[off: off + ln])
+        off += ln
+    return items
+
+
+def _send_digest_frame(conn: socket.socket, index: int, digests) -> None:
+    payload = struct.pack("<BII", STREAM_OK, index, len(digests)) + b"".join(
+        digests
+    )
+    conn.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _send_tree_frame(conn: socket.socket, nodes) -> None:
+    payload = struct.pack("<BI", STREAM_TREE, len(nodes)) + b"".join(nodes)
+    conn.sendall(struct.pack(">I", len(payload)) + payload)
+
+
 def _send_error_frame(conn: socket.socket, index: int, msg: str) -> None:
     payload = struct.pack("<BI", STREAM_ERR, index) + msg.encode()
     conn.sendall(struct.pack(">I", len(payload)) + payload)
@@ -214,6 +300,7 @@ class _DaemonState:
         self.started = time.time()
         self.platform: str | None = None
         self.verifier = None  # ops.gateway.Verifier once the device is held
+        self.hasher = None    # hash backend once the device is held
         self.warmed: list[int] = []
         self.status = "starting"
         self.lock = threading.Lock()
@@ -236,10 +323,31 @@ class _DaemonState:
             "chunk_device_ms_last": 0.0,   # dispatch->verdict, last chunk
             "chunk_device_ms_avg": 0.0,    # EWMA (alpha .2) of the same
         }
+        # hash-plane observability (ISSUE 2): same gauge shape as the
+        # verify stream, "lanes" = leaves hashed; plus the tree-frame and
+        # single-shot hash-op counters
+        self.hash_stream = {
+            "streams": 0,
+            "chunks": 0,
+            "lanes": 0,
+            "bytes_framed": 0,
+            "inflight": 0,
+            "inflight_max": 0,
+            "errors": 0,
+            "trees": 0,              # tree frames served (proof-free part sets)
+            "single_batches": 0,     # single-shot "hash" op requests
+            "single_lanes": 0,
+            "chunk_device_ms_last": 0.0,
+            "chunk_device_ms_avg": 0.0,
+        }
 
     def stream_stats(self) -> dict:
         with self.lock:
             return dict(self.stream)
+
+    def hash_stream_stats(self) -> dict:
+        with self.lock:
+            return dict(self.hash_stream)
 
 
 class _SimVerifier:
@@ -293,6 +401,89 @@ class _SimVerifier:
             return dict(self._stats)
 
 
+class _DevdHasher:
+    """In-daemon hash backend for the real (jax) daemon: the batched
+    RIPEMD-160 kernel (ops/hashing) on the held device. Dispatch rides
+    jax's async execution — hash_batch_async packs and enqueues NOW and
+    materializes in the resolver, so the stream handler decodes chunk
+    N+1 while chunk N's compressions run."""
+
+    def hash_batch_async(self, items, mode: str):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tendermint_tpu.ops import hashing as oh
+
+        if mode == "leaf":
+            from tendermint_tpu.codec.binary import encode_bytes
+
+            msgs = [encode_bytes(it) for it in items]
+        else:
+            msgs = list(items)
+        if not msgs:
+            return lambda: []
+        words, nblocks = oh.pack_messages(msgs, little_endian=True)
+        out = oh.ripemd160_words(jnp.asarray(words), jnp.asarray(nblocks))
+
+        def resolve():
+            return oh.digests_to_bytes_le(np.asarray(out))
+
+        return resolve
+
+    def tree_internal_nodes(self, digests):
+        """Postorder internal nodes over the leaf digests, via the
+        vectorized tree kernel (ops/merkle) — the tree frame payload."""
+        from tendermint_tpu.ops import merkle as ops_merkle
+
+        return ops_merkle.tree_nodes_from_leaf_digests(digests)[len(digests):]
+
+
+class _SimHasher:
+    """Transport-bench stand-in for the hash kernel (same
+    TENDERMINT_DEVD_SIM_RATE gate as _SimVerifier): ONE FIFO worker
+    computes REAL digests (crypto.hashing — byte-identical, so parity
+    holds even in sim mode) and charges simulated device time at
+    rate items/s, so streamed-vs-single-shot isolates the transport with
+    device time held constant."""
+
+    def __init__(self, rate: float):
+        self.rate = float(rate)
+        self._q: queuelib.Queue = queuelib.Queue()
+        threading.Thread(target=self._worker, daemon=True,
+                         name="devd-simhash").start()
+
+    def _worker(self) -> None:
+        from tendermint_tpu.codec.binary import encode_bytes
+        from tendermint_tpu.crypto.hashing import ripemd160
+
+        while True:
+            items, mode, box, done = self._q.get()
+            try:
+                if mode == "leaf":
+                    box.extend(ripemd160(encode_bytes(it)) for it in items)
+                else:
+                    box.extend(ripemd160(it) for it in items)
+                time.sleep(len(items) / self.rate)
+            finally:
+                done.set()
+
+    def hash_batch_async(self, items, mode: str):
+        box: list = []
+        done = threading.Event()
+        self._q.put((list(items), mode, box, done))
+
+        def resolve():
+            done.wait()
+            return box
+
+        return resolve
+
+    def tree_internal_nodes(self, digests):
+        from tendermint_tpu.merkle.simple import flat_tree_from_leaf_digests
+
+        return flat_tree_from_leaf_digests(digests).internal_nodes()
+
+
 def subprocess_probe(timeout_s: float) -> str | None:
     """Dial the device in a THROWAWAY subprocess; the platform name or
     None. The probe bounds itself (jitcache.probe_device daemon-thread
@@ -340,6 +531,7 @@ def _device_loop(st: _DaemonState, *, accept_cpu: bool, probe_timeout: float,
         with st.lock:
             st.platform = "cpu"
             st.verifier = _SimVerifier(sim_rate)
+            st.hasher = _SimHasher(sim_rate)
             st.status = "serving"
         logger.info("sim device (%.0f sigs/s); serving", sim_rate)
         return
@@ -499,6 +691,10 @@ def _device_loop(st: _DaemonState, *, accept_cpu: bool, probe_timeout: float,
             with st.lock:
                 st.platform = platform if not accept_cpu else "cpu"
                 st.verifier = verifier
+                # hash plane rides the same held device; compiles lazily
+                # on the first hash op (part widths repeat, so the jit
+                # cache hits from then on)
+                st.hasher = _DevdHasher()
                 st.status = "serving"
             logger.info("device held (%s); serving", st.platform)
             return
@@ -539,7 +735,72 @@ def _handle_verify_stream(conn: socket.socket, st: _DaemonState,
         return False
     with st.lock:
         st.stream["streams"] += 1
+    return _serve_stream(
+        conn, st, st.stream, n_chunks,
+        _unpack_chunk, v.verify_batch_async, _send_result_frame,
+    )
 
+
+def _handle_hash_stream(conn: socket.socket, st: _DaemonState,
+                        req: dict) -> bool:
+    """Serve one hash_stream request on the shared stream core: hash
+    chunk frames decode as they arrive, each dispatches to the batched
+    RIPEMD-160 kernel, digest frames stream back per chunk in order.
+    With "tree": true the leaf digests accumulate (in chunk order,
+    through the sender thread) and ONE tree frame with every internal
+    node follows the last digest frame — proofs come free host-side."""
+    n_chunks = int(req.get("chunks", 0))
+    mode = req.get("mode", "part")
+    want_tree = bool(req.get("tree"))
+    h = st.hasher
+    if h is None or n_chunks < 0 or mode not in HASH_MODES:
+        _send_error_frame(
+            conn, 0xFFFFFFFF,
+            f"device not held (status: {st.status})" if h is None
+            else (f"bad chunk count {n_chunks}" if n_chunks < 0
+                  else f"bad hash mode {mode!r}"),
+        )
+        return False
+    with st.lock:
+        st.hash_stream["streams"] += 1
+    leaves: list = []
+    ok = _serve_stream(
+        conn, st, st.hash_stream, n_chunks,
+        _unpack_hash_chunk, lambda items: h.hash_batch_async(items, mode),
+        _send_digest_frame,
+        on_result=(leaves.extend if want_tree else None),
+    )
+    if not ok:
+        return False
+    if want_tree:
+        try:
+            nodes = h.tree_internal_nodes(leaves) if len(leaves) > 1 else []
+            _send_tree_frame(conn, nodes)
+            with st.lock:
+                st.hash_stream["trees"] += 1
+        except Exception as exc:  # noqa: BLE001 — tree build/send died
+            logger.exception("hash tree build failed")
+            try:
+                _send_error_frame(conn, n_chunks, f"{type(exc).__name__}: {exc}")
+            except Exception:
+                pass
+            with st.lock:
+                st.hash_stream["errors"] += 1
+            return False
+    return True
+
+
+def _serve_stream(conn: socket.socket, st: _DaemonState, gauges: dict,
+                  n_chunks: int, unpack, dispatch, send_result,
+                  on_result=None) -> bool:
+    """The chunked-stream serving core shared by verify_stream and
+    hash_stream: bounded in-flight dispatch, in-order result frames from
+    a sender thread, error-frame-then-close on any malformed frame.
+    `gauges` is the st-owned counter dict (st.stream / st.hash_stream —
+    same keys); `dispatch(items)` returns a zero-arg resolver;
+    `send_result(conn, idx, result)` frames one chunk's result;
+    `on_result(result)` (optional) observes results in chunk order from
+    the sender thread. Returns True when the connection stays usable."""
     depth = threading.Semaphore(_stream_depth())
     results: queuelib.Queue = queuelib.Queue()
     send_ok = threading.Event()
@@ -555,14 +816,14 @@ def _handle_verify_stream(conn: socket.socket, st: _DaemonState,
                 if isinstance(resolver_or_err, str):
                     _send_error_frame(conn, idx, resolver_or_err)
                     with st.lock:
-                        st.stream["errors"] += 1
+                        gauges["errors"] += 1
                     send_ok.clear()
                     return
                 counted = False
-                oks = resolver_or_err()
+                res = resolver_or_err()
                 dt_ms = (time.time() - t_disp) * 1000.0
                 with st.lock:
-                    s = st.stream
+                    s = gauges
                     s["inflight"] -= 1
                     counted = True
                     s["chunks"] += 1
@@ -571,7 +832,9 @@ def _handle_verify_stream(conn: socket.socket, st: _DaemonState,
                     s["chunk_device_ms_avg"] = round(
                         0.8 * s["chunk_device_ms_avg"] + 0.2 * dt_ms, 3
                     ) if s["chunk_device_ms_avg"] else round(dt_ms, 3)
-                _send_result_frame(conn, idx, oks)
+                if on_result is not None:
+                    on_result(res)
+                send_result(conn, idx, res)
             except Exception as exc:  # noqa: BLE001 — resolve/send died
                 logger.exception("stream chunk %d failed", idx)
                 try:
@@ -579,12 +842,12 @@ def _handle_verify_stream(conn: socket.socket, st: _DaemonState,
                 except Exception:
                     pass
                 with st.lock:
-                    st.stream["errors"] += 1
+                    gauges["errors"] += 1
                     # decrement exactly once per dispatched chunk: the
                     # success path may have counted it before the send
                     # died (a post-send failure must not double-count)
                     if not isinstance(resolver_or_err, str) and not counted:
-                        st.stream["inflight"] -= 1
+                        gauges["inflight"] -= 1
                 send_ok.clear()
                 return
             finally:
@@ -607,7 +870,7 @@ def _handle_verify_stream(conn: socket.socket, st: _DaemonState,
         for idx in range(n_chunks):
             try:
                 payload = _recv_raw_frame(conn)
-                items = _unpack_chunk(payload)
+                items = unpack(payload)
             except (ConnectionError, EOFError):
                 aborted = True
                 break
@@ -621,13 +884,13 @@ def _handle_verify_stream(conn: socket.socket, st: _DaemonState,
                 aborted = True
                 break
             try:
-                resolver = v.verify_batch_async(items)
+                resolver = dispatch(items)
             except Exception as exc:  # noqa: BLE001 — dispatch failed
                 results.put((idx, f"{type(exc).__name__}: {exc}", 0, 0.0))
                 aborted = True
                 break
             with st.lock:
-                s = st.stream
+                s = gauges
                 s["bytes_framed"] += len(payload)
                 s["inflight"] += 1
                 s["inflight_max"] = max(s["inflight_max"], s["inflight"])
@@ -647,7 +910,7 @@ def _handle_verify_stream(conn: socket.socket, st: _DaemonState,
                 leaked += 1
         if leaked:
             with st.lock:
-                st.stream["inflight"] -= leaked
+                gauges["inflight"] -= leaked
     return not aborted and send_ok.is_set()
 
 
@@ -680,13 +943,47 @@ def _handle_conn(conn: socket.socket, st: _DaemonState) -> None:
                     if op == "status":
                         # the serving-path bottleneck, measurable in
                         # production: chunks in flight, bytes framed,
-                        # per-chunk device latency (ISSUE 1 satellite)
+                        # per-chunk device latency (ISSUE 1 satellite;
+                        # hash plane ISSUE 2)
                         rep["stream"] = st.stream_stats()
+                        rep["hash_stream"] = st.hash_stream_stats()
                         rep["stream_depth"] = _stream_depth()
                     _send_frame(conn, rep)
                 elif op == "verify_stream":
                     if not _handle_verify_stream(conn, st, req):
                         return  # stream aborted; framing is untrustworthy
+                elif op == "hash_stream":
+                    if not _handle_hash_stream(conn, st, req):
+                        return  # stream aborted; framing is untrustworthy
+                elif op == "hash":
+                    # single-shot hash: one pickle frame each way — what
+                    # small batches ride (stream setup loses below
+                    # TENDERMINT_DEVD_STREAM_MIN) and the baseline the
+                    # hash-stream bench row measures against
+                    h = st.hasher
+                    mode = req.get("mode", "part")
+                    if h is None:
+                        _send_frame(conn, {
+                            "ok": False,
+                            "error": f"device not held (status: {st.status})",
+                        })
+                    elif mode not in HASH_MODES:
+                        _send_frame(conn, {
+                            "ok": False, "error": f"bad hash mode {mode!r}",
+                        })
+                    else:
+                        items = [bytes(b) for b in req.get("items", [])]
+                        digests = h.hash_batch_async(items, mode)()
+                        rep = {"ok": True, "digests": digests}
+                        if req.get("tree"):
+                            rep["nodes"] = (
+                                h.tree_internal_nodes(digests)
+                                if len(digests) > 1 else []
+                            )
+                        with st.lock:
+                            st.hash_stream["single_batches"] += 1
+                            st.hash_stream["single_lanes"] += len(items)
+                        _send_frame(conn, rep)
                 elif op == "verify":
                     v = st.verifier
                     if v is None:
@@ -702,6 +999,7 @@ def _handle_conn(conn: socket.socket, st: _DaemonState) -> None:
                         "ok": True,
                         "stats": held_stats(),
                         "stream": st.stream_stats(),
+                        "hash_stream": st.hash_stream_stats(),
                     })
                 elif op == "bench":
                     # In-daemon pipelined throughput measurement: the one
@@ -939,6 +1237,13 @@ class DevdClient:
             "stream_batches": 0, "stream_chunks_out": 0,
             "stream_lanes": 0, "stream_bytes_out": 0, "reconnects": 0,
         }
+        # hash-plane counters, same key shape (consumers prefix; the
+        # gateway Hasher folds these in as flat stream_* gauges)
+        self._hash_stats = {
+            "stream_batches": 0, "stream_chunks_out": 0,
+            "stream_lanes": 0, "stream_bytes_out": 0, "reconnects": 0,
+            "stream_trees": 0, "single_batches": 0, "single_lanes": 0,
+        }
 
     def _acquire(self) -> tuple[socket.socket, bool]:
         """(connection, was_pooled). Pooled sockets may be stale — the
@@ -1082,13 +1387,32 @@ class DevdClient:
             return lambda: []
         width = max(1, chunk or self.stream_chunk())
         spans = [items[i: i + width] for i in range(0, len(items), width)]
+        header = {
+            "op": "verify_stream",
+            "chunks": len(spans),
+            "total": sum(len(s) for s in spans),
+        }
+        return self._stream_resolver(
+            spans, header, _pack_chunk, self._stream_stats,
+            lambda conn, writer, werr: self._collect_stream(
+                conn, writer, werr, len(spans)
+            ),
+        )
 
-        first = self._start_stream(spans, fresh=False)
+    def _stream_resolver(self, spans, header: dict, pack, stats, collect):
+        """Open a chunked stream NOW and return the zero-arg resolver
+        with the shared reconnect-once error triage (verify and hash
+        planes): a DevdError is final; a writer error that is not an
+        OSError is a deterministic client-side marshal failure (a retry
+        would fail identically — surface the real cause); a transport
+        failure on a POOLED connection retries once on a fresh one
+        (daemon restarts must not surface to the caller)."""
+        first = self._start_stream(spans, False, header, pack, stats)
 
-        def resolve() -> list[bool]:
+        def resolve():
             conn, pooled, writer, werr = first
             try:
-                return self._collect_stream(conn, writer, werr, len(spans))
+                return collect(conn, writer, werr)
             except DevdError:
                 self._discard(conn)
                 raise
@@ -1096,41 +1420,40 @@ class DevdClient:
                 self._discard(conn)
                 writer.join(timeout=5.0)
                 if werr and not isinstance(werr[0], OSError):
-                    # deterministic client-side marshal failure (e.g. a
-                    # malformed lane in _pack_chunk): a retry would fail
-                    # identically — surface the real cause immediately
                     raise werr[0] from exc
                 if not (pooled and isinstance(exc, (ConnectionError, EOFError))):
                     raise
                 with self._mtx:
-                    self._stream_stats["reconnects"] += 1
-                conn2, _, writer2, werr2 = self._start_stream(spans, fresh=True)
+                    stats["reconnects"] += 1
+                conn2, _, writer2, werr2 = self._start_stream(
+                    spans, True, header, pack, stats
+                )
                 try:
-                    return self._collect_stream(conn2, writer2, werr2, len(spans))
+                    return collect(conn2, writer2, werr2)
                 except Exception:
                     self._discard(conn2)
                     raise
 
         return resolve
 
-    def _start_stream(self, spans, fresh: bool):
+    def _start_stream(self, spans, fresh: bool, header: dict, pack, stats):
+        """Open one chunked stream (verify or hash plane): send the
+        pickle header, then launch the writer thread that packs and
+        streams chunk frames. `stats` is the client counter dict the
+        writer notes its totals into (shared key shape)."""
         if fresh:
             conn, pooled = self._fresh(), False
         else:
             conn, pooled = self._acquire()
         try:
-            _send_frame(conn, {
-                "op": "verify_stream",
-                "chunks": len(spans),
-                "total": sum(len(s) for s in spans),
-            })
+            _send_frame(conn, header)
         except Exception as exc:
             self._discard(conn)
             if not (pooled and isinstance(exc, (ConnectionError, EOFError))):
                 raise
             with self._mtx:
-                self._stream_stats["reconnects"] += 1
-            return self._start_stream(spans, fresh=True)
+                stats["reconnects"] += 1
+            return self._start_stream(spans, True, header, pack, stats)
         werr: list = []
 
         def write() -> None:
@@ -1140,17 +1463,16 @@ class DevdClient:
             try:
                 sent_chunks = sent_bytes = sent_lanes = 0
                 for span in spans:
-                    payload = _pack_chunk(span)
+                    payload = pack(span)
                     conn.sendall(struct.pack(">I", len(payload)) + payload)
                     sent_chunks += 1
                     sent_bytes += len(payload)
                     sent_lanes += len(span)
                 with self._mtx:
-                    s = self._stream_stats
-                    s["stream_batches"] += 1
-                    s["stream_chunks_out"] += sent_chunks
-                    s["stream_bytes_out"] += sent_bytes
-                    s["stream_lanes"] += sent_lanes
+                    stats["stream_batches"] += 1
+                    stats["stream_chunks_out"] += sent_chunks
+                    stats["stream_bytes_out"] += sent_bytes
+                    stats["stream_lanes"] += sent_lanes
             except Exception as exc:  # noqa: BLE001 — surfaced by resolver
                 werr.append(exc)
                 # fail FAST on both sides: without this the daemon would
@@ -1207,6 +1529,111 @@ class DevdClient:
             raise DevdError(f"stream writer failed: {werr[0]}")
         self._release(conn)
         return out
+
+    # -- streamed hash transport --------------------------------------------
+
+    def hash_batch(self, items, mode: str = "part", tree: bool = False):
+        """Single-shot daemon hashing: one pickle frame each way. Digest
+        list; with tree=True, (digests, postorder internal nodes)."""
+        rep = self.request({
+            "op": "hash", "mode": mode,
+            "items": [bytes(b) for b in items], "tree": bool(tree),
+        })
+        if not rep.get("ok"):
+            raise DevdError(rep.get("error", "hash failed"))
+        with self._mtx:
+            self._hash_stats["single_batches"] += 1
+            self._hash_stats["single_lanes"] += len(rep["digests"])
+        if tree:
+            return rep["digests"], rep.get("nodes", [])
+        return rep["digests"]
+
+    def hash_stream(self, items, mode: str = "part", tree: bool = False,
+                    chunk: int | None = None):
+        """Streamed hash_batch: same digests, pipelined transport."""
+        return self.hash_stream_async(items, mode=mode, tree=tree,
+                                      chunk=chunk)()
+
+    def hash_stream_async(self, items, mode: str = "part",
+                          tree: bool = False, chunk: int | None = None):
+        """Submit leaf payloads as chunked hash frames on one connection;
+        the returned resolver collects per-chunk digest frames in order
+        (plus the tree frame when tree=True → (digests, internal_nodes)).
+        Reconnect-once semantics match verify_stream_async: a failed
+        attempt on a pooled connection retries on a fresh one."""
+        items = [bytes(b) for b in items]
+        if not items:
+            return (lambda: ([], [])) if tree else (lambda: [])
+        width = max(1, chunk or self.stream_chunk())
+        spans = [items[i: i + width] for i in range(0, len(items), width)]
+        header = {
+            "op": "hash_stream",
+            "chunks": len(spans),
+            "total": len(items),
+            "mode": mode,
+            "tree": bool(tree),
+        }
+        return self._stream_resolver(
+            spans, header, _pack_hash_chunk, self._hash_stats,
+            lambda conn, writer, werr: self._collect_hash_stream(
+                conn, writer, werr, len(spans), tree
+            ),
+        )
+
+    def _collect_hash_stream(self, conn, writer, werr, n_chunks: int,
+                             want_tree: bool):
+        digests: list[bytes] = []
+        for want in range(n_chunks):
+            payload = _recv_raw_frame(conn)
+            status, idx = struct.unpack_from("<BI", payload, 0)
+            if status == STREAM_ERR:
+                writer.join(timeout=5.0)
+                raise DevdError(
+                    f"hash stream chunk {idx}: "
+                    f"{payload[5:].decode(errors='replace')}"
+                )
+            if status != STREAM_OK:
+                if status == 0x80:  # pickle frame: pre-r7 daemon answered
+                    # the header with {"ok": False, "error": "unknown op"}
+                    raise DevdError("daemon too old for hash_stream")
+                raise DevdError(
+                    f"bad hash result frame (status {status}, chunk {want})"
+                )
+            if idx != want:
+                raise DevdError(
+                    f"hash stream desync: got chunk {idx}, want {want}"
+                )
+            (n,) = struct.unpack_from("<I", payload, 5)
+            if len(payload) != 9 + 20 * n:
+                raise DevdError(f"digest frame size mismatch for chunk {idx}")
+            digests.extend(
+                payload[9 + 20 * i: 29 + 20 * i] for i in range(n)
+            )
+        nodes: list[bytes] | None = None
+        if want_tree:
+            payload = _recv_raw_frame(conn)
+            status, cnt = struct.unpack_from("<BI", payload, 0)
+            if status == STREAM_ERR:
+                writer.join(timeout=5.0)
+                raise DevdError(
+                    f"hash stream tree: {payload[5:].decode(errors='replace')}"
+                )
+            if status != STREAM_TREE or len(payload) != 5 + 20 * cnt:
+                raise DevdError(f"bad tree frame (status {status})")
+            nodes = [payload[5 + 20 * i: 25 + 20 * i] for i in range(cnt)]
+            with self._mtx:
+                self._hash_stats["stream_trees"] += 1
+        writer.join(timeout=5.0)
+        if werr:
+            raise DevdError(f"hash stream writer failed: {werr[0]}")
+        self._release(conn)
+        return (digests, nodes) if want_tree else digests
+
+    def hash_stream_stats(self) -> dict:
+        """Client-side hash-transport counters (ops/gateway.Hasher folds
+        these in as flat stream_* gauges for the metrics RPC)."""
+        with self._mtx:
+            return dict(self._hash_stats)
 
     def stream_stats(self) -> dict:
         """Client-side streamed-transport counters (Verifier.stats()

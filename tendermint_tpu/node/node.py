@@ -167,6 +167,7 @@ class Node(BaseService):
             batch_verifier=self.verifier.commit_batch_verifier(),
             async_batch_verifier=self.verifier.verify_batch_async,
             part_hasher=self.hasher.part_leaf_hashes,
+            part_tree_hasher=self.hasher.part_set_tree,
         )
 
         # -- p2p switch (node.go:231-245) ---------------------------------

@@ -100,3 +100,96 @@ def stream_stats() -> dict:
     """Client-side streamed-transport counters; Verifier.stats() exposes
     them so the serving path is observable from the node process too."""
     return _get_client().stream_stats()
+
+
+# -- hash plane ---------------------------------------------------------------
+#
+# Same transport policy as verify, plus a BYTES floor: part-set batches
+# are few-but-fat (16 x 64 KB for a 1 MB block — far under the 256-lane
+# stream min that fits signature lanes), and it is exactly those megabyte
+# frames whose marshal the stream exists to overlap with device hashing.
+
+_HASH_STREAM_MIN_BYTES = 1 << 18  # 256 KB
+
+# the hash plane's OWN version-skew latch: a round-6 daemon serves
+# verify_stream fine while rejecting hash_stream — latching the shared
+# verify flag would silently reintroduce the serving-path gap PR 1 closed
+_hash_stream_ok = True
+
+
+def _hash_stream_min_bytes() -> int:
+    try:
+        return int(os.environ.get(
+            "TENDERMINT_DEVD_HASH_STREAM_MIN_BYTES",
+            str(_HASH_STREAM_MIN_BYTES),
+        ))
+    except ValueError:
+        return _HASH_STREAM_MIN_BYTES
+
+
+def _use_hash_stream(n: int, total_bytes: int) -> bool:
+    return _hash_stream_ok and (
+        n >= _stream_min() or total_bytes >= _hash_stream_min_bytes()
+    )
+
+
+def _latch_hash_single_shot() -> None:
+    global _hash_stream_ok
+    _hash_stream_ok = False
+
+
+def _hash_chunk(mode: str) -> int | None:
+    """Stream chunk width in ITEMS: TENDERMINT_DEVD_HASH_CHUNK pins it;
+    otherwise part mode frames narrow (parts are 64 KB each — 8 parts =
+    a 512 KB frame, enough to overlap decode with device compute without
+    starving the pipeline), leaf mode rides the daemon-advertised verify
+    width (tx leaves are sig-lane sized)."""
+    try:
+        env = int(os.environ.get("TENDERMINT_DEVD_HASH_CHUNK", "0") or 0)
+    except ValueError:
+        env = 0
+    if env > 0:
+        return env
+    return 8 if mode == "part" else None
+
+
+def hash_batch(items, mode: str = "part") -> list[bytes]:
+    """Batched daemon-side hashing (gateway.Hasher's devd route):
+    streamed chunk frames when the batch is wide or fat enough, the
+    single-shot pickle op otherwise. Digests byte-identical to
+    crypto.hashing.ripemd160 / merkle.simple.leaf_hash."""
+    items = [bytes(b) for b in items]
+    c = _get_client()
+    if _use_hash_stream(len(items), sum(len(b) for b in items)):
+        try:
+            return c.hash_stream(items, mode=mode, chunk=_hash_chunk(mode))
+        except devd.DevdError as exc:
+            if "too old" not in str(exc):
+                raise
+            _latch_hash_single_shot()
+    return c.hash_batch(items, mode=mode)
+
+
+def hash_tree(items, mode: str = "part") -> tuple[list, list]:
+    """(leaf digests, postorder internal tree nodes) — the proof-free
+    part-set path: one streamed pass hashes every leaf AND the whole
+    Merkle tree daemon-side (merkle.simple.FlatTree.from_nodes
+    rehydrates host proofs with zero host hashing)."""
+    items = [bytes(b) for b in items]
+    c = _get_client()
+    if _use_hash_stream(len(items), sum(len(b) for b in items)):
+        try:
+            return c.hash_stream(
+                items, mode=mode, tree=True, chunk=_hash_chunk(mode)
+            )
+        except devd.DevdError as exc:
+            if "too old" not in str(exc):
+                raise
+            _latch_hash_single_shot()
+    return c.hash_batch(items, mode=mode, tree=True)
+
+
+def hash_stream_stats() -> dict:
+    """Client-side hash-transport counters; gateway.Hasher.stats() folds
+    them in as flat stream_* gauges for the metrics RPC."""
+    return _get_client().hash_stream_stats()

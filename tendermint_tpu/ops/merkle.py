@@ -4,13 +4,16 @@ Replaces the reference's sequential tree loops (types/part_set.go:95-122
 NewPartSetFromData, types/tx.go:33-46 Txs.Hash) with level-parallel batched
 RIPEMD-160:
 
-1. Host computes the tree SHAPE only — the recursive (n+1)//2 split of
-   merkle/simple.py — as a dense schedule of (left, right, out) node-slot
-   triples grouped into dependency rounds (depth levels). The schedule
-   depends only on n and is lru-cached per exact leaf count (leaves cannot
-   be padded: the tree over the first n leaves of a padded set is a
-   different tree). Part-set sizes repeat heavily so the cache hits;
-   _run_tree jit-specializes on (slots, n_rounds) which collide often.
+1. Host computes the tree SHAPE only — the left-heavy (n+1)//2 split,
+   taken from THE shape oracle merkle.simple._flat_shape (one
+   implementation serves this kernel and the host FlatTree builder, so
+   their postorder slot contract cannot drift) — as a dense schedule of
+   (left, right, out) node-slot triples grouped into dependency rounds
+   (height levels). The schedule depends only on n and is lru-cached per
+   exact leaf count (leaves cannot be padded: the tree over the first n
+   leaves of a padded set is a different tree). Part-set sizes repeat
+   heavily so the cache hits; _run_tree jit-specializes on
+   (slots, n_rounds) which collide often.
 2. TPU holds a node-slot buffer of 20-byte digests as uint32[slots, 5] and,
    per round, gathers children, assembles the 44-byte inner-node preimage
    (length-prefixed left || length-prefixed right — matching
@@ -19,6 +22,11 @@ RIPEMD-160:
 
 The returned node buffer also yields every internal node, so SimpleProof
 aunts come for free without extra hashing (used by PartSet.from_data).
+Slot order (leaves 0..n-1, then internal nodes in postorder, root last)
+matches merkle.simple.FlatTree exactly — tree_nodes_from_leaf_digests
+feeds FlatTree.from_nodes, which is how the devd hash_stream tree frame
+turns one device pass into host root + every proof with zero host
+hashing.
 """
 
 from __future__ import annotations
@@ -42,75 +50,34 @@ from tendermint_tpu.ops.hashing import (
 # ---------------------------------------------------------------------------
 
 
-class _TreeSchedule:
-    __slots__ = ("n", "slots", "rounds", "root_slot", "combines")
-
-    def __init__(self, n: int):
-        """Build the combine schedule for n leaves (slots 0..n-1 = leaves).
-        combines: list of (left, right, out); rounds: list of index ranges
-        into combines, grouped by dependency depth."""
-        self.n = n
-        next_slot = n
-        combines: list[tuple[int, int, int]] = []
-        depths: list[int] = []
-
-        def build(lo: int, hi: int) -> tuple[int, int]:
-            """Return (slot, depth) of subtree over leaves [lo, hi)."""
-            nonlocal next_slot
-            count = hi - lo
-            if count == 1:
-                return lo, 0
-            mid = lo + (count + 1) // 2
-            ls, ld = build(lo, mid)
-            rs, rd = build(mid, hi)
-            out = next_slot
-            next_slot += 1
-            combines.append((ls, rs, out))
-            depths.append(max(ld, rd) + 1)
-            return out, max(ld, rd) + 1
-
-        if n == 0:
-            self.slots = 0
-            self.rounds = []
-            self.root_slot = -1
-            self.combines = []
-            return
-        root, _ = build(0, n)
-        self.slots = next_slot
-        self.root_slot = root
-        # group by depth
-        order = sorted(range(len(combines)), key=lambda i: depths[i])
-        self.combines = [combines[i] for i in order]
-        self.rounds = []
-        i = 0
-        while i < len(order):
-            d = depths[order[i]]
-            j = i
-            while j < len(order) and depths[order[j]] == d:
-                j += 1
-            self.rounds.append((i, j))
-            i = j
-
-
 @lru_cache(maxsize=64)
 def _dense_schedule(n_bucket: int):
-    """Dense schedule arrays for one exact leaf count:
-    left/right/out: int32[max_rounds, max_width]; counts: int32[max_rounds].
-    Entries beyond a round's count are no-ops (combine slot 0,0 -> scratch).
-    Returns (left, right, out, scratch_slot, total_slots, py_schedule)."""
-    sched = _TreeSchedule(n_bucket)
-    max_width = max((j - i for i, j in sched.rounds), default=0)
-    n_rounds = len(sched.rounds)
-    scratch = sched.slots  # one extra slot absorbs no-op writes
+    """Dense schedule arrays for one exact leaf count (n_bucket >= 2),
+    derived from THE shape oracle (merkle.simple._flat_shape) — one
+    implementation of the postorder slot order + by-height level
+    grouping serves both the host FlatTree builder and this kernel, so
+    the byte-parity contract between them cannot drift.
+    left/right/out: int32[n_rounds, max_width]; entries beyond a round's
+    width are no-ops (combine slot 0,0 -> scratch).
+    Returns (left, right, out, scratch_slot, buffer_rows, real_slots,
+    n_rounds); real_slots = 2n-1 (root last), buffer_rows adds the
+    scratch sink row."""
+    from tendermint_tpu.merkle.simple import _flat_shape
+
+    _, _, levels = _flat_shape(n_bucket)
+    n_rounds = len(levels)
+    max_width = max(len(level) for level in levels)
+    real_slots = 2 * n_bucket - 1
+    scratch = real_slots  # one extra slot absorbs no-op writes
     left = np.zeros((n_rounds, max_width), dtype=np.int32)
     right = np.zeros((n_rounds, max_width), dtype=np.int32)
     out = np.full((n_rounds, max_width), scratch, dtype=np.int32)
-    for r, (i, j) in enumerate(sched.rounds):
-        for k, (ls, rs, os_) in enumerate(sched.combines[i:j]):
+    for r, level in enumerate(levels):
+        for k, (o, ls, rs) in enumerate(level):
             left[r, k] = ls
             right[r, k] = rs
-            out[r, k] = os_
-    return left, right, out, scratch, sched.slots + 1, sched
+            out[r, k] = o
+    return left, right, out, scratch, real_slots + 1, real_slots, n_rounds
 
 
 # ---------------------------------------------------------------------------
@@ -174,51 +141,47 @@ def _run_tree(nodes: jax.Array, left: jax.Array, right: jax.Array, out: jax.Arra
 # ---------------------------------------------------------------------------
 
 
+def tree_nodes_from_leaf_digests(digests: list[bytes]) -> list[bytes]:
+    """All 2n-1 tree node hashes from 20-byte leaf digests — leaves
+    0..n-1, internal nodes in postorder, root last (the FlatTree slot
+    order). TPU does every compression; the host only reshapes the node
+    buffer. This is the payload of the devd hash_stream tree frame."""
+    n = len(digests)
+    if n <= 1:
+        return list(digests)
+    left, right, out, scratch, rows, real_slots, n_rounds = _dense_schedule(n)
+    nodes_np = np.zeros((rows, 5), dtype=np.uint32)
+    for i, d in enumerate(digests):
+        nodes_np[i] = np.frombuffer(d, dtype="<u4")
+    nodes = _run_tree(
+        jnp.asarray(nodes_np), jnp.asarray(left), jnp.asarray(right),
+        jnp.asarray(out), n_rounds,
+    )
+    # drop the scratch row: 2n-1 real nodes + 1 no-op sink
+    return digests_to_bytes_le(np.asarray(nodes))[:real_slots]
+
+
 def tree_hash_from_leaf_digests(digests: list[bytes]) -> tuple[bytes, list[list[bytes]]]:
     """Root + per-leaf aunt lists (bottom-up order) from 20-byte leaf
-    digests. TPU does all hashing; host assembles proofs from the node
-    buffer. Mirrors merkle.simple.simple_proofs_from_hashes output."""
+    digests. TPU does all hashing; host assembles proofs as FlatTree
+    views over the node buffer. Mirrors
+    merkle.simple.simple_proofs_from_hashes output."""
+    from tendermint_tpu.merkle.simple import FlatTree
+
     n = len(digests)
     if n == 0:
         return b"", []
     if n == 1:
         return digests[0], [[]]
-    left, right, out, scratch, slots, sched = _dense_schedule(n)
-    nodes_np = np.zeros((slots, 5), dtype=np.uint32)
-    for i, d in enumerate(digests):
-        nodes_np[i] = np.frombuffer(d, dtype="<u4")
-    nodes = _run_tree(
-        jnp.asarray(nodes_np), jnp.asarray(left), jnp.asarray(right),
-        jnp.asarray(out), len(sched.rounds),
-    )
-    nodes_host = np.asarray(nodes)
-    all_hashes = digests_to_bytes_le(nodes_host)
-    root = all_hashes[sched.root_slot]
-
-    # host-side proof assembly: walk the recursion again (shape-only)
-    aunts: list[list[bytes]] = [[] for _ in range(n)]
-    combine_map = {(ls, rs): o for ls, rs, o in sched.combines}
-
-    def walk(lo: int, hi: int) -> int:
-        count = hi - lo
-        if count == 1:
-            return lo
-        mid = lo + (count + 1) // 2
-        ls = walk(lo, mid)
-        rs = walk(mid, hi)
-        for i in range(lo, mid):
-            aunts[i].append(all_hashes[rs])
-        for i in range(mid, hi):
-            aunts[i].append(all_hashes[ls])
-        return combine_map[(ls, rs)]
-
-    walk(0, n)
-    return root, aunts
+    tree = FlatTree.from_nodes(n, tree_nodes_from_leaf_digests(digests))
+    return tree.root(), [tree.aunts_for(i) for i in range(n)]
 
 
 def merkle_root_from_leaf_digests(digests: list[bytes]) -> bytes:
-    root, _ = tree_hash_from_leaf_digests(digests)
-    return root
+    if not digests:
+        return b""
+    # root = last node in the buffer; skips materializing any aunts
+    return tree_nodes_from_leaf_digests(digests)[-1]
 
 
 def part_leaf_hashes(chunks: list[bytes]) -> list[bytes]:

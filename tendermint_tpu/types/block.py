@@ -296,6 +296,7 @@ class Block:
         part_size: int,
         time_ns: int | None = None,
         part_hasher=None,
+        part_tree_hasher=None,
     ) -> tuple["Block", PartSet]:
         """MakeBlock equivalent (types/block.go:26-44): block + its part set."""
         header = Header(
@@ -309,7 +310,9 @@ class Block:
         )
         block = cls(header, Data(txs=list(txs)), commit)
         block.fill_header()
-        return block, block.make_part_set(part_size, hasher=part_hasher)
+        return block, block.make_part_set(
+            part_size, hasher=part_hasher, tree_hasher=part_tree_hasher
+        )
 
     def fill_header(self) -> None:
         if not self.header.last_commit_hash:
@@ -326,8 +329,11 @@ class Block:
     def hashes_to(self, h: bytes) -> bool:
         return len(h) > 0 and self.hash() == h
 
-    def make_part_set(self, part_size: int, hasher=None) -> PartSet:
-        return PartSet.from_data(self.to_bytes(), part_size, hasher=hasher)
+    def make_part_set(self, part_size: int, hasher=None,
+                      tree_hasher=None) -> PartSet:
+        return PartSet.from_data(
+            self.to_bytes(), part_size, hasher=hasher, tree_hasher=tree_hasher
+        )
 
     def validate_basic(
         self,

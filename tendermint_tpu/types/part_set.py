@@ -81,18 +81,34 @@ class PartSet:
     # -- constructors ------------------------------------------------------
 
     @classmethod
-    def from_data(cls, data: bytes, part_size: int, hasher=None) -> "PartSet":
+    def from_data(
+        cls, data: bytes, part_size: int, hasher=None, tree_hasher=None
+    ) -> "PartSet":
         """Split + build Merkle proofs (NewPartSetFromData,
         types/part_set.go:95-122). `hasher` optionally supplies batched leaf
         hashes (the TPU path); it must equal [ripemd160(p) for p in chunks].
-        """
+        `tree_hasher` (ops/gateway.Hasher.part_set_tree) optionally
+        supplies (leaf hashes, merkle.simple.FlatTree) in one offload
+        pass — the devd hash_stream tree frame — making the proofs free
+        here; returning None falls through to the host path. Either way
+        proofs are shared-aunt views over one flat node buffer,
+        byte-identical to the recursive reference."""
         total = max((len(data) + part_size - 1) // part_size, 1)
         chunks = [data[i * part_size : (i + 1) * part_size] for i in range(total)]
-        if hasher is not None:
-            leaf_hashes = hasher(chunks)
+        leaf_hashes = tree = None
+        if tree_hasher is not None:
+            built = tree_hasher(chunks)
+            if built is not None:
+                leaf_hashes, tree = built
+        if leaf_hashes is None:
+            if hasher is not None:
+                leaf_hashes = hasher(chunks)
+            else:
+                leaf_hashes = [ripemd160(c) for c in chunks]
+        if tree is not None:
+            root, proofs = tree.root(), tree.proofs()
         else:
-            leaf_hashes = [ripemd160(c) for c in chunks]
-        root, proofs = simple_proofs_from_hashes(list(leaf_hashes))
+            root, proofs = simple_proofs_from_hashes(list(leaf_hashes))
         ps = cls(total, root)
         for i, chunk in enumerate(chunks):
             part = Part(index=i, bytes_=chunk, proof=proofs[i], _hash=leaf_hashes[i])

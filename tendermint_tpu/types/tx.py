@@ -1,6 +1,10 @@
 """Transactions: raw bytes with Merkle hashing and inclusion proofs
 (reference: types/tx.go). Tx is a plain `bytes` alias; helpers operate on
-lists of them. The recursive (n+1)//2 split matches types/tx.go:33-46."""
+lists of them. The left-heavy (n+1)//2 split matches types/tx.go:33-46;
+since round 7 the tree builds flat (merkle.simple.FlatTree — same shape,
+same bytes, no recursion), and the injected batch hook
+(ops/gateway.Hasher.tx_merkle_root) memoizes roots per tx set so
+reproposals and gossip re-validation of an unchanged set never rehash."""
 
 from __future__ import annotations
 

@@ -1,0 +1,324 @@
+"""Streamed devd hash-plane tests (tendermint_tpu/devd.py hash_stream):
+digest parity against the single-shot op and the CPU reference (both
+modes, chunk-width remainders), tree-frame proofs byte-identical to the
+host builders, pipelining (in-flight high-water), malformed-frame error
+path, client reconnect across a daemon restart, and the gateway Hasher's
+streamed routing + gauges — mirroring tests/test_devd_stream.py.
+
+Parity runs against a real CPU-kernel daemon subprocess (the jax
+RIPEMD-160 kernel serving the same IPC bytes a TPU daemon would);
+behavioral tests ride the sim-device daemon (TENDERMINT_DEVD_SIM_RATE —
+whose _SimHasher computes REAL digests through a rate-limited FIFO, so
+parity holds there too with device time deterministic)."""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tendermint_tpu import devd
+from tendermint_tpu.crypto.hashing import ripemd160
+from tendermint_tpu.merkle.simple import (
+    FlatTree,
+    leaf_hash,
+    recursive_proofs_from_hashes,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn(sock: str, extra_env: dict) -> subprocess.Popen:
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "TENDERMINT_DEVD_SOCK": sock,
+        "TENDERMINT_DEVD_ACCEPT_CPU": "1",
+        "TENDERMINT_DEVD_EXIT_ON_TERM": "1",
+        **extra_env,
+    }
+    return subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.devd"],
+        env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+    )
+
+
+def _wait_held(client, proc, deadline_s: float) -> None:
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            err = proc.stderr.read() if proc.stderr else b""
+            pytest.fail(f"daemon died: {err[-2000:]!r}")
+        try:
+            if client.ping(timeout=2.0).get("held"):
+                return
+        except Exception:
+            pass
+        time.sleep(0.3)
+    proc.kill()
+    pytest.fail("daemon never reached serving state")
+
+
+@pytest.fixture(scope="module")
+def daemon(tmp_path_factory):
+    """Real jax-kernel daemon, verify warm DISABLED (the hash plane
+    compiles lazily on first use; no f32 verify compile needed here)."""
+    sock = str(tmp_path_factory.mktemp("devd-hash") / "devd.sock")
+    proc = _spawn(sock, {"TENDERMINT_DEVD_WARM": ""})
+    client = devd.DevdClient(sock)
+    _wait_held(client, proc, 60.0)
+    yield sock, client
+    try:
+        client.shutdown()
+    except Exception:
+        pass
+    client.close()
+    try:
+        proc.wait(timeout=15)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+@pytest.fixture()
+def sim_daemon(tmp_path):
+    sock = str(tmp_path / "sim.sock")
+    proc = _spawn(sock, {"TENDERMINT_DEVD_SIM_RATE": "100000"})
+    client = devd.DevdClient(sock)
+    _wait_held(client, proc, 30.0)
+    yield sock, client, proc
+    try:
+        client.shutdown()
+    except Exception:
+        pass
+    client.close()
+    try:
+        proc.wait(timeout=15)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def _parts(n: int, tag: bytes = b"part") -> list[bytes]:
+    # ragged sizes incl. empty and multi-block payloads
+    return [tag + b"-%03d" % i + b"\xab" * ((i * 37) % 300) for i in range(n)]
+
+
+def test_hash_stream_parity_with_single_shot_and_cpu(daemon):
+    """Digest-for-digest: streamed == single-shot == crypto.hashing, for
+    both modes, with chunk widths hitting remainder/divisor/exact/
+    oversize — served by the real jax RIPEMD-160 kernel."""
+    _, client = daemon
+    items = _parts(23)
+    items[5] = b""  # empty payload lane
+    want_part = [ripemd160(it) for it in items]
+    want_leaf = [leaf_hash(it) for it in items]
+    assert client.hash_batch(items, mode="part") == want_part
+    assert client.hash_batch(items, mode="leaf") == want_leaf
+    for width in (5, 8, 23, 64):
+        assert client.hash_stream(items, mode="part", chunk=width) == want_part
+        assert client.hash_stream(items, mode="leaf", chunk=width) == want_leaf
+
+
+def test_hash_stream_tree_frame_proofs_free(daemon):
+    """tree=True: the daemon's tree kernel ships every internal node;
+    FlatTree.from_nodes must reproduce the host root AND every proof
+    byte-for-byte — zero host hashing."""
+    _, client = daemon
+    items = _parts(17, tag=b"tree")
+    digests, nodes = client.hash_stream(items, mode="part", tree=True, chunk=4)
+    want = [ripemd160(it) for it in items]
+    assert digests == want
+    root_ref, proofs_ref = recursive_proofs_from_hashes(want)
+    tree = FlatTree.from_nodes(17, list(digests) + list(nodes))
+    assert tree.root() == root_ref
+    for i in range(17):
+        assert tree.aunts_for(i) == proofs_ref[i].aunts
+    # single-shot tree agrees
+    d2, n2 = client.hash_batch(items, mode="part", tree=True)
+    assert d2 == digests and n2 == nodes
+
+
+def test_hash_stream_empty_and_single_item(sim_daemon):
+    _, client, _ = sim_daemon
+    assert client.hash_stream([]) == []
+    assert client.hash_stream([], tree=True) == ([], [])
+    one = [b"only-part"]
+    assert client.hash_stream(one, chunk=16) == [ripemd160(one[0])]
+    d, nodes = client.hash_stream(one, tree=True, chunk=16)
+    assert d == [ripemd160(one[0])] and nodes == []
+    assert FlatTree.from_nodes(1, d).root() == d[0]
+
+
+def test_bad_hash_mode_rejected(sim_daemon):
+    _, client, _ = sim_daemon
+    with pytest.raises(devd.DevdError, match="bad hash mode"):
+        client.hash_batch([b"x"], mode="nonsense")
+    with pytest.raises(devd.DevdError, match="bad hash mode"):
+        client.hash_stream([b"x"], mode="nonsense", chunk=1)
+
+
+def test_daemon_overlaps_hash_chunks_in_flight(sim_daemon):
+    """The pipelining claim: with sim device time 10 ms/chunk the daemon
+    holds multiple dispatched-unresolved hash chunks at once."""
+    _, client, _ = sim_daemon
+    items = [b"lap-%05d" % i * 4 for i in range(8000)]
+    assert client.hash_stream(items, chunk=1000) == [ripemd160(b) for b in items]
+    hs = client.status()["hash_stream"]
+    assert hs["inflight_max"] >= 2, hs
+    assert hs["inflight"] == 0, hs
+    assert hs["chunks"] == 8 and hs["lanes"] == 8000
+    assert hs["chunk_device_ms_last"] > 0 and hs["chunk_device_ms_avg"] > 0
+    # the verify-plane gauges did not move
+    assert client.status()["stream"]["chunks"] == 0
+
+
+def test_malformed_mid_stream_frame_gets_error_frame(sim_daemon):
+    """Raw protocol: one good hash chunk, then garbage. The daemon must
+    answer the good chunk, send an ERROR frame, and close the stream."""
+    sock, _, _ = sim_daemon
+    conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    conn.settimeout(10.0)
+    conn.connect(sock)
+    try:
+        devd._send_frame(conn, {
+            "op": "hash_stream", "chunks": 3, "total": 8, "mode": "part",
+        })
+        good = devd._pack_hash_chunk([b"mal-%d" % i for i in range(4)])
+        conn.sendall(struct.pack(">I", len(good)) + good)
+        garbage = b"\xde\xad\xbe\xef" * 5  # claims 0xefbeadde items
+        conn.sendall(struct.pack(">I", len(garbage)) + garbage)
+
+        first = devd._recv_raw_frame(conn)
+        status, idx = struct.unpack_from("<BI", first, 0)
+        assert (status, idx) == (devd.STREAM_OK, 0)
+        (n,) = struct.unpack_from("<I", first, 5)
+        assert n == 4 and len(first) == 9 + 20 * 4
+        second = devd._recv_raw_frame(conn)
+        status, idx = struct.unpack_from("<BI", second, 0)
+        assert status == devd.STREAM_ERR and idx == 1
+        assert b"malformed" in second[5:]
+        conn.settimeout(5.0)
+        assert conn.recv(1) == b""
+    finally:
+        conn.close()
+
+
+def test_malformed_stream_leaves_daemon_serving(sim_daemon):
+    sock, client, _ = sim_daemon
+    bad = devd.DevdClient(sock)
+    with pytest.raises(devd.DevdError, match="malformed|mismatch"):
+        conn, _ = bad._acquire()
+        devd._send_frame(conn, {
+            "op": "hash_stream", "chunks": 1, "total": 4, "mode": "part",
+        })
+        conn.sendall(struct.pack(">I", 2) + b"\x01\x02")
+        bad._collect_hash_stream(conn, _NopThread(), [], 1, False)
+    bad.close()
+    rep = client.status()
+    assert rep["hash_stream"]["errors"] >= 1
+    items = [b"after-%d" % i for i in range(6)]
+    assert client.hash_stream(items, chunk=4) == [ripemd160(b) for b in items]
+
+
+class _NopThread:
+    def join(self, timeout=None):
+        pass
+
+
+def test_client_reconnects_after_daemon_restart(tmp_path):
+    """Pooled connections go stale across a daemon restart; the next
+    hash request (single-shot AND streamed) retries on a fresh socket."""
+    sock = str(tmp_path / "restart.sock")
+    proc = _spawn(sock, {"TENDERMINT_DEVD_SIM_RATE": "100000"})
+    client = devd.DevdClient(sock)
+    _wait_held(client, proc, 30.0)
+    items = [b"rc-%d" % i * 10 for i in range(32)]
+    want = [ripemd160(b) for b in items]
+    assert client.hash_stream(items, chunk=8) == want
+    assert client.hash_batch(items) == want
+
+    client.shutdown()
+    proc.wait(timeout=15)
+    proc2 = _spawn(sock, {"TENDERMINT_DEVD_SIM_RATE": "100000"})
+    try:
+        _wait_held(devd.DevdClient(sock), proc2, 30.0)
+        assert client.hash_stream(items, chunk=8) == want
+        assert client.hash_batch(items) == want
+        assert client.hash_stream_stats()["reconnects"] >= 1
+    finally:
+        try:
+            client.shutdown()
+        except Exception:
+            pass
+        client.close()
+        try:
+            proc2.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc2.kill()
+
+
+def test_gateway_hasher_routes_over_stream(sim_daemon, monkeypatch):
+    """A Hasher with offload on next to a serving daemon resolves the
+    devd route: wide part batches stream (daemon hash counters move),
+    stats() carries the flat stream_* gauges, and part_set_tree rides
+    the tree frame — proofs byte-identical to the host path."""
+    sock, client, _ = sim_daemon
+    monkeypatch.setenv("TENDERMINT_DEVD_SOCK", sock)
+    monkeypatch.setenv("TENDERMINT_DEVD_STREAM_MIN", "8")
+    monkeypatch.setenv("TENDERMINT_DEVD_HASH_CHUNK", "16")
+    import tendermint_tpu.ops.devd_backend as backend
+    from tendermint_tpu.ops import gateway
+    from tendermint_tpu.types.part_set import PartSet
+
+    monkeypatch.setattr(backend, "_client", None)
+    monkeypatch.setattr(backend, "_stream_ok", True)
+    monkeypatch.setattr(backend, "_hash_stream_ok", True)
+    devd.bust_avail_cache()
+    h = gateway.Hasher(min_tpu_batch=1, use_tpu=True)
+    assert h._route == "devd"
+
+    before = client.status()["hash_stream"]
+    chunks = [b"c-%02d" % i * 50 for i in range(40)]
+    assert h.part_leaf_hashes(chunks) == [ripemd160(c) for c in chunks]
+    after = client.status()["hash_stream"]
+    assert after["chunks"] - before["chunks"] == 3  # 40 items / width 16
+    assert after["lanes"] - before["lanes"] == 40
+    assert after["bytes_framed"] > before["bytes_framed"]
+    stats = h.stats()
+    assert stats["tpu_leaves"] == 40 and stats["stream_lanes"] >= 40
+    assert all(isinstance(v, (int, float)) for v in stats.values()), stats
+
+    # proof-free part set through the tree frame
+    data = bytes(range(256)) * 512  # 128 KB
+    ps = PartSet.from_data(data, 4096, tree_hasher=h.part_set_tree)
+    ref = PartSet.from_data(data, 4096)
+    assert ps.header() == ref.header()
+    for i in range(ps.total):
+        part, rpart = ps.get_part(i), ref.get_part(i)
+        assert part.proof == rpart.proof
+        assert part.proof.verify(i, ps.total, part.hash(), ps.hash())
+    assert client.status()["hash_stream"]["trees"] >= 1
+    assert h.stats()["stream_trees"] >= 1
+
+    # tx roots over the leaf mode + memoization
+    txs = [b"tx-%04d" % i for i in range(32)]
+    from tendermint_tpu.merkle.simple import simple_hash_from_byteslices
+
+    assert h.tx_merkle_root(txs) == simple_hash_from_byteslices(txs)
+    assert h.tx_merkle_root(list(txs)) == simple_hash_from_byteslices(txs)
+    assert h.stats()["tx_root_cache_hits"] == 1
+
+
+def test_status_and_stats_expose_hash_stream_section(sim_daemon):
+    _, client, _ = sim_daemon
+    rep = client.status()
+    assert {"streams", "chunks", "lanes", "bytes_framed", "inflight",
+            "inflight_max", "errors", "trees", "single_batches",
+            "chunk_device_ms_last"} <= set(rep["hash_stream"])
+    full = client.request({"op": "stats"})
+    assert full["ok"] and "hash_stream" in full

@@ -1,0 +1,224 @@
+"""Flat Merkle builder parity (round 7): the level-order FlatTree +
+shared-aunt SimpleProof views must be byte-identical — roots AND every
+per-leaf proof — to the pre-r7 recursive reference
+(merkle.simple.recursive_proofs_from_hashes), across odd/even/prime leaf
+counts from 1 to ~300. Plus the satellite hardening: SimpleProof.from_json
+rejects aunts that aren't exactly one RIPEMD-160 digest wide, and the
+gateway tx-root cache returns memoized roots without rehashing."""
+
+from __future__ import annotations
+
+import pytest
+
+from tendermint_tpu.merkle.simple import (
+    FlatTree,
+    SharedProof,
+    SimpleProof,
+    flat_tree_from_leaf_digests,
+    leaf_hash,
+    recursive_proofs_from_hashes,
+    simple_hash_from_hashes,
+    simple_proofs_from_hashes,
+)
+
+# every count 1..40 (all small shapes incl. each odd/even boundary), then
+# powers of two, their neighbors, and primes out to ~300
+PARITY_COUNTS = list(range(1, 41)) + [
+    63, 64, 65, 97, 101, 127, 128, 129, 151, 199, 200, 256, 257, 283, 300,
+]
+
+
+def _digests(n: int) -> list[bytes]:
+    return [leaf_hash(b"leaf-%d" % i) for i in range(n)]
+
+
+class TestFlatParity:
+    @pytest.mark.parametrize("n", PARITY_COUNTS)
+    def test_roots_and_proofs_byte_identical(self, n):
+        ds = _digests(n)
+        root_ref, proofs_ref = recursive_proofs_from_hashes(ds)
+        root_flat, proofs_flat = simple_proofs_from_hashes(ds)
+        assert root_flat == root_ref
+        assert root_flat == simple_hash_from_hashes(ds)
+        assert len(proofs_flat) == n
+        for i in range(n):
+            assert proofs_flat[i].aunts == proofs_ref[i].aunts, (n, i)
+            assert proofs_flat[i].verify(i, n, ds[i], root_ref)
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 5, 16, 33, 100])
+    def test_from_nodes_rehydration(self, n):
+        """A FlatTree rebuilt from its own node buffer (what the devd
+        tree frame ships) yields the same root and proofs."""
+        ds = _digests(n)
+        built = flat_tree_from_leaf_digests(ds)
+        tree = FlatTree.from_nodes(n, ds + built.internal_nodes())
+        root_ref, proofs_ref = recursive_proofs_from_hashes(ds)
+        assert tree.root() == root_ref
+        for i in range(n):
+            assert tree.aunts_for(i) == proofs_ref[i].aunts
+
+    def test_from_nodes_validates_count(self):
+        ds = _digests(4)
+        with pytest.raises(ValueError, match="needs 7 nodes"):
+            FlatTree.from_nodes(4, ds)
+
+    def test_empty(self):
+        root, proofs = simple_proofs_from_hashes([])
+        assert root == b"" and proofs == []
+        assert simple_hash_from_hashes([]) == b""
+        assert flat_tree_from_leaf_digests([]).root() == b""
+
+    @pytest.mark.parametrize("n", [2, 3, 7, 16])
+    def test_non_digest_leaf_widths_still_match_recursive(self, n):
+        """simple_hash_from_hashes is a public API: non-20-byte operands
+        must hash with their REAL varint length prefixes (the recursive
+        builder's semantics), not the fast path's fixed 20-byte prefix."""
+        from tendermint_tpu.merkle.simple import inner_hash
+
+        leaves = [b"x" * (8 + i) for i in range(n)]  # 8..8+n-1 bytes
+
+        def recursive(hs):
+            if len(hs) == 1:
+                return hs[0]
+            mid = (len(hs) + 1) // 2
+            return inner_hash(recursive(hs[:mid]), recursive(hs[mid:]))
+
+        assert simple_hash_from_hashes(leaves) == recursive(leaves)
+
+    def test_shared_proof_is_a_simple_proof(self):
+        """SharedProof views serialize, compare, and verify exactly like
+        eager SimpleProofs (wire compatibility)."""
+        ds = _digests(7)
+        root, proofs = simple_proofs_from_hashes(ds)
+        _, proofs_ref = recursive_proofs_from_hashes(ds)
+        p = proofs[3]
+        assert isinstance(p, SharedProof) and isinstance(p, SimpleProof)
+        # eq across representations, both directions
+        assert p == proofs_ref[3] and proofs_ref[3] == p
+        assert p != proofs_ref[2]
+        rt = SimpleProof.from_json(p.to_json())
+        assert rt == p
+        assert rt.verify(3, 7, ds[3], root)
+
+    def test_aunts_materialize_lazily_and_once(self):
+        ds = _digests(9)
+        tree = flat_tree_from_leaf_digests(ds)
+        p = tree.proofs()[4]
+        assert p._aunts is None  # view only until first access
+        first = p.aunts
+        assert p.aunts is first  # memoized
+
+
+class TestProofJsonValidation:
+    def test_roundtrip_ok(self):
+        _, proofs = simple_proofs_from_hashes(_digests(5))
+        for i, p in enumerate(proofs):
+            assert SimpleProof.from_json(p.to_json()).aunts == p.aunts
+
+    @pytest.mark.parametrize("width", [0, 2, 38, 42, 64, 128])
+    def test_wrong_width_aunt_rejected(self, width):
+        """Satellite: every decoded aunt must be exactly 20 bytes (40 hex
+        chars) — the pre-r7 decoder accepted anything up to 64 bytes and
+        only failed later at compare time."""
+        with pytest.raises(ValueError, match="bad merkle proof aunts"):
+            SimpleProof.from_json({"aunts": ["ab" * 20, "c" * width]})
+
+    def test_exact_width_accepted(self):
+        p = SimpleProof.from_json({"aunts": ["AB" * 20, "cd" * 20]})
+        assert [len(a) for a in p.aunts] == [20, 20]
+
+    def test_depth_and_type_still_rejected(self):
+        with pytest.raises(ValueError):
+            SimpleProof.from_json({"aunts": ["ab" * 20] * 65})
+        with pytest.raises(ValueError):
+            SimpleProof.from_json({"aunts": [42]})
+        with pytest.raises(ValueError):
+            SimpleProof.from_json({"aunts": "ab" * 20})
+
+
+class TestTxRootCache:
+    def test_cache_hits_skip_rehash(self, monkeypatch):
+        from tendermint_tpu.ops.gateway import Hasher
+
+        h = Hasher(use_tpu=False)
+        txs = [b"tx-%d" % i for i in range(20)]
+        root = h.tx_merkle_root(txs)
+        assert h.stats()["tx_root_cache_hits"] == 0
+        # unchanged set: memoized root, no second hash pass
+        calls = []
+        monkeypatch.setattr(
+            h, "_tx_merkle_root_uncached",
+            lambda t: calls.append(1) or b"\x00" * 20,
+        )
+        assert h.tx_merkle_root(list(txs)) == root
+        assert calls == [] and h.stats()["tx_root_cache_hits"] == 1
+
+    def test_distinct_sets_distinct_roots(self):
+        from tendermint_tpu.merkle.simple import simple_hash_from_byteslices
+        from tendermint_tpu.ops.gateway import Hasher
+
+        h = Hasher(use_tpu=False)
+        a = [b"a-%d" % i for i in range(17)]
+        b = [b"b-%d" % i for i in range(17)]
+        assert h.tx_merkle_root(a) == simple_hash_from_byteslices(a)
+        assert h.tx_merkle_root(b) == simple_hash_from_byteslices(b)
+        assert h.tx_merkle_root(a) != h.tx_merkle_root(b)
+
+    def test_cache_evicts_fifo(self):
+        from tendermint_tpu.ops.gateway import Hasher
+
+        h = Hasher(use_tpu=False)
+        h._tx_roots_cap = 4
+        for i in range(6):
+            h.tx_merkle_root([b"set-%d" % i])
+        assert len(h._tx_roots) == 4
+
+
+class TestPartSetTreePath:
+    def test_from_data_tree_hasher_used(self):
+        """A tree_hasher that returns (digests, FlatTree) short-circuits
+        host proof building; headers and proofs stay byte-identical."""
+        from tendermint_tpu.crypto.hashing import ripemd160
+        from tendermint_tpu.types.part_set import PartSet
+
+        data = bytes(range(256)) * 160  # 40 KB -> 10 parts of 4 KB
+        calls = []
+
+        def tree_hasher(chunks):
+            calls.append(len(chunks))
+            digests = [ripemd160(c) for c in chunks]
+            return digests, flat_tree_from_leaf_digests(digests)
+
+        ps = PartSet.from_data(data, 4096, tree_hasher=tree_hasher)
+        ref = PartSet.from_data(data, 4096)
+        assert calls == [10]
+        assert ps.header() == ref.header()
+        for i in range(ps.total):
+            part, rpart = ps.get_part(i), ref.get_part(i)
+            assert part.proof == rpart.proof
+            assert part.proof.verify(i, ps.total, part.hash(), ps.hash())
+
+    def test_from_data_tree_hasher_none_falls_back(self):
+        from tendermint_tpu.types.part_set import PartSet
+
+        data = b"z" * 30000
+        ps = PartSet.from_data(data, 4096, tree_hasher=lambda chunks: None)
+        assert ps.header() == PartSet.from_data(data, 4096).header()
+
+    def test_gateway_part_set_tree_local_route(self):
+        """Hasher.part_set_tree on the in-process route returns the
+        kernel node buffer; parity against the host reference."""
+        from tendermint_tpu.crypto.hashing import ripemd160
+        from tendermint_tpu.ops.gateway import Hasher
+
+        h = Hasher(min_tpu_batch=1, use_tpu=True)
+        h._route = "local"
+        chunks = [bytes([i]) * (2000 + i) for i in range(11)]
+        built = h.part_set_tree(chunks)
+        assert built is not None
+        digests, tree = built
+        assert digests == [ripemd160(c) for c in chunks]
+        root_ref, proofs_ref = recursive_proofs_from_hashes(digests)
+        assert tree.root() == root_ref
+        for i in range(11):
+            assert tree.aunts_for(i) == proofs_ref[i].aunts

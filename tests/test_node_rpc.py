@@ -223,4 +223,13 @@ def test_metrics_endpoint(node, client):
     assert "gateway_verify_tpu_sigs" in m
     assert m["consensus_peer_msg_drops"] == 0  # healthy node drops nothing
     assert "gateway_hash_cpu_leaves" in m
+    # the Hasher's streamed-transport gauges must surface through the
+    # metrics RPC unconditionally (zeros off the devd route) — the PR-1
+    # Verifier stream gauges only had client-side coverage, which let a
+    # stats()-shape regression hide from the RPC surface
+    for gauge in ("gateway_hash_stream_lanes", "gateway_hash_stream_batches",
+                  "gateway_hash_stream_bytes_out", "gateway_hash_stream_trees",
+                  "gateway_hash_stream_reconnects",
+                  "gateway_hash_tx_root_cache_hits"):
+        assert gauge in m, gauge
     assert all(isinstance(v, (int, float)) for v in m.values()), m
