@@ -24,7 +24,7 @@ test:
 # hold (dots no worse than the seed) — plus the chip-free hash-stream
 # smoke (the two asserted BENCH_r07 rows: streamed hash offload >= 1.3x
 # single-shot on the sim transport, flat host builder >= 1.5x recursive).
-tier1: hash-stream-smoke
+tier1: hash-stream-smoke chaos-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Chip-free bench smoke: every BASELINE config on the pinned CPU backend,
@@ -40,6 +40,14 @@ bench-smoke:
 hash-stream-smoke:
 	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_PARTSET_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_partset.py
 
+# Chaos smoke, chip-free and fast (~30 s): a reduced FaultPlan pass of
+# bench_chaos.py — breaker-open degraded throughput + recovery-time
+# floor after daemon kill/restart. Runs as part of `make tier1` (the
+# full fault matrix lives in tests/test_chaos_devd.py, incl. the
+# slow-marked 20-block soak).
+chaos-smoke:
+	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_CHAOS_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_chaos.py
+
 test_race:
 	$(PY) -m pytest tests/test_race.py -q
 
@@ -52,4 +60,4 @@ test_slow:
 native:
 	$(MAKE) -C native
 
-.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke
+.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke

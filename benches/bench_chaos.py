@@ -1,0 +1,192 @@
+"""Chaos bench (round 8): recovery time and degraded-mode throughput of
+the devd device plane under daemon kill/restart.
+
+What production cares about when a chip (or its daemon) gets sick is not
+just steady-state throughput but the shape of the degradation: how long
+until the process notices and falls back (continuity), what the CPU
+fallback sustains while the daemon is down (degraded delta), and how
+long after the daemon returns until devd routing is restored (recovery
+— the breaker's half-open probe closing). This bench measures all three
+against a sim daemon (device time held constant, chip-free — same
+methodology as bench_devd_stream.py) and writes BENCH_r08.json.
+
+Rows:
+- healthy:   streamed verify throughput, daemon serving (sigs/s)
+- degraded:  throughput with the daemon SIGKILLed — the breaker-open CPU
+             fallback path (sigs/s, + delta vs healthy)
+- recovery:  median seconds from "daemon serving again" to "breaker
+             re-closed AND a batch demonstrably devd-routed", over
+             N_KILLS kill/restart cycles
+
+Asserted floors (chip-free, so they gate `make chaos-smoke` in tier1):
+- every batch during the whole run returns correct verdicts (continuity)
+- recovery_s <= BENCH_CHAOS_MAX_RECOVERY_S (default 5 s with the bench's
+  0.1 s/1 s breaker windows — generous; measured ~0.3-1.5 s)
+
+BENCH_CHAOS_SMOKE=1 shrinks batches/cycles for the tier-1 gate.
+Prints ONE JSON line like the other benches.
+Run from the repo root: python benches/bench_chaos.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+SMOKE = os.environ.get("BENCH_CHAOS_SMOKE", "") == "1"
+N_ITEMS = int(os.environ.get("BENCH_CHAOS_ITEMS", "2048" if SMOKE else "8192"))
+N_KILLS = int(os.environ.get("BENCH_CHAOS_KILLS", "2" if SMOKE else "4"))
+TRIALS = int(os.environ.get("BENCH_CHAOS_TRIALS", "3" if SMOKE else "5"))
+SIM_RATE = float(os.environ.get("BENCH_CHAOS_SIM_RATE", "500000"))
+MAX_RECOVERY_S = float(os.environ.get("BENCH_CHAOS_MAX_RECOVERY_S", "5.0"))
+
+
+def _items(n: int) -> list:
+    """REAL signed lanes, 256 distinct cycled to width: the degraded row
+    runs the actual CPU verifier (structural fakes would rightly fail
+    there), and the sim daemon structurally accepts the same lanes, so
+    'all True' is the correct continuity invariant in every mode."""
+    from tendermint_tpu.crypto import ed25519 as ed
+
+    seeds = [bytes([8, k]) + b"\x08" * 30 for k in range(64)]
+    base = []
+    for i in range(min(n, 256)):
+        seed = seeds[i % 64]
+        msg = b"chaos-%06d" % i
+        base.append((ed.public_key(seed), msg, ed.sign(seed, msg)))
+    return [base[i % len(base)] for i in range(n)]
+
+
+def _rate(verifier, items, trials: int) -> float:
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        oks = verifier.verify_batch(items)
+        best = min(best, time.perf_counter() - t0)
+        assert all(oks), "verdicts must stay correct in every mode"
+    return len(items) / best
+
+
+def main() -> None:
+    # breaker windows for the bench: probe fast so RECOVERY measures the
+    # plane, not a 30 s production backoff cap
+    os.environ.setdefault("TENDERMINT_TPU_BREAKER_BACKOFF_S", "0.1")
+    os.environ.setdefault("TENDERMINT_TPU_BREAKER_BACKOFF_CAP_S", "1.0")
+    os.environ.setdefault("TENDERMINT_DEVD_STREAM_MIN", "64")
+    sock = os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), f"devd-chaos-{os.getpid()}.sock"
+    )
+    os.environ["TENDERMINT_DEVD_SOCK"] = sock
+    os.environ["TENDERMINT_TPU_KERNEL"] = "devd"
+
+    from tendermint_tpu import devd
+    from tendermint_tpu.ops import gateway
+    from tendermint_tpu.ops.faults import DaemonSupervisor, FaultPlan
+
+    plan = FaultPlan(seed=8)
+    sup = DaemonSupervisor(
+        sock, {"TENDERMINT_DEVD_SIM_RATE": str(int(SIM_RATE))}, plan=plan
+    )
+    sup.start()
+    items = _items(N_ITEMS)
+    rows = []
+    try:
+        gateway.reset_devd_breaker()
+        devd.bust_avail_cache()
+        v = gateway.Verifier(min_tpu_batch=1)
+        br = gateway.devd_breaker()
+
+        healthy = _rate(v, items, TRIALS)
+        assert v.stats()["tpu_sigs"] > 0, "healthy row must ride devd"
+        rows.append({
+            "mode": "healthy", "platform": "sim",
+            "sigs_per_sec": round(healthy, 1),
+            "sim_device_sigs_per_sec": SIM_RATE,
+        })
+
+        recoveries = []
+        degraded = None
+        for cycle in range(N_KILLS):
+            sup.kill()
+            # continuity: every batch during the outage answers correct
+            # verdicts (first ones eat the failure triage, then the
+            # breaker opens and the fallback serves clean)
+            deadline = time.monotonic() + 30.0
+            while br.state != br.OPEN:
+                assert time.monotonic() < deadline, "breaker never opened"
+                assert all(v.verify_batch(items))
+            if degraded is None:
+                degraded = _rate(v, items, TRIALS)
+                rows.append({
+                    "mode": "degraded", "platform": "cpu-fallback",
+                    "sigs_per_sec": round(degraded, 1),
+                    "delta_vs_healthy": round(degraded / healthy, 3),
+                    "breaker": br.stats(),
+                })
+            sup.restart()  # blocks until the daemon holds again
+            t0 = time.monotonic()
+            before = v.stats()["tpu_sigs"]
+            deadline = t0 + 30.0
+            while True:
+                assert time.monotonic() < deadline, "devd routing never restored"
+                assert all(v.verify_batch(items))
+                if br.state == br.CLOSED and v.stats()["tpu_sigs"] > before:
+                    break
+                time.sleep(0.02)
+            recoveries.append(time.monotonic() - t0)
+
+        recovery = statistics.median(recoveries)
+        rows.append({
+            "mode": "recovery", "platform": "sim",
+            "kill_restart_cycles": N_KILLS,
+            "recovery_s_median": round(recovery, 3),
+            "recovery_s_all": [round(r, 3) for r in recoveries],
+            "faults": plan.stats(),
+            "breaker": br.stats(),
+        })
+        assert recovery <= MAX_RECOVERY_S, (
+            f"recovery {recovery:.2f}s exceeds the {MAX_RECOVERY_S}s floor"
+        )
+    finally:
+        sup.stop()
+        gateway.reset_devd_breaker()
+        try:
+            os.unlink(sock)
+        except OSError:
+            pass
+
+    record = {
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "metric": "devd chaos: recovery time + degraded-mode throughput",
+        "max_recovery_s_asserted": MAX_RECOVERY_S,
+        "rows": rows,
+        "note": (
+            "sim daemon holds device time constant; degraded row is the "
+            "breaker-open CPU fallback; recovery is daemon-serving -> "
+            "breaker-closed-and-devd-routed (fast probe windows: "
+            "TENDERMINT_TPU_BREAKER_BACKOFF_S=0.1/cap 1.0)"
+        ),
+    }
+    with open(os.path.join(ROOT, "BENCH_r08.json"), "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+
+    print(json.dumps({
+        "metric": "devd_chaos_recovery_s",
+        "value": rows[-1]["recovery_s_median"],
+        "unit": "s",
+        "degraded_delta": rows[1]["delta_vs_healthy"],
+        "healthy_sigs_per_sec": rows[0]["sigs_per_sec"],
+        "platform": "sim",
+        "smoke": SMOKE,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -12,6 +12,10 @@ Configs (BASELINE.md):
   5 mempool   — 50k-tx CheckTx burst + signed-tx gated burst
   6 devd_stream — serving-path transport: single-shot vs streamed devd
                   (writes BENCH_r06.json; asserts the streamed win)
+  7 chaos      — device-plane failure shape: recovery time after daemon
+                 kill/restart + degraded-mode (breaker-open CPU
+                 fallback) throughput delta (writes BENCH_r08.json;
+                 chip-free, asserts the recovery floor)
 
 Each bench is its own process (the TPU is exclusive per process).
 Usage: python benches/run_all.py [--skip testnet,...]
@@ -34,6 +38,7 @@ BENCHES = {
     "4_fastsync": [sys.executable, "benches/bench_fastsync.py"],
     "5_mempool": [sys.executable, "benches/bench_mempool.py"],
     "6_devd_stream": [sys.executable, "benches/bench_devd_stream.py"],
+    "7_chaos": [sys.executable, "benches/bench_chaos.py"],
 }
 
 

@@ -94,6 +94,14 @@ class ConsensusState(BaseService):
         self.replay_mode = False
         self.done_height = threading.Event()  # pulses on each commit (tests)
         self.n_steps = 0
+        # liveness observability (round 8): wall seconds per committed
+        # height, last and max — the direct gauge for "a consensus round
+        # stalled past its budget" (e.g. behind a sick device plane, the
+        # exact regression the chaos soak guards), exported by the
+        # metrics RPC as consensus_height_seconds_{last,max}
+        self._height_started = time.monotonic()
+        self.height_seconds_last = 0.0
+        self.height_seconds_max = 0.0
 
         # duplicate-vote evidence (beyond reference: state.go:1438-1447
         # punts with a TODO; we record validated pairs — types/evidence)
@@ -164,6 +172,11 @@ class ConsensusState(BaseService):
             target=self.receive_routine, args=(0,), daemon=True, name="cs.receiveRoutine"
         )
         self._thread.start()
+        # height clock starts when consensus starts CONSUMING, not at
+        # construction — otherwise the first height's gauge absorbs
+        # fast-sync/handshake/idle time and pins height_seconds_max to a
+        # number that never measured a consensus round
+        self._height_started = time.monotonic()
         self.schedule_round_0(self.rs)
 
     def start_routines(self, max_steps: int = 0) -> None:
@@ -177,6 +190,7 @@ class ConsensusState(BaseService):
             name="cs.receiveRoutine",
         )
         self._thread.start()
+        self._height_started = time.monotonic()  # see on_start
 
     # soft cap on peer-originated messages waiting in _inputs: beyond it
     # the PEER forwarder drops instead of growing the combined queue
@@ -983,6 +997,13 @@ class ConsensusState(BaseService):
         event_cache.flush()
 
         fail_point()
+
+        now = time.monotonic()
+        self.height_seconds_last = now - self._height_started
+        self.height_seconds_max = max(
+            self.height_seconds_max, self.height_seconds_last
+        )
+        self._height_started = now
 
         self.update_to_state(state_copy)
         self.done_height.set()

@@ -87,6 +87,9 @@ _MAX_CHUNK_LANES = 1 << 20
 # default chunk width when neither the daemon's claim-time tuning nor
 # TENDERMINT_DEVD_CHUNK pinned one
 DEFAULT_STREAM_CHUNK = 2048
+# writer-thread reap budget (DevdClient._reap_writer); module-level so
+# the chaos tests can shrink it without waiting out the production value
+WRITER_REAP_S = 5.0
 
 
 def sock_path() -> str:
@@ -1208,6 +1211,34 @@ class DevdError(Exception):
     pass
 
 
+# Sanctioned fault-injection point (ops/faults.py): when set, every NEW
+# client connection passes through the wrapper (a socket-like proxy that
+# injects scheduled faults). Production leaves it None; chaos tests and
+# benches install it so the UNMODIFIED client/gateway triage paths are
+# what gets exercised — no monkeypatching of internals.
+_socket_wrapper = None
+
+
+def set_socket_wrapper(wrapper) -> None:
+    """Install (or clear, with None) the connection-factory wrapper
+    applied by DevdClient._fresh. See ops/faults.install_client_faults."""
+    global _socket_wrapper
+    _socket_wrapper = wrapper
+
+
+def _env_timeout(name: str, default: float) -> float:
+    """Env-tunable deadline budget; a typo'd value must not kill the
+    verify hot path (same rule as stream_chunk's env handling)."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("ignoring malformed %s=%r", name, raw)
+        return default
+
+
 class DevdClient:
     """Client for the device daemon. verify_batch is synchronous;
     verify_batch_async sends on a pooled connection and returns a
@@ -1223,27 +1254,63 @@ class DevdClient:
 
     A request that fails on a POOLED connection retries once on a fresh
     one: pooled sockets go stale whenever the daemon restarts, and a
-    client must survive that without its caller seeing the flap."""
+    client must survive that without its caller seeing the flap.
 
-    def __init__(self, path: str | None = None, connect_timeout: float = 2.0,
-                 io_timeout: float = 300.0):
+    Deadline budgets (round 8): the single flat io_timeout is now only
+    the default for three per-phase budgets — `connect` (dial), `claim`
+    (control-plane ops: ping/status/stats/shutdown and stream headers),
+    and `stream` (each frame read/write on an active stream). Data-plane
+    single-shot verify/hash keep the full io budget (a first batch may
+    legitimately sit behind a minutes-long kernel compile); everything
+    else can and should fail faster. Env overrides:
+    TENDERMINT_DEVD_CONNECT_TIMEOUT_S / _CLAIM_TIMEOUT_S /
+    _STREAM_TIMEOUT_S."""
+
+    def __init__(self, path: str | None = None,
+                 connect_timeout: float | None = None,
+                 io_timeout: float = 300.0, claim_timeout: float | None = None,
+                 stream_timeout: float | None = None):
         self.path = path or sock_path()
-        self.connect_timeout = connect_timeout
+        # env tunes only the DEFAULTS — an explicit constructor arg
+        # always wins (devd.available builds its probe client with
+        # connect_timeout=1.0 precisely so the breaker's inline health
+        # probe stays bounded ~1 s; an operator's env knob must not
+        # silently un-bound the verify hot path through it)
+        self.connect_timeout = connect_timeout if connect_timeout is not None \
+            else _env_timeout("TENDERMINT_DEVD_CONNECT_TIMEOUT_S", 2.0)
         self.io_timeout = io_timeout
+        self.claim_timeout = claim_timeout if claim_timeout is not None \
+            else _env_timeout("TENDERMINT_DEVD_CLAIM_TIMEOUT_S", io_timeout)
+        self.stream_timeout = stream_timeout if stream_timeout is not None \
+            else _env_timeout("TENDERMINT_DEVD_STREAM_TIMEOUT_S", io_timeout)
         self._pool: list[socket.socket] = []
         self._mtx = threading.Lock()
         self._adv_chunk: int | None = None  # daemon-advertised width
+        # reconnects is the TOTAL; the labeled pair splits it by where
+        # the stale socket surfaced — at first use of a pooled conn
+        # (reconnects_connect: daemon restarted between requests) vs
+        # mid-exchange (reconnects_midstream: it died under an active
+        # request/stream) — so chaos tests can assert WHICH path fired
         self._stream_stats = {
             "stream_batches": 0, "stream_chunks_out": 0,
             "stream_lanes": 0, "stream_bytes_out": 0, "reconnects": 0,
+            "reconnects_connect": 0, "reconnects_midstream": 0,
+            "writer_abandoned": 0,
         }
         # hash-plane counters, same key shape (consumers prefix; the
         # gateway Hasher folds these in as flat stream_* gauges)
         self._hash_stats = {
             "stream_batches": 0, "stream_chunks_out": 0,
             "stream_lanes": 0, "stream_bytes_out": 0, "reconnects": 0,
+            "reconnects_connect": 0, "reconnects_midstream": 0,
+            "writer_abandoned": 0,
             "stream_trees": 0, "single_batches": 0, "single_lanes": 0,
         }
+
+    def _note_reconnect(self, stats: dict, where: str) -> None:
+        with self._mtx:
+            stats["reconnects"] += 1
+            stats[f"reconnects_{where}"] += 1
 
     def _acquire(self) -> tuple[socket.socket, bool]:
         """(connection, was_pooled). Pooled sockets may be stale — the
@@ -1263,11 +1330,27 @@ class DevdClient:
         except Exception:
             pass
 
+    def _kill(self, conn) -> None:
+        """shutdown THEN discard: a conn being abandoned mid-stream may
+        have the writer thread blocked in sendall on it, and close()
+        alone never wakes a syscall pinned on the same fd — shutdown
+        fails it fast, so the follow-up _reap_writer join returns
+        promptly instead of burning the full reap budget."""
+        try:
+            conn.shutdown(socket.SHUT_RDWR)
+        except Exception:  # noqa: BLE001 — already dead is fine
+            pass
+        self._discard(conn)
+
     def request(self, obj, timeout: float | None = None) -> dict:
+        """One pickle round trip. The read/write budget defaults to the
+        CLAIM deadline (control-plane ops fail fast); data-plane ops
+        that may sit behind a kernel compile pass the io budget
+        explicitly (verify_batch / hash_batch)."""
         conn, pooled = self._acquire()
         while True:
-            if timeout is not None:
-                conn.settimeout(timeout)
+            conn.settimeout(timeout if timeout is not None
+                            else self.claim_timeout)
             try:
                 _send_frame(conn, obj)
                 rep = _recv_frame(conn)
@@ -1279,13 +1362,11 @@ class DevdClient:
                 # same work would double device load exactly when it is
                 # saturated (and break at-most-once for non-verify ops).
                 if pooled and isinstance(exc, (ConnectionError, EOFError)):
-                    with self._mtx:
-                        self._stream_stats["reconnects"] += 1
+                    self._note_reconnect(self._stream_stats, "connect")
                     conn, pooled = self._fresh(), False
                     continue
                 raise
-            if timeout is not None:
-                conn.settimeout(self.io_timeout)
+            conn.settimeout(self.io_timeout)
             self._release(conn)
             return rep
 
@@ -1294,6 +1375,8 @@ class DevdClient:
         conn.settimeout(self.connect_timeout)
         conn.connect(self.path)
         conn.settimeout(self.io_timeout)
+        if _socket_wrapper is not None:  # chaos harness (ops/faults.py)
+            conn = _socket_wrapper(conn)
         return conn
 
     def ping(self, timeout: float = 5.0) -> dict:
@@ -1303,7 +1386,8 @@ class DevdClient:
         return rep
 
     def verify_batch(self, items) -> list[bool]:
-        rep = self.request({"op": "verify", "items": list(items)})
+        rep = self.request({"op": "verify", "items": list(items)},
+                           timeout=self.io_timeout)
         if not rep.get("ok"):
             raise DevdError(rep.get("error", "verify failed"))
         return rep["results"]
@@ -1317,8 +1401,7 @@ class DevdClient:
             self._discard(conn)
             if not (pooled and isinstance(exc, (ConnectionError, EOFError))):
                 raise
-            with self._mtx:
-                self._stream_stats["reconnects"] += 1
+            self._note_reconnect(self._stream_stats, "connect")
             conn, pooled = self._fresh(), False
             try:
                 _send_frame(conn, {"op": "verify", "items": items})
@@ -1335,8 +1418,7 @@ class DevdClient:
                     # stale pooled socket: the daemon restarted between
                     # requests — the whole batch retries on a fresh conn
                     # (timeouts deliberately do NOT retry: see request())
-                    with self._mtx:
-                        self._stream_stats["reconnects"] += 1
+                    self._note_reconnect(self._stream_stats, "midstream")
                     return self.verify_batch(items)
                 raise
             self._release(conn)
@@ -1414,27 +1496,49 @@ class DevdClient:
             try:
                 return collect(conn, writer, werr)
             except DevdError:
-                self._discard(conn)
+                self._kill(conn)
+                self._reap_writer(writer, stats, conn)
                 raise
             except Exception as exc:
-                self._discard(conn)
-                writer.join(timeout=5.0)
+                self._kill(conn)
+                self._reap_writer(writer, stats, conn)
                 if werr and not isinstance(werr[0], OSError):
                     raise werr[0] from exc
                 if not (pooled and isinstance(exc, (ConnectionError, EOFError))):
                     raise
-                with self._mtx:
-                    stats["reconnects"] += 1
+                self._note_reconnect(stats, "midstream")
                 conn2, _, writer2, werr2 = self._start_stream(
                     spans, True, header, pack, stats
                 )
                 try:
                     return collect(conn2, writer2, werr2)
                 except Exception:
-                    self._discard(conn2)
+                    self._kill(conn2)
+                    self._reap_writer(writer2, stats, conn2)
                     raise
 
         return resolve
+
+    def _reap_writer(self, writer, stats: dict, conn) -> bool:
+        """Join the writer thread under a bounded budget. An overrun is
+        ABANDONMENT (satellite fix, round 8): the pre-r8 code silently
+        walked away from a live writer wedged in sendall, leaving its
+        thread and connection dangling with no trace in any counter.
+        Now abandonment counts as a fault (`writer_abandoned`, surfaced
+        through stream_* stats), and the connection is closed — which
+        both unwedges the stuck sendall (it fails fast on the dead fd)
+        and guarantees the socket can never re-enter the pool. Returns
+        True when the writer had to be abandoned."""
+        writer.join(timeout=WRITER_REAP_S)
+        if not writer.is_alive():
+            return False
+        with self._mtx:
+            stats["writer_abandoned"] += 1
+        logger.warning(
+            "stream writer abandoned after join timeout; closing its conn"
+        )
+        self._kill(conn)  # shutdown-then-close: unwedges a pinned sendall
+        return True
 
     def _start_stream(self, spans, fresh: bool, header: dict, pack, stats):
         """Open one chunked stream (verify or hash plane): send the
@@ -1446,13 +1550,18 @@ class DevdClient:
         else:
             conn, pooled = self._acquire()
         try:
+            conn.settimeout(self.claim_timeout)
             _send_frame(conn, header)
+            # per-frame budget for the active stream: each chunk write
+            # and each result read must make progress inside this window
+            # (a stalled daemon surfaces as socket.timeout here instead
+            # of sitting on the full flat io budget)
+            conn.settimeout(self.stream_timeout)
         except Exception as exc:
             self._discard(conn)
             if not (pooled and isinstance(exc, (ConnectionError, EOFError))):
                 raise
-            with self._mtx:
-                stats["reconnects"] += 1
+            self._note_reconnect(stats, "connect")
             return self._start_stream(spans, True, header, pack, stats)
         werr: list = []
 
@@ -1496,7 +1605,8 @@ class DevdClient:
             payload = _recv_raw_frame(conn)
             status, idx = struct.unpack_from("<BI", payload, 0)
             if status == STREAM_ERR:
-                writer.join(timeout=5.0)
+                # the resolver's DevdError handler discards the conn and
+                # reaps the writer (abandonment-counted) — no join here
                 raise DevdError(
                     f"stream chunk {idx}: {payload[5:].decode(errors='replace')}"
                 )
@@ -1522,12 +1632,14 @@ class DevdClient:
                 np.frombuffer(payload, dtype=np.uint8, offset=9)
                 .astype(bool).tolist()
             )
-        writer.join(timeout=5.0)
+        abandoned = self._reap_writer(writer, self._stream_stats, conn)
         if werr:
             # results complete but the writer died — impossible unless
             # the daemon answered chunks it never received; be loud
             raise DevdError(f"stream writer failed: {werr[0]}")
-        self._release(conn)
+        if not abandoned:
+            conn.settimeout(self.io_timeout)  # back to pickle mode
+            self._release(conn)
         return out
 
     # -- streamed hash transport --------------------------------------------
@@ -1538,7 +1650,7 @@ class DevdClient:
         rep = self.request({
             "op": "hash", "mode": mode,
             "items": [bytes(b) for b in items], "tree": bool(tree),
-        })
+        }, timeout=self.io_timeout)
         if not rep.get("ok"):
             raise DevdError(rep.get("error", "hash failed"))
         with self._mtx:
@@ -1587,7 +1699,7 @@ class DevdClient:
             payload = _recv_raw_frame(conn)
             status, idx = struct.unpack_from("<BI", payload, 0)
             if status == STREAM_ERR:
-                writer.join(timeout=5.0)
+                # resolver discards + reaps (see _collect_stream)
                 raise DevdError(
                     f"hash stream chunk {idx}: "
                     f"{payload[5:].decode(errors='replace')}"
@@ -1614,7 +1726,6 @@ class DevdClient:
             payload = _recv_raw_frame(conn)
             status, cnt = struct.unpack_from("<BI", payload, 0)
             if status == STREAM_ERR:
-                writer.join(timeout=5.0)
                 raise DevdError(
                     f"hash stream tree: {payload[5:].decode(errors='replace')}"
                 )
@@ -1623,10 +1734,12 @@ class DevdClient:
             nodes = [payload[5 + 20 * i: 25 + 20 * i] for i in range(cnt)]
             with self._mtx:
                 self._hash_stats["stream_trees"] += 1
-        writer.join(timeout=5.0)
+        abandoned = self._reap_writer(writer, self._hash_stats, conn)
         if werr:
             raise DevdError(f"hash stream writer failed: {werr[0]}")
-        self._release(conn)
+        if not abandoned:
+            conn.settimeout(self.io_timeout)  # back to pickle mode
+            self._release(conn)
         return (digests, nodes) if want_tree else digests
 
     def hash_stream_stats(self) -> dict:

@@ -14,6 +14,8 @@ one batching point.
 
 from __future__ import annotations
 
+import logging
+
 from tendermint_tpu.blockchain.reactor import BlockchainReactor
 from tendermint_tpu.blockchain.store import BlockStore
 from tendermint_tpu.consensus.reactor import ConsensusReactor
@@ -38,6 +40,8 @@ from tendermint_tpu.state.state import State
 from tendermint_tpu.state.txindex import KVTxIndexer, NullTxIndexer
 from tendermint_tpu.types import GenesisDoc, PrivValidatorFS
 from tendermint_tpu.version import VERSION
+
+logger = logging.getLogger("node")
 
 
 def _parse_laddr(laddr: str) -> str:
@@ -82,6 +86,22 @@ class Node(BaseService):
         self.verifier = gateway.default_verifier()
         self.hasher = gateway.default_hasher()
         tx_types.set_batch_tx_root(self.hasher.tx_merkle_root)
+        # operator visibility at startup: which device plane this node
+        # runs on, and (devd route) the breaker policy that governs its
+        # degradation/recovery — the runtime state lives in the metrics
+        # RPC (gateway_verify_breaker_* / gateway_hash_breaker_*)
+        if self.verifier._kernel == "devd":
+            br = gateway.devd_breaker()
+            logger.info(
+                "device plane: devd IPC (breaker: open after %d failures, "
+                "probe backoff %.2gs..%.2gs)",
+                br.threshold, br.base_backoff_s, br.max_backoff_s,
+            )
+        else:
+            logger.info(
+                "device plane: %s",
+                self.verifier._kernel or "cpu (native batch verify)",
+            )
         # warm the native marshal/verify library off the hot path: the
         # gateway's CPU fallback only uses it when ready() (never builds
         # inline), so trigger the build/load here in the background

@@ -96,6 +96,18 @@ def _latch_single_shot() -> None:
     _stream_ok = False
 
 
+def reset_stream_latches() -> None:
+    """Re-arm the version-skew latches (verify AND hash planes). Called
+    by the shared circuit breaker's on_close hook (ops/gateway): the
+    latches are per-DAEMON facts, and a breaker re-close means the
+    daemon came back — possibly upgraded — so the streamed fast path
+    must get another chance instead of staying latched off by the build
+    that died."""
+    global _stream_ok, _hash_stream_ok
+    _stream_ok = True
+    _hash_stream_ok = True
+
+
 def stream_stats() -> dict:
     """Client-side streamed-transport counters; Verifier.stats() exposes
     them so the serving path is observable from the node process too."""

@@ -11,7 +11,12 @@ behavior, CPU fallback below a size threshold).
 Policies:
 - batches below `min_tpu_batch` run on CPU (kernel launch + host marshal
   overhead beats the win for small batches; single votes stay CPU);
-- TPU failures (no device, compile error) permanently fall back to CPU;
+- direct-kernel failures (compile error, device init) permanently fall
+  back to CPU — deterministic in-process failures recur per batch;
+- devd-transport failures feed the shared CircuitBreaker (round 8):
+  open = CPU fallback per batch, half-open ping probes on jittered
+  exponential backoff restore devd routing when the daemon returns —
+  a transient daemon restart never latches the process on CPU;
 - `mesh` sharding: on a multi-chip jax.sharding.Mesh the batch axis is
   sharded across devices — pure data parallelism over independent
   signatures, no collectives needed in the kernel itself.
@@ -21,6 +26,7 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import threading
 import time
 from collections import OrderedDict
@@ -33,6 +39,21 @@ from tendermint_tpu.crypto.keys import verify_any
 logger = logging.getLogger("ops.gateway")
 
 Item = tuple[bytes, bytes, bytes]  # (pubkey, message, signature)
+
+
+def _env_number(name: str, default: float, cast=float) -> float:
+    """Env-tunable numeric knob; a typo'd value warns and falls back —
+    it must never kill the verify hot path (same rule as
+    devd._env_timeout, which stays module-local to avoid an import
+    cycle)."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        logger.warning("ignoring malformed %s=%r", name, raw)
+        return default
 
 
 def _cpu_verify_batch(items: list[Item]) -> list[bool]:
@@ -271,6 +292,255 @@ def _split_by_key_type(items: list[Item]):
     return ed_items, ed_pos, other_items, other_pos
 
 
+class CircuitBreaker:
+    """Shared closed → open → half-open degradation/recovery policy for
+    the devd device plane (round 8).
+
+    Before this existed, every consumer latched its own one-way flag on
+    failure: `Verifier._demote_after_failure` pinned the process to the
+    CPU fallback FOREVER after 3 transport errors, and the hash plane
+    kept a separate single-shot skew latch — so a 2-second daemon
+    restart demoted a live consensus node to CPU for its whole lifetime.
+    The breaker replaces all of that with one recoverable state machine
+    shared by both planes (Verifier, Hasher, ShardedVerifier's inherited
+    paths, the mempool SigBatcher and consensus prime_cache_async, which
+    all dispatch through them):
+
+    - CLOSED: devd routes normally. `threshold` CONSECUTIVE failures
+      (default 3, TENDERMINT_TPU_BREAKER_FAILURES) open it.
+    - OPEN: callers route to the CPU fallback per batch — verdicts and
+      digests stay correct, only the transport degrades. Probes are
+      scheduled on exponential backoff with jitter (base
+      TENDERMINT_TPU_BREAKER_BACKOFF_S, default 0.5 s; cap
+      TENDERMINT_TPU_BREAKER_BACKOFF_CAP_S, default 30 s).
+    - HALF-OPEN: when a probe is due, `allow()` runs it inline — the
+      existing devd ping (cheap, ~1 ms against a live daemon, bounded
+      ~1 s against a dead one; at most one caller probes per window,
+      concurrent callers stay on the fallback). A healthy probe
+      re-CLOSES the breaker and devd routing resumes; a failed one
+      re-opens with doubled backoff. With no probe injected, the one
+      `allow()` that finds a due window returns True as a TRIAL request
+      and its record_success/record_failure settles the state.
+
+    Observability: `stats()` returns flat numeric gauges (state,
+    open/close transition counts, probe counts, consecutive failures,
+    cumulative seconds on the fallback) that Verifier/Hasher `stats()`
+    fold in — the metrics RPC exports them, so operators SEE
+    degradation instead of inferring it from throughput."""
+
+    CLOSED, HALF_OPEN, OPEN = 0, 1, 2
+
+    def __init__(self, threshold: int | None = None,
+                 base_backoff_s: float | None = None,
+                 max_backoff_s: float | None = None,
+                 probe=None, on_close=None, seed: int | None = None):
+        self.threshold = max(1, int(
+            threshold if threshold is not None
+            else _env_number("TENDERMINT_TPU_BREAKER_FAILURES", 3)
+        ))
+        self.base_backoff_s = float(
+            base_backoff_s if base_backoff_s is not None
+            else _env_number("TENDERMINT_TPU_BREAKER_BACKOFF_S", 0.5)
+        )
+        self.max_backoff_s = float(
+            max_backoff_s if max_backoff_s is not None
+            else _env_number("TENDERMINT_TPU_BREAKER_BACKOFF_CAP_S", 30.0)
+        )
+        self._probe = probe
+        self._on_close = on_close
+        self._rng = random.Random(seed)
+        self._mtx = threading.Lock()
+        self._state = self.CLOSED
+        self._fails = 0
+        self._backoff = self.base_backoff_s
+        self._opened_at = 0.0
+        self._next_probe = 0.0
+        self._probing = False
+        self._opens = 0
+        self._closes = 0
+        self._probes = 0
+        self._probe_failures = 0
+        self._fallback_s = 0.0
+
+    def _jittered(self, backoff: float) -> float:
+        # full jitter on [0.5x, 1.5x]: many processes sharing one daemon
+        # must not probe in lockstep after a restart
+        return backoff * (0.5 + self._rng.random())
+
+    def _open_locked(self, now: float, *, reopen: bool) -> None:
+        if self._state != self.OPEN and not reopen:
+            self._opens += 1
+            self._opened_at = now
+            self._backoff = self.base_backoff_s
+        self._state = self.OPEN
+        if reopen:
+            self._backoff = min(self._backoff * 2.0, self.max_backoff_s)
+        self._next_probe = now + self._jittered(self._backoff)
+
+    def _close_locked(self, now: float) -> None:
+        if self._state != self.CLOSED:
+            self._closes += 1
+            self._fallback_s += now - self._opened_at
+        self._state = self.CLOSED
+        self._fails = 0
+        self._backoff = self.base_backoff_s
+
+    def allow(self) -> bool:
+        """May the caller route to devd right now? CLOSED: yes. OPEN
+        with a probe due: run the probe (or admit one trial request) —
+        success restores routing for everyone. Otherwise: no, take the
+        fallback."""
+        with self._mtx:
+            if self._state == self.CLOSED:
+                return True
+            now = time.monotonic()
+            if self._probing or now < self._next_probe:
+                return False
+            self._state = self.HALF_OPEN
+            self._probes += 1
+            if self._probe is None:
+                # trial mode: this one request IS the probe; its
+                # record_success/record_failure settles the state.
+                # Advance the window NOW so concurrent/subsequent
+                # callers stay on the fallback while the trial is in
+                # flight (at most one trial per window — the same
+                # contract the inline-probe branch keeps via _probing)
+                self._next_probe = time.monotonic() + self._jittered(
+                    self._backoff
+                )
+                return True
+            self._probing = True
+            probe = self._probe
+        ok = False
+        try:
+            ok = bool(probe())
+        except Exception:  # noqa: BLE001 — a raising probe is a failed probe
+            logger.exception("breaker probe raised")
+        closed = False
+        with self._mtx:
+            self._probing = False
+            now = time.monotonic()
+            if ok:
+                self._close_locked(now)
+                closed = True
+            else:
+                self._probe_failures += 1
+                # reopen ONLY if this probe still owns the half-open
+                # slot: a concurrent record_success may have closed the
+                # breaker while the probe ran, and that fresh success
+                # evidence outranks the stale probe verdict (reopening
+                # a CLOSED breaker here would also leave _opened_at
+                # pointing at the previous episode, double-counting
+                # fallback_s on the next close)
+                if self._state == self.HALF_OPEN:
+                    self._open_locked(now, reopen=True)
+        if closed:
+            logger.warning("devd breaker re-closed: device routing restored")
+            self._run_on_close()
+        return ok
+
+    def record_success(self) -> None:
+        closed = False
+        with self._mtx:
+            self._fails = 0
+            if self._state != self.CLOSED:
+                self._close_locked(time.monotonic())
+                closed = True
+        if closed:
+            logger.warning("devd breaker re-closed: device routing restored")
+            self._run_on_close()
+
+    def record_failure(self) -> bool:
+        """Note one failure; True if the breaker is now open."""
+        with self._mtx:
+            now = time.monotonic()
+            self._fails += 1
+            if self._state == self.HALF_OPEN:
+                # the trial request failed: straight back to OPEN with
+                # doubled backoff
+                self._probe_failures += 1
+                self._open_locked(now, reopen=True)
+                return True
+            if self._state == self.CLOSED and self._fails >= self.threshold:
+                self._open_locked(now, reopen=False)
+                logger.warning(
+                    "devd breaker OPEN after %d consecutive failures; "
+                    "CPU fallback until a probe finds the daemon healthy",
+                    self._fails,
+                )
+                return True
+            return self._state == self.OPEN
+
+    def _run_on_close(self) -> None:
+        if self._on_close is None:
+            return
+        try:
+            self._on_close()
+        except Exception:  # noqa: BLE001 — a bad hook must not block recovery
+            logger.exception("breaker on_close hook failed")
+
+    @property
+    def state(self) -> int:
+        with self._mtx:
+            return self._state
+
+    def stats(self) -> dict:
+        with self._mtx:
+            now = time.monotonic()
+            current = (now - self._opened_at) if self._state != self.CLOSED \
+                else 0.0
+            return {
+                "breaker_state": self._state,  # 0 closed/1 half-open/2 open
+                "breaker_opens": self._opens,
+                "breaker_closes": self._closes,
+                "breaker_probes": self._probes,
+                "breaker_probe_failures": self._probe_failures,
+                "breaker_consecutive_failures": self._fails,
+                "breaker_fallback_s": round(self._fallback_s + current, 3),
+            }
+
+
+_devd_breaker: CircuitBreaker | None = None
+_breaker_mtx = threading.Lock()
+
+
+def _devd_probe() -> bool:
+    """The breaker's half-open health probe: ONE fresh ping (never the
+    TTL cache — it may predate the daemon's death) proving a daemon is
+    serving AND holds the device."""
+    from tendermint_tpu import devd
+
+    devd.bust_avail_cache()
+    return devd.available(timeout=1.0) is not None
+
+
+def devd_breaker() -> CircuitBreaker:
+    """The process-wide breaker every devd consumer shares — Verifier,
+    Hasher, and everything stacked on them (SigBatcher, prime_cache,
+    fast-sync) see ONE degradation state, so recovery restores every
+    plane at once."""
+    global _devd_breaker
+    with _breaker_mtx:
+        if _devd_breaker is None:
+            from tendermint_tpu.ops import devd_backend
+
+            _devd_breaker = CircuitBreaker(
+                probe=_devd_probe,
+                # a re-close means the daemon came BACK — possibly a
+                # different build, so the per-daemon version-skew
+                # latches must re-learn (devd_backend docstring)
+                on_close=devd_backend.reset_stream_latches,
+            )
+        return _devd_breaker
+
+
+def reset_devd_breaker() -> None:
+    """Drop the shared breaker (tests; also re-reads the env knobs)."""
+    global _devd_breaker
+    with _breaker_mtx:
+        _devd_breaker = None
+
+
 class _PendingBatch:
     """An in-flight prime_cache_async dispatch. Each primed item maps to
     the shared handle; a background thread materializes the verdicts the
@@ -290,8 +560,14 @@ class _PendingBatch:
                 self._done.update(
                     (it, bool(ok)) for it, ok in zip(items, resolve())
                 )
-            except Exception:  # noqa: BLE001 — resolver fallbacks should
-                # make this unreachable; unprimed items re-verify on CPU
+            except Exception:  # noqa: BLE001 — round-8 latch sweep:
+                # genuinely unconditional, NOT breaker business. The
+                # resolver underneath already did the breaker accounting
+                # (Verifier.verify_batch_async's resolve demotes through
+                # _demote_after_failure); anything that still escapes
+                # here only UNPRIMES the items — verify_one re-verifies
+                # each on CPU, so a lost batch is latency, never a wrong
+                # or dropped verdict (idempotent merge)
                 logger.exception("async prime resolve failed")
             finally:
                 self._event.set()
@@ -310,7 +586,15 @@ class _PendingBatch:
 class Verifier:
     """Batch signature verifier with TPU acceleration and CPU fallback."""
 
-    def __init__(self, min_tpu_batch: int = 32, use_tpu: bool | None = None):
+    def __init__(self, min_tpu_batch: int | None = None,
+                 use_tpu: bool | None = None):
+        if min_tpu_batch is None:
+            # operator knob (round 8): small-validator-set deployments
+            # (localnet, chaos harnesses) route narrow consensus batches
+            # through devd only when told to
+            min_tpu_batch = int(
+                _env_number("TENDERMINT_TPU_MIN_BATCH", 32, cast=int)
+            )
         kernel = None
         if use_tpu is None:
             if os.environ.get("TENDERMINT_TPU_DISABLE", "") == "1":
@@ -336,7 +620,6 @@ class Verifier:
         self._kernel = kernel if use_tpu else None
         self.min_tpu_batch = min_tpu_batch
         self._tpu_ok = use_tpu
-        self._devd_fails = 0
         self._mtx = threading.Lock()
         self._stats = {"tpu_batches": 0, "tpu_sigs": 0, "cpu_sigs": 0}
         # verify-ahead results for the live vote path: consensus drains a
@@ -353,51 +636,48 @@ class Verifier:
         return importlib.import_module(KERNELS[self._kernel])
 
     def _demote_after_failure(self) -> None:
-        """A verify raised. For the devd backend, re-ping the daemon
-        FRESH (never the TTL cache — it may predate the daemon's death):
+        """A verify raised.
 
-        - daemon alive and holding: transient failure — keep devd and let
-          the caller retry, up to 3 consecutive failures; a persistently
-          failing-but-alive daemon latches CPU (an in-process dial while
-          the daemon holds the chip would violate the one-owner rule);
-        - daemon dead: re-resolve the platform from scratch (bounded:
-          env, ping, subprocess probe) and take the direct kernel only if
-          an accelerator genuinely answers.
+        devd route: feed the SHARED circuit breaker (round 8; replaces
+        the permanent `_devd_fails >= 3 -> CPU forever` latch and the
+        devd -> direct-kernel demotion). While the breaker is closed the
+        caller's retry re-dispatches over devd (bounded: each failure
+        counts toward the open threshold); once open, `_use_device`
+        routes to the CPU fallback per batch and the breaker's ping
+        probes restore devd routing when the daemon returns — a
+        transient daemon restart costs seconds of fallback, not the
+        process lifetime. The old dead-daemon -> in-process direct
+        kernel switch is deliberately GONE: it was one-way (the daemon
+        coming back found this process holding the chip — the one-owner
+        violation devd exists to prevent) and its platform re-resolve
+        could block the verify hot path behind a 45 s subprocess probe.
+        A daemon retired FOR GOOD is an operator topology change: restart
+        the node or set TENDERMINT_TPU_KERNEL explicitly.
 
-        Any direct-kernel failure (or an unreachable device) latches the
-        permanent CPU fallback, as before."""
+        Direct-kernel failures still latch CPU permanently — a compile
+        or device-init error in THIS process is deterministic, so
+        retrying it per batch would fail identically (annotated per the
+        round-8 latch sweep)."""
         if self._kernel == "devd":
-            from tendermint_tpu import devd
-
-            devd.bust_avail_cache()
-            if devd.available() is not None:
-                self._devd_fails += 1
-                if self._devd_fails < 3:
-                    logger.warning(
-                        "devd request failed but daemon is serving; retry "
-                        "%d/3", self._devd_fails,
-                    )
-                    return  # keep devd; the caller's retry re-dispatches
-                logger.warning("devd failing persistently while alive; CPU path")
-                self._tpu_ok = False
-                return
-            _platform_cache.pop("v", None)
-            platform = resolve_platform()
-            if platform in ("tpu", "axon"):
-                # same policy as kernel_name()'s hardware default: the
-                # comb kernel (its cold lanes self-route to the ladder)
-                self._kernel = "comb"
-                logger.warning("devd dead; direct %s kernel", self._kernel)
-                return
-            if platform is not None:
-                self._kernel = "f32"
-                logger.warning("devd dead; direct %s kernel", self._kernel)
-                return
+            devd_breaker().record_failure()
+            return
         self._tpu_ok = False
+
+    def _use_device(self, n: int) -> bool:
+        """Route this batch to the kernel path? Size/health gates plus,
+        on the devd route, the shared breaker (an OPEN breaker means CPU
+        fallback for this batch — never a permanent demotion)."""
+        if not (self._tpu_ok and n >= self.min_tpu_batch):
+            return False
+        return self._kernel != "devd" or devd_breaker().allow()
+
+    def _note_device_success(self) -> None:
+        if self._kernel == "devd":
+            devd_breaker().record_success()
 
     # -- core API ----------------------------------------------------------
 
-    def verify_batch(self, items: list[Item]) -> list[bool]:
+    def verify_batch(self, items: list[Item], _attempt: int = 0) -> list[bool]:
         n = len(items)
         if n == 0:
             return []
@@ -417,7 +697,7 @@ class Verifier:
             with self._mtx:
                 self._stats["cpu_sigs"] += n
             return _cpu_verify_batch(items)
-        if self._tpu_ok and n >= self.min_tpu_batch:
+        if self._use_device(n) and _attempt <= self._max_retries():
             try:
                 ops_ed = self._kernel_module()
 
@@ -425,17 +705,34 @@ class Verifier:
                 with self._mtx:
                     self._stats["tpu_batches"] += 1
                     self._stats["tpu_sigs"] += n
-                self._devd_fails = 0
+                self._note_device_success()
                 return [bool(b) for b in out]
             except Exception:
                 logger.exception("batch verify via %s failed", self._kernel)
                 self._demote_after_failure()
-                return self.verify_batch(items)  # direct kernel or CPU path
+                # at-least-once with idempotent merge: the WHOLE batch
+                # re-verifies (devd retry while the breaker stays closed,
+                # else the CPU fallback) — a chunk whose stream died
+                # mid-flight is re-dispatched, never dropped. _attempt
+                # bounds THIS batch's retries even when concurrent
+                # successes on the other plane keep resetting the shared
+                # breaker's consecutive-failure count (the recursion
+                # must never be open-ended on the consensus hot path)
+                return self.verify_batch(items, _attempt=_attempt + 1)
         with self._mtx:
             self._stats["cpu_sigs"] += n
         return _cpu_verify_batch(items)
 
-    def verify_batch_async(self, items: list[Item]):
+    def _max_retries(self) -> int:
+        """Per-BATCH retry bound for the devd route (direct kernels
+        never retry: their failures latch). Matches the breaker
+        threshold so a lone caller still drives the breaker open before
+        giving up, while a batch can never recurse past it."""
+        if self._kernel != "devd":
+            return 0
+        return devd_breaker().threshold
+
+    def verify_batch_async(self, items: list[Item], _attempt: int = 0):
         """Pipelined form of verify_batch: marshals + enqueues the device
         kernel now, returns a zero-arg resolver that blocks for results.
         Host marshaling of the next batch can overlap device execution of
@@ -459,7 +756,7 @@ class Verifier:
                 return out
 
             return resolve_mixed
-        if self._tpu_ok and n >= self.min_tpu_batch:
+        if self._use_device(n) and _attempt <= self._max_retries():
             try:
                 ops_ed = self._kernel_module()
                 if not hasattr(ops_ed, "verify_batch_async"):
@@ -479,7 +776,7 @@ class Verifier:
                     # guarantee here too.
                     try:
                         res = [bool(b) for b in kernel_resolve()]
-                        self._devd_fails = 0
+                        self._note_device_success()
                         return res
                     except Exception:
                         logger.exception(
@@ -495,7 +792,7 @@ class Verifier:
             except Exception:
                 logger.exception("batch verify via %s failed", self._kernel)
                 self._demote_after_failure()
-                return self.verify_batch_async(items)
+                return self.verify_batch_async(items, _attempt=_attempt + 1)
         with self._mtx:
             self._stats["cpu_sigs"] += n
         res = _cpu_verify_batch(items)
@@ -564,6 +861,17 @@ class Verifier:
                     out[k if k.startswith("stream") else f"stream_{k}"] = val
             except Exception:  # noqa: BLE001 — stats must never raise
                 pass
+            # degradation observability (round 8): breaker state +
+            # transitions + time-in-fallback, and the faults_* counters
+            # (zeros unless a chaos harness is registered) — operators
+            # see a sick device plane, not just a throughput dip
+            try:
+                out.update(devd_breaker().stats())
+                from tendermint_tpu.ops import faults
+
+                out.update(faults.global_counters())
+            except Exception:  # noqa: BLE001 — stats must never raise
+                pass
         return out
 
     # -- adapters for the call sites --------------------------------------
@@ -590,7 +898,7 @@ class ShardedVerifier(Verifier):
     the fallback). Bake-off backends don't shard; requesting one
     explicitly is an error rather than a silent misreport."""
 
-    def __init__(self, mesh, min_tpu_batch: int = 32):
+    def __init__(self, mesh, min_tpu_batch: int | None = None):
         super().__init__(min_tpu_batch=min_tpu_batch, use_tpu=True)
         explicit = os.environ.get("TENDERMINT_TPU_KERNEL", "")
         if explicit and explicit not in ("f32", "f32p"):
@@ -628,7 +936,7 @@ class ShardedVerifier(Verifier):
 
         return importlib.import_module(KERNELS["f32"])
 
-    def verify_batch_async(self, items: list[Item]):
+    def verify_batch_async(self, items: list[Item], _attempt: int = 0):
         """Sharded pipelining: the pjit/shard_map dispatch is already
         asynchronous, so enqueue now and materialize in the resolver —
         same contract as the base class (which would otherwise fall back
@@ -640,23 +948,23 @@ class ShardedVerifier(Verifier):
             or n < self.min_tpu_batch
             or any(len(it[0]) != 32 or len(it[2]) != 64 for it in items)
         ):
-            return super().verify_batch_async(items)
+            return super().verify_batch_async(items, _attempt=_attempt)
         res = self.verify_batch(items)  # async dispatch inside; results
         # materialize before return today — acceptable: the sharded path
         # serves pod-scale batch posting, and jax's async dispatch still
         # overlaps device work with the caller's next marshal
         return lambda: res
 
-    def verify_batch(self, items: list[Item]) -> list[bool]:
+    def verify_batch(self, items: list[Item], _attempt: int = 0) -> list[bool]:
         n = len(items)
         if n == 0:
             return []
         if any(len(it[0]) != 32 or len(it[2]) != 64 for it in items):
             # mixed key types: the base partitions and re-enters here with
             # the pure-ed25519 lanes; secp256k1 verifies on CPU
-            return super().verify_batch(items)
+            return super().verify_batch(items, _attempt=_attempt)
         if not self._tpu_ok or n < self.min_tpu_batch:
-            return super().verify_batch(items)
+            return super().verify_batch(items, _attempt=_attempt)
         try:
             if self._kernel == "f32p":
                 from tendermint_tpu.ops import ed25519_f32p as ops_f32p
@@ -692,6 +1000,11 @@ class ShardedVerifier(Verifier):
                 self._stats["tpu_sigs"] += n
             return [bool(b) for b in (np.asarray(ok)[:n] & valid[:n])]
         except Exception:
+            # round-8 latch sweep: these stay genuinely unconditional —
+            # a sharded compile/dispatch failure in THIS process is
+            # deterministic (same mesh, same program), so a breaker-style
+            # retry would fail identically; the f32p -> f32 -> CPU ladder
+            # is a one-way ratchet by design
             if self._kernel == "f32p":
                 logger.exception("sharded f32p verify failed; trying f32")
                 self._kernel = "f32"
@@ -812,7 +1125,12 @@ class Hasher:
     Overrides: TENDERMINT_TPU_HASHES=1 forces offload (any transport),
     =0 forces CPU; TENDERMINT_TPU_DISABLE=1 forces CPU."""
 
-    def __init__(self, min_tpu_batch: int = 16, use_tpu: bool | None = None):
+    def __init__(self, min_tpu_batch: int | None = None,
+                 use_tpu: bool | None = None):
+        if min_tpu_batch is None:
+            min_tpu_batch = int(
+                _env_number("TENDERMINT_TPU_HASH_MIN_BATCH", 16, cast=int)
+            )
         if use_tpu is None:
             env = os.environ.get("TENDERMINT_TPU_HASHES", "")
             if os.environ.get("TENDERMINT_TPU_DISABLE", "") == "1" or env == "0":
@@ -870,7 +1188,39 @@ class Hasher:
                     out[k if k.startswith("stream") else f"stream_{k}"] = val
             except Exception:  # noqa: BLE001 — stats must never raise
                 pass
+            # the SAME shared breaker the verify plane rides (round 8)
+            try:
+                out.update(devd_breaker().stats())
+                from tendermint_tpu.ops import faults
+
+                out.update(faults.global_counters())
+            except Exception:  # noqa: BLE001 — stats must never raise
+                pass
         return out
+
+    def _use_offload(self, n: int) -> bool:
+        """Route this batch to the offload path? On the devd route the
+        shared breaker gates per batch (an open breaker = host hashing
+        for THIS batch, devd routing restored by the next healthy
+        probe — never the old permanent `_tpu_ok = False` latch)."""
+        if not (self._tpu_ok and n >= self.min_tpu_batch):
+            return False
+        return self._route != "devd" or devd_breaker().allow()
+
+    def _demote_after_failure(self) -> None:
+        """A hash offload raised. devd route -> the shared breaker
+        (transient transport failure, recoverable). In-process kernel
+        route -> permanent CPU latch, annotated per the round-8 sweep:
+        a jax compile/dispatch failure in this process is deterministic
+        and would recur per batch."""
+        if self._route == "devd":
+            devd_breaker().record_failure()
+            return
+        self._tpu_ok = False
+
+    def _note_offload_success(self) -> None:
+        if self._route == "devd":
+            devd_breaker().record_success()
 
     def _note_batch(self, n_bytes: int, dt_s: float) -> None:
         ms = dt_s * 1000.0
@@ -897,7 +1247,7 @@ class Hasher:
 
     def part_leaf_hashes(self, chunks: list[bytes]) -> list[bytes]:
         """Part.Hash batch — for PartSet.from_data(hasher=...)."""
-        if self._tpu_ok and len(chunks) >= self.min_tpu_batch:
+        if self._use_offload(len(chunks)):
             try:
                 t0 = time.perf_counter()
                 out = self._offload_leaf_hashes(chunks, "part")
@@ -907,10 +1257,11 @@ class Hasher:
                 with self._mtx:
                     self._stats["tpu_part_batches"] += 1
                     self._stats["tpu_leaves"] += len(chunks)
+                self._note_offload_success()
                 return out
             except Exception:
                 logger.exception("TPU part hashing failed; falling back to CPU")
-                self._tpu_ok = False
+                self._demote_after_failure()
         with self._mtx:
             self._stats["cpu_leaves"] += len(chunks)
         from tendermint_tpu import native
@@ -934,7 +1285,7 @@ class Hasher:
         internal tree node (the hash_stream tree frame), so proofs cost
         this process zero hashing; the in-process route reads the same
         node buffer off the tree kernel (ops/merkle)."""
-        if not (self._tpu_ok and len(chunks) >= self.min_tpu_batch):
+        if not self._use_offload(len(chunks)):
             return None
         from tendermint_tpu.merkle.simple import FlatTree
 
@@ -962,10 +1313,11 @@ class Hasher:
             with self._mtx:
                 self._stats["tpu_part_batches"] += 1
                 self._stats["tpu_leaves"] += len(chunks)
+            self._note_offload_success()
             return digests, tree
         except Exception:
             logger.exception("TPU part-set tree failed; falling back to CPU")
-            self._tpu_ok = False
+            self._demote_after_failure()
             return None
 
     def tx_merkle_root(self, txs: list[bytes]) -> bytes:
@@ -990,7 +1342,7 @@ class Hasher:
         return root
 
     def _tx_merkle_root_uncached(self, txs: list[bytes]) -> bytes:
-        if self._tpu_ok and len(txs) >= self.min_tpu_batch:
+        if self._use_offload(len(txs)):
             try:
                 t0 = time.perf_counter()
                 if self._route == "devd":
@@ -1013,10 +1365,11 @@ class Hasher:
                 with self._mtx:
                     self._stats["tpu_tx_roots"] += 1
                     self._stats["tpu_leaves"] += len(txs)
+                self._note_offload_success()
                 return out
             except Exception:
                 logger.exception("TPU tx hashing failed; falling back to CPU")
-                self._tpu_ok = False
+                self._demote_after_failure()
         from tendermint_tpu.merkle.simple import simple_hash_from_byteslices
 
         with self._mtx:

@@ -338,6 +338,15 @@ def metrics(ctx) -> dict:
     out["consensus_height"] = rs.height
     out["consensus_round"] = rs.round_
     out["consensus_step"] = int(rs.step)
+    # liveness gauges (round 8): wall seconds per committed height —
+    # the operator-facing "did a round stall behind a sick device
+    # plane" signal the chaos soak asserts on
+    out["consensus_height_seconds_last"] = round(
+        getattr(ctx.consensus_state, "height_seconds_last", 0.0), 3
+    )
+    out["consensus_height_seconds_max"] = round(
+        getattr(ctx.consensus_state, "height_seconds_max", 0.0), 3
+    )
     out["blockstore_height"] = ctx.block_store.height()
     out["consensus_peer_msg_drops"] = ctx.consensus_state.peer_msg_drops
     pool = getattr(ctx.consensus_state, "evidence_pool", None)
@@ -347,6 +356,8 @@ def metrics(ctx) -> dict:
     batcher = getattr(ctx.mempool, "sig_batcher", None)
     if batcher is not None:
         out["mempool_sig_gate_dropped"] = batcher.dropped
+        out["mempool_sig_gate_delivered"] = batcher.delivered
+        out["mempool_sig_gate_fail_open"] = batcher.fail_open
     outbound, inbound, dialing = ctx.switch.num_peers()
     out["p2p_peers_outbound"] = outbound
     out["p2p_peers_inbound"] = inbound

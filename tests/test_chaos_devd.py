@@ -1,0 +1,690 @@
+"""Chaos suite for the devd device plane (round 8 — ISSUE 3).
+
+The consensus critical path rides a socket to a separate daemon process
+on both hot planes (verify stream, hash stream); these tests prove the
+plane DEGRADES AND RECOVERS instead of latching dead: faults injected on
+a deterministic seeded schedule (ops/faults.FaultPlan — no internals
+monkeypatched), the shared circuit breaker opening to the CPU fallback
+and re-closing when the daemon returns, and consensus committing blocks
+throughout.
+
+Fast tier-1 subset (unmarked): schedule determinism, breaker trial
+mode, in-process and out-of-process (FaultProxy — real wire bytes)
+injection with verdict/digest parity, SigBatcher exactly-once delivery
+across a daemon death, writer abandonment accounting, and a short
+consensus-under-churn run. The slow-marked soak is the acceptance run:
+>= 20 committed blocks under a kill/restart + frame-corruption schedule
+with the committed tx sequence, part-set roots, and final app hash
+byte-identical to a fault-free run, and the breaker demonstrably
+re-closed.
+
+Commit-hash fidelity note: block HEADER hashes embed wall-clock propose
+times, so two separate runs can never be compared header-for-header;
+the deterministic commit fingerprints are the committed tx sequence,
+the per-block part-set root (recomputed on pure CPU against the root
+the devd-routed hasher produced under faults), and the app-hash chain
+they imply. All sim daemons here hash with REAL digests
+(devd._SimHasher), so those comparisons are real parity, not tautology.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu import devd
+from tendermint_tpu.crypto import ed25519 as ed
+from tendermint_tpu.ops import faults
+from tendermint_tpu.ops.faults import (
+    DaemonSupervisor,
+    Fault,
+    FaultPlan,
+    FaultProxy,
+)
+
+SIM_ENV = {"TENDERMINT_DEVD_SIM_RATE": "200000"}
+
+
+@pytest.fixture()
+def chaos_env(monkeypatch, tmp_path):
+    """devd-routed gateway with fast breaker windows and clean shared
+    state (breaker, backend client, skew latches, avail cache); yields
+    the per-test daemon socket path."""
+    sock = str(tmp_path / "devd.sock")
+    monkeypatch.setenv("TENDERMINT_DEVD_SOCK", sock)
+    monkeypatch.setenv("TENDERMINT_TPU_KERNEL", "devd")
+    monkeypatch.setenv("TENDERMINT_TPU_BREAKER_BACKOFF_S", "0.05")
+    monkeypatch.setenv("TENDERMINT_TPU_BREAKER_BACKOFF_CAP_S", "0.25")
+    monkeypatch.setenv("TENDERMINT_DEVD_STREAM_MIN", "8")
+    monkeypatch.setenv("TENDERMINT_DEVD_CLAIM_TIMEOUT_S", "10")
+    monkeypatch.setenv("TENDERMINT_DEVD_STREAM_TIMEOUT_S", "10")
+    import tendermint_tpu.ops.devd_backend as backend
+    from tendermint_tpu.ops import gateway
+
+    monkeypatch.setattr(backend, "_client", None)
+    # the module-level default gateway instances are process-global;
+    # monkeypatch restores whatever existed before the test, so a
+    # devd-routed default built against this test's throwaway daemon
+    # can never leak into later tests
+    monkeypatch.setattr(gateway, "_default_verifier", None)
+    monkeypatch.setattr(gateway, "_default_hasher", None)
+    backend.reset_stream_latches()
+    gateway.reset_devd_breaker()
+    devd.bust_avail_cache()
+    yield sock
+    devd.set_socket_wrapper(None)
+    gateway.reset_devd_breaker()
+    backend.reset_stream_latches()
+    devd.bust_avail_cache()
+
+
+def _items(n: int, tag: bytes = b"chaos"):
+    seeds = [bytes([7, k]) + b"\x07" * 30 for k in range(8)]
+    out = []
+    for i in range(n):
+        seed = seeds[i % 8]
+        msg = tag + b"-%d" % i
+        out.append((ed.public_key(seed), msg, ed.sign(seed, msg)))
+    return out
+
+
+def _wait_breaker_closed(verify_once, breaker, deadline_s: float = 10.0):
+    """Drive traffic until a probe re-closes the breaker (bounded)."""
+    deadline = time.monotonic() + deadline_s
+    while breaker.state != breaker.CLOSED:
+        assert time.monotonic() < deadline, "breaker never re-closed"
+        verify_once()
+        time.sleep(0.05)
+
+
+# -- schedule + breaker units (no daemon) -------------------------------------
+
+
+def test_fault_plan_schedule_is_deterministic():
+    plan = FaultPlan(seed=7).add("corrupt", "s2c", first=3, every=3, limit=2)
+    fired = [plan.pick("s2c") is not None for _ in range(10)]
+    assert fired == [False, False, True, False, False, True,
+                     False, False, False, False]
+    assert plan.stats()["faults_corrupt"] == 2
+    assert plan.stats()["faults_total"] == 2
+    # unrelated event streams never trip the rule
+    assert all(plan.pick("c2s") is None for _ in range(10))
+    # content randomness is seed-deterministic
+    a, b = FaultPlan(seed=9), FaultPlan(seed=9)
+    assert [a.corrupt_offset(0, 100) for _ in range(8)] == \
+        [b.corrupt_offset(0, 100) for _ in range(8)]
+    with pytest.raises(ValueError):
+        Fault("melt", "s2c")
+    with pytest.raises(ValueError):
+        Fault("corrupt", "sideways")
+    # a due fault the injection point cannot inject is skipped — neither
+    # consumed nor counted, so faults_* only ever report real injections
+    p2 = FaultPlan(seed=1).add("truncate", "s2c", first=1, every=1, limit=3)
+    assert p2.pick("s2c", supported=("stall", "drop")) is None
+    assert p2.stats()["faults_truncate"] == 0
+    assert p2.wants("truncate", "s2c")
+    assert p2.pick("s2c") is not None  # injectable point: fires + counts
+    assert p2.stats()["faults_truncate"] == 1
+
+
+def test_breaker_trial_mode_backoff_and_stats():
+    from tendermint_tpu.ops.gateway import CircuitBreaker
+
+    br = CircuitBreaker(threshold=2, base_backoff_s=0.05,
+                        max_backoff_s=0.2, probe=None, seed=3)
+    assert br.allow() and br.state == br.CLOSED
+    br.record_failure()
+    assert br.state == br.CLOSED  # below threshold
+    br.record_failure()
+    assert br.state == br.OPEN
+    assert not br.allow()  # probe not due yet
+    time.sleep(0.3)  # past max jittered window
+    assert br.allow()  # trial request admitted (half-open)
+    assert br.state == br.HALF_OPEN
+    br.record_failure()  # trial failed -> reopen, backoff doubled
+    assert br.state == br.OPEN
+    time.sleep(0.45)
+    assert br.allow()
+    br.record_success()  # trial passed -> closed
+    assert br.state == br.CLOSED
+    st = br.stats()
+    assert st["breaker_opens"] == 1 and st["breaker_closes"] == 1
+    assert st["breaker_probes"] == 2 and st["breaker_probe_failures"] == 1
+    assert st["breaker_fallback_s"] > 0
+    assert st["breaker_state"] == 0
+
+
+def test_writer_abandonment_counts_fault_and_closes_conn(monkeypatch):
+    """Satellite fix: a writer thread that outlives its reap budget is
+    counted (`writer_abandoned` in stream_* stats) and its connection
+    closed — never silently walked away from, never re-pooled."""
+    monkeypatch.setattr(devd, "WRITER_REAP_S", 0.05)
+    client = devd.DevdClient("/nonexistent/sock")
+    gate = threading.Event()
+    writer = threading.Thread(target=gate.wait, daemon=True)
+    writer.start()
+    closed = []
+
+    class Conn:
+        def shutdown(self, how):
+            closed.append("shutdown")
+
+        def close(self):
+            closed.append("close")
+
+    try:
+        assert client._reap_writer(writer, client._stream_stats, Conn())
+        assert client.stream_stats()["writer_abandoned"] == 1
+        # shutdown BEFORE close: close() alone never wakes the wedged
+        # sendall (the syscall pins the file description)
+        assert closed == ["shutdown", "close"]
+        # a promptly-exiting writer is NOT abandonment
+        gate.set()
+        assert not client._reap_writer(writer, client._stream_stats, Conn())
+        assert client.stream_stats()["writer_abandoned"] == 1
+    finally:
+        gate.set()
+
+
+# -- in-process injection -----------------------------------------------------
+
+
+def test_inprocess_faults_gateway_serves_correct_verdicts(chaos_env):
+    """Corrupt/drop/refuse faults on the production client path: every
+    batch still answers the correct verdicts (reconnect-once, breaker,
+    CPU re-verify), the plan's counters prove the schedule fired, and
+    the faults_* gauges surface through Verifier.stats()."""
+    from tendermint_tpu.ops import gateway
+
+    sup = DaemonSupervisor(chaos_env, SIM_ENV)
+    sup.start()
+    plan = FaultPlan(seed=11)
+    plan.add("corrupt", "c2s", first=3, every=7, limit=3)
+    plan.add("drop", "s2c", first=5, every=0, limit=1)
+    plan.add("refuse", "connect", first=2, every=0, limit=1)
+    try:
+        faults.install_client_faults(plan)
+        v = gateway.Verifier(min_tpu_batch=1)
+        items = _items(64)
+        for _ in range(12):
+            assert v.verify_batch(items) == [True] * 64
+        st = plan.stats()
+        assert st["faults_corrupt"] >= 1
+        assert st["faults_total"] >= 3, st
+        # visible alongside the stream_* gauges
+        vstats = v.stats()
+        assert vstats["faults_corrupt"] == st["faults_corrupt"]
+        assert {"breaker_state", "breaker_opens"} <= set(vstats)
+        # drive recovery: the breaker (if it opened) must re-close
+        # against the healthy daemon once the harness is uninstalled
+        faults.uninstall_client_faults(plan)
+        br = gateway.devd_breaker()
+        _wait_breaker_closed(
+            lambda: v.verify_batch(items), br
+        )
+        before = v.stats()["tpu_sigs"]
+        assert v.verify_batch(items) == [True] * 64
+        assert v.stats()["tpu_sigs"] == before + 64  # devd-routed again
+    finally:
+        faults.uninstall_client_faults(plan)
+        sup.stop()
+
+
+def test_stalled_daemon_hits_stream_budget_not_io_timeout(chaos_env,
+                                                          tmp_path,
+                                                          monkeypatch):
+    """Deadline budgets: a read starved on an active stream (daemon-side
+    stall, injected by the proxy holding every result frame for 5 s)
+    surfaces within the per-frame STREAM budget, not the flat 300 s io
+    timeout the resolver used to block on. A timeout is deliberately
+    not a reconnect (live-but-slow daemon — see DevdClient.request), so
+    it raises to the caller's fallback fast."""
+    monkeypatch.setenv("TENDERMINT_DEVD_STREAM_TIMEOUT_S", "0.5")
+    upstream = str(tmp_path / "real.sock")
+    sup = DaemonSupervisor(upstream, SIM_ENV)
+    sup.start()
+    plan = FaultPlan(seed=2)
+    plan.add("stall", "s2c", first=1, every=1, limit=1 << 30, stall_s=5.0)
+    proxy = FaultProxy(chaos_env, upstream, plan).start()
+    try:
+        client = devd.DevdClient(chaos_env)
+        assert client.stream_timeout == 0.5
+        t0 = time.monotonic()
+        with pytest.raises(Exception):
+            client.verify_stream(_items(32), chunk=8)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 8.0, f"stalled read took {elapsed:.1f}s to surface"
+        assert plan.stats()["faults_stall"] >= 1
+        client.close()
+    finally:
+        proxy.stop()
+        sup.stop()
+
+
+def test_proxy_skew_latches_single_shot_until_breaker_reset(chaos_env,
+                                                            tmp_path):
+    """Version skew: the proxy answers stream headers the way a
+    pre-streaming daemon would (pickle {"ok": False}); the backend
+    latches the single-shot path (verdicts stay correct) and the latch
+    re-arms through reset_stream_latches — the hook the breaker's
+    re-close fires, since a returned daemon may be a different build."""
+    import tendermint_tpu.ops.devd_backend as backend
+    from tendermint_tpu.ops import gateway
+
+    upstream = str(tmp_path / "real.sock")
+    sup = DaemonSupervisor(upstream, SIM_ENV)
+    sup.start()
+    plan = FaultPlan(seed=4)
+    plan.add("skew", "c2s", first=1, every=1, limit=1 << 30)
+    proxy = FaultProxy(chaos_env, upstream, plan).start()
+    try:
+        v = gateway.Verifier(min_tpu_batch=1)
+        items = _items(32)
+        assert v.verify_batch(items) == [True] * 32  # wide: tries stream
+        assert backend._stream_ok is False, "skew must latch single-shot"
+        assert plan.stats()["faults_skew"] >= 1
+        # latched but serving: still correct, still devd-routed
+        assert v.verify_batch(items) == [True] * 32
+        backend.reset_stream_latches()
+        assert backend._stream_ok and backend._hash_stream_ok
+    finally:
+        proxy.stop()
+        sup.stop()
+
+
+# -- out-of-process injection (real wire bytes) -------------------------------
+
+
+def test_proxy_faults_both_planes_parity_and_skew(chaos_env, tmp_path,
+                                                  monkeypatch):
+    """FaultProxy in front of a real daemon: chunk/digest frames relay
+    byte-for-byte and the schedule corrupts/truncates them on the wire.
+    The gateway's verdicts and digests stay byte-identical to CPU
+    throughout, and the plan counters prove the schedule fired."""
+    from tendermint_tpu.crypto.hashing import ripemd160
+    from tendermint_tpu.ops import gateway
+
+    upstream = str(tmp_path / "real.sock")
+    sup = DaemonSupervisor(upstream, SIM_ENV)
+    sup.start()
+    plan = FaultPlan(seed=5)
+    plan.add("corrupt", "s2c", first=4, every=6, limit=4)
+    plan.add("truncate", "c2s", first=9, every=0, limit=1)
+    proxy = FaultProxy(chaos_env, upstream, plan).start()
+    try:
+        devd.bust_avail_cache()
+        monkeypatch.setenv("TENDERMINT_TPU_HASHES", "1")
+        v = gateway.Verifier(min_tpu_batch=1)
+        h = gateway.Hasher(min_tpu_batch=1, use_tpu=True)
+        assert h._route == "devd"
+        items = _items(48)
+        parts = [bytes([i]) * 700 for i in range(24)]
+        want_digests = [ripemd160(p) for p in parts]
+        for _ in range(10):
+            assert v.verify_batch(items) == [True] * 48
+            assert h.part_leaf_hashes(parts) == want_digests
+        st = plan.stats()
+        assert st["faults_corrupt"] >= 2, st
+        assert st["faults_truncate"] >= 1, st
+        hs = h.stats()
+        assert hs["faults_corrupt"] == st["faults_corrupt"]
+    finally:
+        proxy.stop()
+        sup.stop()
+
+
+def test_proxy_blackout_opens_breaker_then_recovers(chaos_env, tmp_path):
+    """Daemon-death emulation via proxy blackout: connects refuse and
+    live conns drop for the window; the breaker opens, the CPU fallback
+    serves correct verdicts, and the end of the blackout re-closes it —
+    no daemon process was harmed (the shared-daemon chaos mode)."""
+    from tendermint_tpu.ops import gateway
+
+    upstream = str(tmp_path / "real.sock")
+    sup = DaemonSupervisor(upstream, SIM_ENV)
+    sup.start()
+    proxy = FaultProxy(chaos_env, upstream).start()
+    try:
+        devd.bust_avail_cache()
+        v = gateway.Verifier(min_tpu_batch=1)
+        items = _items(32)
+        assert v.verify_batch(items) == [True] * 32
+        proxy.blackout(0.6)
+        br = gateway.devd_breaker()
+        deadline = time.monotonic() + 5.0
+        while br.state != br.OPEN and time.monotonic() < deadline:
+            assert v.verify_batch(items) == [True] * 32
+        assert br.state == br.OPEN
+        assert proxy.plan.stats()["faults_kill"] == 1
+        time.sleep(0.7)  # blackout over
+        _wait_breaker_closed(lambda: v.verify_batch(items), br)
+        before = v.stats()["tpu_sigs"]
+        assert v.verify_batch(items) == [True] * 32
+        assert v.stats()["tpu_sigs"] == before + 32
+    finally:
+        proxy.stop()
+        sup.stop()
+
+
+# -- mempool sig gate: exactly-once across daemon death -----------------------
+
+
+def test_sigbatcher_exactly_once_across_daemon_death(chaos_env):
+    """Satellite coverage: the daemon dying between the gate's 2
+    in-flight chunks must not drop or double-deliver a single tx
+    verdict. Every accepted submission is delivered exactly once; valid
+    signatures are never reported invalid (fallback re-verifies; the
+    gate fails open only on total verifier loss)."""
+    from tendermint_tpu.mempool.mempool import SigBatcher
+    from tendermint_tpu.ops import gateway
+
+    sup = DaemonSupervisor(chaos_env, SIM_ENV)
+    sup.start()
+    delivered: list = []
+    dmtx = threading.Lock()
+
+    def on_results(results):
+        with dmtx:
+            delivered.extend(results)
+
+    v = gateway.Verifier(min_tpu_batch=1)
+    sb = SigBatcher(v, parse=lambda tx: tx, max_batch=64,
+                    max_wait_s=0.001, on_results=on_results, max_inflight=2)
+    items = _items(512, tag=b"gate")
+    try:
+        accepted = []
+        for i, it in enumerate(items):
+            if sb.submit(it, i):
+                accepted.append(i)
+            if i == 128:
+                sup.kill()  # mid-burst, chunks in flight
+            elif i == 320:
+                sup.restart()
+            if i % 64 == 0:
+                time.sleep(0.01)  # let batches go in-flight mid-churn
+    finally:
+        sb.stop()
+        sb._thread.join(timeout=30.0)
+        sup.stop()
+    assert not sb._thread.is_alive()
+    with dmtx:
+        got = sorted(ctx for ctx, _ok in delivered)
+        oks = {ctx: ok for ctx, ok in delivered}
+    assert got == accepted, "dropped or duplicated tx verdicts"
+    assert sb.delivered == len(accepted)
+    # all submissions were validly signed: none may be reported invalid
+    assert all(oks.values())
+
+
+# -- consensus liveness under churn -------------------------------------------
+
+
+def _run_consensus_run(n_blocks: int, txs: list[bytes], hasher=None,
+                       budget_s: float = 20.0, during=None, until=None):
+    """Commit `n_blocks` on a single-validator KVStore chain, feeding
+    txs strictly sequentially (tx k+1 enters the pool only after tx k
+    committed, so the committed ORDER is deterministic across runs).
+    Returns (new-block event list, consensus state). `during(height_events)`
+    runs once after start (chaos hookup)."""
+    import tendermint_tpu.types.events as tev
+    from consensus_common import EventCollector, make_cs_and_stubs
+    from tendermint_tpu.abci.apps.kvstore import KVStoreApp
+
+    cs, _stubs, _ = make_cs_and_stubs(1, app=KVStoreApp())
+    if hasher is not None:
+        cs.part_hasher = hasher
+    blocks = EventCollector(cs.evsw, tev.EVENT_NEW_BLOCK)
+    cs.start()
+    try:
+        if during is not None:
+            during(blocks)
+        next_tx = 0
+        if txs:
+            cs.mempool.check_tx(txs[0])
+            next_tx = 1
+        deadline = time.monotonic() + budget_s + 1.5 * n_blocks
+        while True:
+            events = list(blocks.items)
+            # done: enough blocks AND every tx landed AND one block
+            # after the last tx's block (so its app-hash effect is
+            # bound into a committed header — app_hash lags one height)
+            if len(events) >= n_blocks and next_tx == len(txs) and (
+                not txs or _fingerprint_ready(events, txs)
+            ) and (until is None or until()):
+                return events, cs
+            assert time.monotonic() < deadline, (
+                f"liveness lost: {len(events)} blocks, tx {next_tx}/"
+                f"{len(txs)} (height_seconds_max="
+                f"{cs.height_seconds_max:.2f})"
+            )
+            if next_tx < len(txs):
+                landed = {t for d in events for t in d.block.data.txs}
+                if txs[next_tx - 1] in landed:
+                    cs.mempool.check_tx(txs[next_tx])
+                    next_tx += 1
+            time.sleep(0.02)
+    finally:
+        cs.stop()
+
+
+def _last_tx_height(block_events, txs) -> int | None:
+    for d in block_events:
+        if txs[-1] in d.block.data.txs:
+            return d.block.header.height
+    return None
+
+
+def _fingerprint_ready(block_events, txs) -> bool:
+    h = _last_tx_height(block_events, txs)
+    return h is not None and any(
+        d.block.header.height == h + 1 for d in block_events
+    )
+
+
+def _committed_fingerprint(block_events, txs):
+    """(ordered committed txs, app hash with every tx applied) — the
+    deterministic commit fingerprint two runs of the same sequential tx
+    schedule must share. header.app_hash lags one height, so the
+    post-all-txs hash is read from the block AFTER the one carrying the
+    last tx (heights may differ across runs; the hash may not)."""
+    committed = [t for d in block_events for t in d.block.data.txs]
+    if not txs:
+        return committed, b""
+    h = _last_tx_height(block_events, txs)
+    post = next(
+        d.block.header.app_hash for d in block_events
+        if d.block.header.height == h + 1
+    )
+    return committed, post
+
+
+def _assert_partset_parity(cs, block_events) -> int:
+    """Every committed block's part-set root (produced by the devd-routed
+    hasher, possibly under faults) must equal a pure-CPU recompute —
+    the 'zero digests wrong' assertion. Returns blocks checked."""
+    checked = 0
+    for d in block_events:
+        blk = d.block
+        meta = cs.block_store.load_block_meta(blk.header.height)
+        if meta is None:
+            continue
+        cpu = blk.make_part_set(65536).header()
+        assert meta.block_id.parts_header == cpu, (
+            f"height {blk.header.height}: part-set root diverged"
+        )
+        checked += 1
+    return checked
+
+
+def _chaos_hasher(sock: str):
+    from tendermint_tpu.ops import gateway
+
+    devd.bust_avail_cache()
+    h = gateway.Hasher(min_tpu_batch=1, use_tpu=True)
+    assert h._route == "devd", "hasher must ride the daemon for the soak"
+    return h
+
+
+def test_consensus_commits_through_daemon_churn(chaos_env):
+    """Fast tier-1 chaos subset: a single-validator chain keeps
+    committing while the daemon serving its part-set hash plane is
+    SIGKILLed and restarted; the commit fingerprint matches a fault-free
+    run, part-set roots recompute byte-identically on CPU, and the
+    breaker re-closes with devd routing restored."""
+    from tendermint_tpu.ops import gateway
+
+    n_blocks, txs = 6, [b"k%d=v%d" % (i, i) for i in range(4)]
+    sup = DaemonSupervisor(chaos_env, SIM_ENV, plan=FaultPlan(seed=3))
+    sup.start()
+    try:
+        # fault-free reference run first (daemon healthy throughout)
+        ref_blocks, ref_cs = _run_consensus_run(
+            n_blocks, txs, hasher=_chaos_hasher(chaos_env)
+        )
+        ref_print = _committed_fingerprint(ref_blocks, txs)
+        assert ref_print[0] == txs, "reference run must commit every tx"
+
+        # chaos run: kill/restart churn while committing
+        hasher = _chaos_hasher(chaos_env)
+
+        def start_churn(_blocks):
+            sup.churn(down_s=0.5, up_s=1.0, cycles=2)
+
+        chaos_blocks, chaos_cs = _run_consensus_run(
+            n_blocks, txs, hasher=hasher, during=start_churn,
+        )
+        sup.stop_churn(ensure_up=True)
+        assert sup.kills >= 1 and sup.plan.stats()["faults_kill"] >= 1
+        assert _committed_fingerprint(chaos_blocks, txs) == ref_print
+        assert _assert_partset_parity(chaos_cs, chaos_blocks) >= n_blocks - 1
+        # liveness: no height stalled past its budget
+        assert chaos_cs.height_seconds_max < 10.0, chaos_cs.height_seconds_max
+        # recovery: breaker closed against the healthy daemon, and the
+        # hash plane demonstrably routes devd again
+        br = gateway.devd_breaker()
+        parts = [bytes([i]) * 512 for i in range(16)]
+        _wait_breaker_closed(lambda: hasher.part_leaf_hashes(parts), br)
+        before = hasher.stats()["tpu_part_batches"]
+        hasher.part_leaf_hashes(parts)
+        assert hasher.stats()["tpu_part_batches"] == before + 1
+    finally:
+        sup.stop()
+
+
+@pytest.mark.slow
+def test_chaos_soak_20_blocks_with_corruption(chaos_env, tmp_path):
+    """The acceptance soak: >= 20 blocks commit while a seeded schedule
+    SIGKILLs/restarts the daemon AND corrupts wire frames through the
+    FaultProxy, with a concurrent streamed verify load sharing the same
+    breaker. Asserts: commit fingerprint byte-identical to a fault-free
+    run, per-block part-set roots CPU-identical, zero wrong verify
+    verdicts, no height past its timeout budget, breaker re-closed with
+    devd routing restored, and the fault counters prove the schedule
+    actually fired."""
+    from tendermint_tpu.ops import gateway
+
+    n_blocks, txs = 22, [b"s%d=w%d" % (i, i) for i in range(12)]
+    upstream = str(tmp_path / "real.sock")
+    plan = FaultPlan(seed=17)
+    plan.add("corrupt", "s2c", first=6, every=9, limit=1 << 30)
+    plan.add("corrupt", "c2s", first=11, every=13, limit=1 << 30)
+    sup = DaemonSupervisor(upstream, SIM_ENV, plan=plan)
+    sup.start()
+    proxy = FaultProxy(chaos_env, upstream, plan).start()
+    try:
+        ref_blocks, _ref_cs = _run_consensus_run(
+            n_blocks, txs, hasher=_chaos_hasher(chaos_env), budget_s=40.0
+        )
+        ref_print = _committed_fingerprint(ref_blocks, txs)
+        assert ref_print[0] == txs
+
+        hasher = _chaos_hasher(chaos_env)
+        v = gateway.Verifier(min_tpu_batch=1)
+        load_stop = threading.Event()
+        wrong = []
+
+        def verify_load():
+            items = _items(96, tag=b"soak")
+            while not load_stop.is_set():
+                try:
+                    if v.verify_batch(items) != [True] * 96:
+                        wrong.append("wrong verdicts")
+                        return
+                except Exception as exc:  # noqa: BLE001 — must not happen:
+                    # the gateway's contract is fallback, never raise
+                    wrong.append(f"verify raised: {exc}")
+                    return
+                time.sleep(0.05)
+
+        load = threading.Thread(target=verify_load, daemon=True)
+        load.start()
+
+        def start_churn(_blocks):
+            sup.churn(down_s=0.6, up_s=1.6, cycles=4)
+
+        # keep committing past n_blocks until the kill schedule really
+        # ran (a fast chain otherwise outruns the churn and the
+        # faults_kill assertion goes timing-dependent)
+        chaos_blocks, chaos_cs = _run_consensus_run(
+            n_blocks, txs, hasher=hasher, during=start_churn, budget_s=60.0,
+            until=lambda: sup.kills >= 3,
+        )
+        sup.stop_churn(ensure_up=True)
+        load_stop.set()
+        load.join(timeout=30.0)
+
+        assert not wrong, wrong
+        assert _committed_fingerprint(chaos_blocks, txs) == ref_print
+        assert _assert_partset_parity(chaos_cs, chaos_blocks) >= n_blocks - 1
+        assert chaos_cs.height_seconds_max < 15.0, chaos_cs.height_seconds_max
+        st = plan.stats()
+        assert st["faults_kill"] >= 3, st      # churn really killed it
+        assert st["faults_corrupt"] >= 2, st   # frames really corrupted
+        br = gateway.devd_breaker()
+        assert br.stats()["breaker_opens"] >= 1  # degradation was real
+        parts = [bytes([i % 251]) * 600 for i in range(20)]
+        _wait_breaker_closed(lambda: hasher.part_leaf_hashes(parts), br,
+                             deadline_s=20.0)
+        # routing restored: devd-routed batches flow again on BOTH
+        # planes within a bounded window. Retry-loop, not next-batch:
+        # the proxy's corruption schedule never stops, so any single
+        # batch may legitimately eat a fault and take the CPU fallback
+        # for that batch — recovery means the plane keeps coming back
+        deadline = time.monotonic() + 20.0
+        before = hasher.stats()["tpu_part_batches"]
+        while hasher.stats()["tpu_part_batches"] == before:
+            assert time.monotonic() < deadline, "hash plane never re-routed"
+            hasher.part_leaf_hashes(parts)
+        vbefore = v.stats()["tpu_sigs"]
+        while v.stats()["tpu_sigs"] == vbefore:
+            assert time.monotonic() < deadline, "verify plane never re-routed"
+            assert v.verify_batch(_items(16)) == [True] * 16
+    finally:
+        proxy.stop()
+        sup.stop()
+
+
+def test_labeled_reconnect_counters_split_paths(chaos_env):
+    """Satellite: the two reconnect paths count separately —
+    `reconnects_connect` (stale pooled socket found at first use) vs
+    `reconnects_midstream` (died under an active exchange) — and the
+    total stays backward-compatible."""
+    sup = DaemonSupervisor(chaos_env, SIM_ENV)
+    sup.start()
+    client = devd.DevdClient(chaos_env)
+    items = _items(32)
+    try:
+        assert all(client.verify_stream(items, chunk=8))
+        sup.restart()  # pool now full of dead sockets
+        assert all(client.verify_stream(items, chunk=8))
+        st = client.stream_stats()
+        assert st["reconnects"] >= 1
+        assert st["reconnects"] == (
+            st["reconnects_connect"] + st["reconnects_midstream"]
+        )
+        assert st["writer_abandoned"] == 0
+    finally:
+        client.close()
+        sup.stop()

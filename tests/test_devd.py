@@ -138,52 +138,110 @@ class _DeadClient:
         raise ConnectionError("daemon transport died")
 
 
-def test_transport_failure_with_live_daemon_latches_cpu(daemon, monkeypatch):
-    """Requests failing while the daemon still serves: keep devd for a
-    bounded retry window, then latch CPU — never dial the chip the live
-    daemon exclusively holds."""
+def test_transport_failure_with_live_daemon_opens_breaker(daemon, monkeypatch):
+    """Requests failing while the daemon still serves: after the breaker
+    threshold (3 consecutive failures) the shared breaker OPENS and
+    batches ride the CPU fallback — never an in-process dial at the chip
+    the live daemon exclusively holds, and (round 8) never the old
+    permanent CPU latch: once the transport heals, a half-open probe
+    re-closes the breaker and devd routing resumes."""
     sock, _ = daemon
     monkeypatch.setenv("TENDERMINT_DEVD_SOCK", sock)
     monkeypatch.delenv("TENDERMINT_TPU_KERNEL", raising=False)
+    monkeypatch.setenv("TENDERMINT_TPU_BREAKER_BACKOFF_S", "0.05")
+    monkeypatch.setenv("TENDERMINT_TPU_BREAKER_BACKOFF_CAP_S", "0.2")
     devd.bust_avail_cache()
     import tendermint_tpu.ops.devd_backend as backend
     from tendermint_tpu.ops import gateway
 
-    v = gateway.Verifier(min_tpu_batch=1)
-    assert v._kernel == "devd"
-    monkeypatch.setattr(backend, "_client", _DeadClient())
-    items = _items(4, tag=b"demote")
-    items[1] = (items[1][0], items[1][1], b"\x99" * 64)
-    # correct results throughout (retries then the CPU fallback)
-    assert v.verify_batch(items) == [True, False, True, True]
-    assert v._kernel == "devd"  # never stole the daemon's device
-    assert not v._tpu_ok  # persistent transport failure -> CPU latch
-    resolve = v.verify_batch_async(items)
-    assert resolve() == [True, False, True, True]
+    gateway.reset_devd_breaker()
+    try:
+        v = gateway.Verifier(min_tpu_batch=1)
+        assert v._kernel == "devd"
+        monkeypatch.setattr(backend, "_client", _DeadClient())
+        items = _items(4, tag=b"demote")
+        items[1] = (items[1][0], items[1][1], b"\x99" * 64)
+        # correct results throughout (retries then the CPU fallback)
+        assert v.verify_batch(items) == [True, False, True, True]
+        assert v._kernel == "devd"  # never stole the daemon's device
+        assert v._tpu_ok  # NOT latched: the breaker owns the fallback
+        br = gateway.devd_breaker()
+        assert br.state == br.OPEN
+        resolve = v.verify_batch_async(items)
+        assert resolve() == [True, False, True, True]
+
+        # transport heals (the daemon was serving all along): the next
+        # due probe re-closes the breaker and devd routing resumes
+        backend._client = None  # next _get_client dials the real daemon
+        deadline = time.time() + 5.0
+        while br.state != br.CLOSED and time.time() < deadline:
+            time.sleep(0.05)
+            assert v.verify_batch(items) == [True, False, True, True]
+        assert br.state == br.CLOSED
+        before = v.stats()["tpu_sigs"]
+        assert v.verify_batch(items) == [True, False, True, True]
+        assert v.stats()["tpu_sigs"] == before + 4  # devd-routed again
+    finally:
+        gateway.reset_devd_breaker()
 
 
-def test_daemon_death_demotes_to_direct_kernel(daemon, monkeypatch):
-    """The daemon actually gone: demote devd -> direct platform kernel
-    (f32 on this CPU host), not a permanent CPU latch."""
+def test_daemon_death_opens_breaker_and_recovery_restores_devd(
+        daemon, monkeypatch):
+    """The daemon actually gone: the breaker opens (probes fail), every
+    batch verifies correctly on the CPU fallback, and when the daemon
+    returns a probe re-closes the breaker — devd routing restored with
+    no process restart. (Round 8 replaces the old one-way devd ->
+    direct-kernel demotion: re-dialing the chip in-process raced the
+    daemon's own re-claim, the exact one-owner violation devd exists to
+    prevent.)"""
     sock, _ = daemon
     monkeypatch.setenv("TENDERMINT_DEVD_SOCK", sock)
     monkeypatch.delenv("TENDERMINT_TPU_KERNEL", raising=False)
+    monkeypatch.setenv("TENDERMINT_TPU_BREAKER_BACKOFF_S", "0.05")
+    monkeypatch.setenv("TENDERMINT_TPU_BREAKER_BACKOFF_CAP_S", "0.2")
     devd.bust_avail_cache()
     import tendermint_tpu.ops.devd_backend as backend
     from tendermint_tpu.ops import gateway
 
-    v = gateway.Verifier(min_tpu_batch=1)
-    assert v._kernel == "devd"
-    # simulate death: transport raises AND the fresh re-ping finds nothing
-    monkeypatch.setattr(backend, "_client", _DeadClient())
-    monkeypatch.setattr(devd, "available", lambda *a, **k: None)
-    items = _items(4, tag=b"demote2")
-    items[2] = (items[2][0], items[2][1], b"\x77" * 64)
-    assert v.verify_batch(items) == [True, True, False, True]
-    assert v._kernel == "f32", v._kernel  # direct, not CPU-latched
-    assert v._tpu_ok
-    resolve = v.verify_batch_async(items)
-    assert resolve() == [True, True, False, True]
+    gateway.reset_devd_breaker()
+    real_available = devd.available
+    try:
+        v = gateway.Verifier(min_tpu_batch=1)
+        assert v._kernel == "devd"
+        # simulate death: transport raises AND the fresh re-ping (the
+        # breaker's probe) finds nothing
+        monkeypatch.setattr(backend, "_client", _DeadClient())
+        monkeypatch.setattr(devd, "available", lambda *a, **k: None)
+        items = _items(4, tag=b"demote2")
+        items[2] = (items[2][0], items[2][1], b"\x77" * 64)
+        assert v.verify_batch(items) == [True, True, False, True]
+        assert v._kernel == "devd", v._kernel  # no direct-kernel steal
+        assert v._tpu_ok
+        br = gateway.devd_breaker()
+        assert br.state == br.OPEN
+        # while dead, probes keep failing and the fallback keeps serving
+        time.sleep(0.1)
+        assert v.verify_batch(items) == [True, True, False, True]
+        assert br.state == br.OPEN
+        assert br.stats()["breaker_probe_failures"] >= 1
+
+        # daemon comes back: probe succeeds, breaker closes, devd routes
+        monkeypatch.setattr(devd, "available", real_available)
+        backend._client = None
+        devd.bust_avail_cache()
+        deadline = time.time() + 5.0
+        while br.state != br.CLOSED and time.time() < deadline:
+            time.sleep(0.05)
+            assert v.verify_batch(items) == [True, True, False, True]
+        assert br.state == br.CLOSED
+        before = v.stats()["tpu_sigs"]
+        assert v.verify_batch(items) == [True, True, False, True]
+        assert v.stats()["tpu_sigs"] == before + 4
+        st = v.stats()
+        assert st["breaker_opens"] >= 1 and st["breaker_closes"] >= 1
+        assert st["breaker_fallback_s"] > 0
+    finally:
+        gateway.reset_devd_breaker()
 
 
 def test_fast_sync_rides_the_daemon(daemon, monkeypatch):
