@@ -24,7 +24,7 @@ test:
 # hold (dots no worse than the seed) — plus the chip-free hash-stream
 # smoke (the two asserted BENCH_r07 rows: streamed hash offload >= 1.3x
 # single-shot on the sim transport, flat host builder >= 1.5x recursive).
-tier1: hash-stream-smoke chaos-smoke
+tier1: hash-stream-smoke chaos-smoke wal-torture-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Chip-free bench smoke: every BASELINE config on the pinned CPU backend,
@@ -48,6 +48,15 @@ hash-stream-smoke:
 chaos-smoke:
 	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_CHAOS_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_chaos.py
 
+# WAL torture smoke, chip-free BY CONSTRUCTION (~10 s): bench_wal.py's
+# reduced pass — group-commit >= 1.3x fsync-per-record floor, repair scan
+# on a torn 10k-record log, and a byte-offset truncation sweep over the
+# tail records, every offset recovering (the full crash-model tiers live
+# in tests/test_wal_repair.py + tests/test_wal_torture.py, incl. the
+# slow-marked subprocess sweep). Runs as part of `make tier1`.
+wal-torture-smoke:
+	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_WAL_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_wal.py
+
 test_race:
 	$(PY) -m pytest tests/test_race.py -q
 
@@ -60,4 +69,4 @@ test_slow:
 native:
 	$(MAKE) -C native
 
-.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke
+.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke

@@ -1,0 +1,266 @@
+"""WAL durability bench (round 9): what group commit buys the consensus
+commit hot path, and what repair costs recovery. Writes BENCH_r09.json.
+
+Every consensus input used to pay a synchronous fsync before it was
+handled (consensus/wal.go:73-95 semantics); round 9's v2 WAL batches the
+fsync behind a bounded flush interval and only forces it on #ENDHEIGHT
+(docs/crash-recovery.md). This bench measures the two modes on identical
+record streams, plus the repair/recovery scan on a 10k-record WAL with a
+deliberately torn+garbaged tail, and runs a mini torture sweep (truncate
+at every byte offset of the final records, reopen, verify the clean
+prefix) so `make wal-torture-smoke` gates the repair logic chip-free.
+
+Rows:
+- fsync_per_record: sync_every_write=True save() throughput + p50 latency
+- group_commit:     default mode save() throughput, fsync count, group size
+- recovery_scan:    WAL open (repair pass) + #ENDHEIGHT search on a
+                    10k-record log whose tail is torn and garbaged
+- torture_smoke:    byte-offset sweep over the tail records, all recovered
+
+Asserted floor (gates `make wal-torture-smoke` in tier1): group commit
+>= 1.3x fsync-per-record msgs/s (measured 10-100x on real disks — fsync
+here costs ~3 ms) and every torture offset recovers. The ratio floor only
+gates when fsync measurably costs something (p50 >= 100 us) — on a
+filesystem where fsync is free (tmpfs checkout, eatmydata CI, fsync=off
+VMs) both modes collapse to buffered-write speed and the ratio says
+nothing about the code, so it is reported but not asserted; the repair
+and torture rows assert unconditionally.
+
+These numbers are chip-free BY CONSTRUCTION — the WAL is a host-plane
+component; no device, daemon, or jax backend is involved, so no
+live-chip re-record is ever owed (ROADMAP ledger).
+
+BENCH_WAL_SMOKE=1 shrinks the record counts for the tier-1 gate.
+Prints ONE JSON line like the other benches.
+Run from the repo root: python benches/bench_wal.py
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+SMOKE = os.environ.get("BENCH_WAL_SMOKE", "") == "1"
+N_SYNC = int(os.environ.get("BENCH_WAL_SYNC_RECORDS", "150" if SMOKE else "400"))
+N_GROUP = int(os.environ.get("BENCH_WAL_GROUP_RECORDS", "4000" if SMOKE else "10000"))
+N_SCAN = int(os.environ.get("BENCH_WAL_SCAN_RECORDS", "4000" if SMOKE else "10000"))
+N_TORTURE_RECORDS = 3  # tail records swept byte-by-byte
+MIN_RATIO = float(os.environ.get("BENCH_WAL_MIN_RATIO", "1.3"))
+# below this measured fsync p50 the filesystem is effectively sync-free
+# and the group-vs-per-record ratio is meaningless (see module docstring)
+FSYNC_FLOOR_US = float(os.environ.get("BENCH_WAL_FSYNC_FLOOR_US", "100"))
+
+
+def _fsync_p50_us(dirpath: str, n: int = 25) -> float:
+    """Median latency of a 1-byte append + fsync on this filesystem."""
+    probe = os.path.join(dirpath, "fsync-probe")
+    lat = []
+    with open(probe, "wb") as f:
+        for _ in range(n):
+            f.write(b"x")
+            f.flush()
+            t0 = time.perf_counter()
+            os.fsync(f.fileno())
+            lat.append(time.perf_counter() - t0)
+    os.unlink(probe)
+    return statistics.median(lat) * 1e6
+
+
+def _record(i: int) -> dict:
+    # a realistic consensus input record (timeout-shaped, ~120 B framed)
+    return {
+        "type": "timeout",
+        "timeout": {"duration": 0.05, "height": i, "round": 0, "step": 3},
+    }
+
+
+def _run_writer(dirpath: str, n: int, sync_every: bool) -> dict:
+    from tendermint_tpu.consensus.wal import WAL, WALMessage  # noqa: F401
+
+    path = os.path.join(dirpath, "wal")
+    w = WAL(path, sync_every_write=sync_every, flush_interval_s=0.05)
+    w.start()
+    lat = []
+    t0 = time.perf_counter()
+    for i in range(n):
+        t1 = time.perf_counter()
+        w.save(_record(i))
+        lat.append(time.perf_counter() - t1)
+    # one ENDHEIGHT close the way a commit would, so the group-commit row
+    # includes its durability point
+    w.write_end_height(1)
+    elapsed = time.perf_counter() - t0
+    stats = w.stats()
+    w.stop()
+    return {
+        "records": n,
+        "msgs_per_sec": round((n + 1) / elapsed, 1),
+        "save_p50_us": round(statistics.median(lat) * 1e6, 1),
+        "fsyncs": stats["fsyncs"],
+        "group_size_avg": stats["group_size_avg"],
+    }
+
+
+def _build_big_wal(dirpath: str, n: int) -> str:
+    from tendermint_tpu.consensus.wal import WAL, MAGIC  # noqa: F401
+
+    path = os.path.join(dirpath, "wal")
+    w = WAL(path, flush_interval_s=10.0)
+    w.start()
+    for i in range(n):
+        w.save(_record(i))
+        if i % 500 == 499:
+            w.write_end_height(i // 500 + 1)
+    w.stop()
+    return path
+
+
+def main() -> None:
+    # bench on the repo filesystem: /tmp may be tmpfs-ish where fsync is
+    # free and the per-record row would understate the real gap. A SIGTERM
+    # (the Makefile's `timeout`) skips the finally, so sweep strays from
+    # earlier runs first — they are gitignored but still clutter.
+    for stale in glob.glob(os.path.join(ROOT, "bench-wal-*")):
+        shutil.rmtree(stale, ignore_errors=True)
+    workdir = tempfile.mkdtemp(prefix="bench-wal-", dir=ROOT)
+    rows = []
+    try:
+        fsync_p50_us = round(_fsync_p50_us(workdir), 1)
+        d1 = os.path.join(workdir, "sync")
+        os.makedirs(d1)
+        per_record = _run_writer(d1, N_SYNC, sync_every=True)
+        rows.append({"mode": "fsync_per_record", **per_record})
+
+        d2 = os.path.join(workdir, "group")
+        os.makedirs(d2)
+        group = _run_writer(d2, N_GROUP, sync_every=False)
+        ratio = group["msgs_per_sec"] / per_record["msgs_per_sec"]
+        rows.append({
+            "mode": "group_commit",
+            **group,
+            "vs_fsync_per_record": round(ratio, 2),
+        })
+
+        # recovery scan: 10k records, tail torn mid-frame + garbage suffix
+        d3 = os.path.join(workdir, "scan")
+        os.makedirs(d3)
+        path = _build_big_wal(d3, N_SCAN)
+        with open(path, "r+b") as f:
+            f.seek(0, 2)
+            f.truncate(f.tell() - 7)  # torn final frame
+        with open(path, "ab") as f:
+            f.write(b"\x00" * 33 + b"\xf3garbage")
+        from tendermint_tpu.consensus.wal import WAL
+
+        t0 = time.perf_counter()
+        w = WAL(path)
+        repair_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        lines = w.lines_after_height(N_SCAN // 500 - 1)
+        scan_s = time.perf_counter() - t0
+        s = w.stats()
+        w.group.close()
+        assert s["repairs"] == 1 and lines, "scan WAL failed to repair"
+        wal_bytes = sum(
+            os.path.getsize(p) for p in glob.glob(path + "*")
+        )
+        rows.append({
+            "mode": "recovery_scan",
+            "records": N_SCAN,
+            "wal_mb": round(wal_bytes / 1e6, 2),
+            "repair_open_ms": round(repair_s * 1e3, 2),
+            "endheight_search_ms": round(scan_s * 1e3, 2),
+            "truncated_bytes": s["truncated_bytes"],
+        })
+
+        # torture smoke: every byte offset of the final records
+        d4 = os.path.join(workdir, "torture")
+        os.makedirs(d4)
+        tpath = _build_big_wal(d4, 12)
+        with open(tpath, "rb") as f:
+            raw = f.read()
+        from tendermint_tpu.consensus.wal import scan_frames
+
+        payloads, bad = scan_frames(raw)
+        assert bad is None
+        tail_start = len(raw) - sum(
+            8 + len(p) for p in payloads[-N_TORTURE_RECORDS:]
+        )
+        swept = 0
+        for cut in range(tail_start, len(raw) + 1):
+            case = os.path.join(d4, f"c{cut}", "wal")
+            os.makedirs(os.path.dirname(case))
+            with open(case, "wb") as f:
+                f.write(raw[:cut])
+            w = WAL(case)
+            expect, _ = scan_frames(raw[:cut])
+            got = w.read_all_lines()
+            w.group.close()
+            assert got == [b.decode() for b in expect], f"offset {cut}"
+            swept += 1
+        rows.append({
+            "mode": "torture_smoke",
+            "offsets_swept": swept,
+            "all_recovered": True,
+        })
+
+        if fsync_p50_us >= FSYNC_FLOOR_US:
+            assert ratio >= MIN_RATIO, (
+                f"group commit {ratio:.2f}x fsync-per-record is under the "
+                f"{MIN_RATIO}x floor (fsync p50 {fsync_p50_us} us)"
+            )
+        else:
+            print(
+                f"# fsync p50 {fsync_p50_us} us < {FSYNC_FLOOR_US} us floor: "
+                "sync-free filesystem, ratio reported but not asserted",
+                file=sys.stderr,
+            )
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    record = {
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "metric": "WAL group commit vs fsync-per-record + repair/recovery scan",
+        "min_ratio_asserted": MIN_RATIO,
+        "fsync_p50_us": fsync_p50_us,
+        "ratio_gated": fsync_p50_us >= FSYNC_FLOOR_US,
+        "smoke": SMOKE,
+        "rows": rows,
+        "note": (
+            "host-plane only: chip-free BY CONSTRUCTION (no device/daemon/"
+            "jax involved), no live-chip re-record owed; repo-fs fsync "
+            "~3 ms dominates the per-record row"
+        ),
+    }
+    if not SMOKE:
+        # bench_partset's convention: the tier-1 smoke gate asserts but
+        # never writes — otherwise every `make tier1` would clobber the
+        # recorded full-run artifact with reduced smoke numbers
+        with open(os.path.join(ROOT, "BENCH_r09.json"), "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+
+    print(json.dumps({
+        "metric": "wal_group_commit_vs_fsync_per_record",
+        "value": rows[1]["vs_fsync_per_record"],
+        "unit": "x",
+        "group_msgs_per_sec": rows[1]["msgs_per_sec"],
+        "fsync_msgs_per_sec": rows[0]["msgs_per_sec"],
+        "repair_open_ms": rows[2]["repair_open_ms"],
+        "torture_offsets": rows[3]["offsets_swept"],
+        "platform": "host",
+        "smoke": SMOKE,
+    }))
+
+
+if __name__ == "__main__":
+    main()

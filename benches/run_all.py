@@ -16,6 +16,10 @@ Configs (BASELINE.md):
                  kill/restart + degraded-mode (breaker-open CPU
                  fallback) throughput delta (writes BENCH_r08.json;
                  chip-free, asserts the recovery floor)
+  8 wal        — host durability plane: group-commit vs fsync-per-record
+                 WAL throughput, repair/recovery scan on a torn 10k-record
+                 log, byte-offset torture smoke (writes BENCH_r09.json;
+                 chip-free BY CONSTRUCTION, asserts the >=1.3x floor)
 
 Each bench is its own process (the TPU is exclusive per process).
 Usage: python benches/run_all.py [--skip testnet,...]
@@ -39,6 +43,7 @@ BENCHES = {
     "5_mempool": [sys.executable, "benches/bench_mempool.py"],
     "6_devd_stream": [sys.executable, "benches/bench_devd_stream.py"],
     "7_chaos": [sys.executable, "benches/bench_chaos.py"],
+    "8_wal": [sys.executable, "benches/bench_wal.py"],
 }
 
 

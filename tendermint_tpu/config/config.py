@@ -113,6 +113,13 @@ class ConsensusConfig:
     root_dir: str = ""
     wal_path: str = "data/cs.wal/wal"
     wal_light: bool = False
+    # group-commit durability window (round 9, docs/crash-recovery.md):
+    # non-ENDHEIGHT records are fsynced at most this many seconds after
+    # they buffer; #ENDHEIGHT markers always fsync synchronously
+    wal_flush_interval_s: float = 0.1
+    # True restores the pre-round-9 fsync-per-record bound (10-40x slower
+    # commit hot path; benches/bench_wal.py measures the gap)
+    wal_sync_every_write: bool = False
 
     timeout_propose: float = 3.0
     timeout_propose_delta: float = 0.5

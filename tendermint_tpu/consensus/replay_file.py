@@ -49,8 +49,12 @@ def run_replay_file(cfg, console: bool = False) -> int:
     """Feed the node's WAL through a fresh consensus state; returns the
     number of replayed messages."""
     wal_file = cfg.consensus.wal_file()
-    with open(wal_file) as f:
-        lines = f.read().splitlines()
+    # format-aware READ-ONLY view (v2 CRC frames or legacy JSON lines):
+    # an operator tool must never run the mutating repair pass against
+    # the home it inspects — a damaged frame just ends the prefix here
+    from tendermint_tpu.consensus.wal import read_wal_lines
+
+    lines = read_wal_lines(wal_file)
 
     cs = new_consensus_state_for_replay(cfg)
     cs.replay_mode = True

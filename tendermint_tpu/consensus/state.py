@@ -239,7 +239,12 @@ class ConsensusState(BaseService):
             self.wal.stop()
 
     def open_wal(self, wal_file: str) -> None:
-        wal = WAL(wal_file, light=self.config.wal_light)
+        wal = WAL(
+            wal_file,
+            light=self.config.wal_light,
+            flush_interval_s=self.config.wal_flush_interval_s,
+            sync_every_write=self.config.wal_sync_every_write,
+        )
         wal.start()
         self.wal = wal
 

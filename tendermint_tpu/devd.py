@@ -77,6 +77,11 @@ import sys
 import threading
 import time
 
+# env-tunable deadline budgets parse via the shared defensive knob helper:
+# a typo'd value must not kill the verify hot path (libs.envknob is
+# stdlib-only, so the daemon's light import footprint is preserved)
+from tendermint_tpu.libs.envknob import env_number as _env_timeout
+
 logger = logging.getLogger("devd")
 
 DEFAULT_SOCK = "/tmp/tendermint-devd.sock"
@@ -1226,17 +1231,6 @@ def set_socket_wrapper(wrapper) -> None:
     _socket_wrapper = wrapper
 
 
-def _env_timeout(name: str, default: float) -> float:
-    """Env-tunable deadline budget; a typo'd value must not kill the
-    verify hot path (same rule as stream_chunk's env handling)."""
-    raw = os.environ.get(name, "")
-    if not raw:
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        logger.warning("ignoring malformed %s=%r", name, raw)
-        return default
 
 
 class DevdClient:

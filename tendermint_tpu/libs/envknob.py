@@ -1,0 +1,24 @@
+"""Defensive env-var knob parsing, shared by every tunable surface
+(gateway breaker, devd deadline budgets, WAL group-commit interval): a
+typo'd value warns and falls back to the default — an operator fat-finger
+must never kill node startup or a verify/commit hot path. An empty or
+unset variable is simply "use the default", with no warning.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger("libs.envknob")
+
+
+def env_number(name: str, default, cast=float):
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        logger.warning("ignoring malformed %s=%r; using %r", name, raw, default)
+        return default

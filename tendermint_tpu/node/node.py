@@ -102,6 +102,18 @@ class Node(BaseService):
                 "device plane: %s",
                 self.verifier._kernel or "cpu (native batch verify)",
             )
+        # the host durability plane's policy, stated next to the device
+        # plane's: what a power failure can cost (runtime state lives in
+        # the metrics RPC wal_* rows; docs/crash-recovery.md)
+        cc = config.consensus
+        if getattr(cc, "wal_sync_every_write", False):
+            logger.info("host durability plane: WAL fsync per record")
+        else:
+            logger.info(
+                "host durability plane: WAL group commit (flush interval "
+                "%.3gs, sync on #ENDHEIGHT; repair-on-open)",
+                getattr(cc, "wal_flush_interval_s", 0.1),
+            )
         # warm the native marshal/verify library off the hot path: the
         # gateway's CPU fallback only uses it when ready() (never builds
         # inline), so trigger the build/load here in the background

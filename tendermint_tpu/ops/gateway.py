@@ -35,25 +35,11 @@ import numpy as np
 
 from tendermint_tpu.crypto import ed25519 as ed_cpu
 from tendermint_tpu.crypto.keys import verify_any
+from tendermint_tpu.libs.envknob import env_number as _env_number
 
 logger = logging.getLogger("ops.gateway")
 
 Item = tuple[bytes, bytes, bytes]  # (pubkey, message, signature)
-
-
-def _env_number(name: str, default: float, cast=float) -> float:
-    """Env-tunable numeric knob; a typo'd value warns and falls back —
-    it must never kill the verify hot path (same rule as
-    devd._env_timeout, which stays module-local to avoid an import
-    cycle)."""
-    raw = os.environ.get(name, "")
-    if not raw:
-        return default
-    try:
-        return cast(raw)
-    except ValueError:
-        logger.warning("ignoring malformed %s=%r", name, raw)
-        return default
 
 
 def _cpu_verify_batch(items: list[Item]) -> list[bool]:

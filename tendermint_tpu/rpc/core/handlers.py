@@ -349,6 +349,14 @@ def metrics(ctx) -> dict:
     )
     out["blockstore_height"] = ctx.block_store.height()
     out["consensus_peer_msg_drops"] = ctx.consensus_state.peer_msg_drops
+    # host durability plane (round 9): WAL group-commit shape + repair
+    # history — wal_repairs moving is how an operator learns a crash left
+    # a torn tail that recovery already cleaned (docs/crash-recovery.md),
+    # the same way breaker_* surfaces device-plane degradation
+    wal = ctx.consensus_state.wal
+    if wal is not None:
+        for k, v in wal.stats().items():
+            out[f"wal_{k}"] = v
     pool = getattr(ctx.consensus_state, "evidence_pool", None)
     if pool is not None:
         out["evidence_count"] = pool.size()

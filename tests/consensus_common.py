@@ -161,3 +161,120 @@ def wait_for_height(cs: ConsensusState, height: int, timeout: float = 10.0) -> b
             return False
         time.sleep(0.005)
     return True
+
+
+# -- subprocess node harness (reference: test/persist/test.sh) ---------------
+#
+# The crash tiers (tests/test_persist.py FAIL_TEST_INDEX cycles, round 9's
+# tests/test_wal_torture.py torn-write sweeps) all drive the SAME node
+# shape: a real `python -m tendermint_tpu.cli node` subprocess over a
+# fast-consensus config with the persistent kvstore app, crashed by env-armed
+# fail points and restarted to prove recovery. One copy of that scaffolding
+# lives here.
+
+import json as _json
+import os as _os
+import subprocess as _subprocess
+import sys as _sys
+import urllib.request as _urllib_request
+
+REPO = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def init_node_home(home: str, chain_id: str) -> None:
+    """`cli init` + the fast-consensus subprocess config."""
+    _subprocess.run(
+        [_sys.executable, "-m", "tendermint_tpu.cli", "--home", home, "init",
+         "--chain-id", chain_id],
+        check=True, capture_output=True,
+        env=dict(_os.environ, PYTHONPATH=REPO),
+    )
+    write_fast_config(home)
+
+
+def write_fast_config(home: str) -> None:
+    """Speed up consensus for the subprocess (config.toml is what the CLI
+    node loads)."""
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.config.toml import config_to_toml
+
+    cfg = load_config(home)
+    c = cfg.consensus
+    c.timeout_propose = 0.3
+    c.timeout_prevote = 0.05
+    c.timeout_precommit = 0.05
+    c.timeout_commit = 0.05
+    c.skip_timeout_commit = True
+    cfg.base.db_backend = "filedb"
+    cfg.base.proxy_app = "persistent_kvstore"
+    with open(_os.path.join(home, "config.toml"), "w") as f:
+        f.write(config_to_toml(cfg))
+
+
+def node_proc(home: str, rpc_port: int, fail_index: int | None = None,
+              extra_env: dict | None = None):
+    """A real node subprocess; fail_index arms FAIL_TEST_INDEX, extra_env
+    arms anything else (e.g. the FAIL_TEST_MODE=torn_write torture tier)."""
+    env = dict(
+        _os.environ,
+        JAX_PLATFORMS="cpu",
+        TENDERMINT_TPU_DISABLE="1",
+        PYTHONPATH=REPO,
+    )
+    for k in ("FAIL_TEST_INDEX", "FAIL_TEST_MODE", "FAIL_TEST_WAL_BYTES",
+              "FAIL_TEST_ROTATE_INDEX", "FAIL_TEST_ROTATE_PHASE"):
+        env.pop(k, None)
+    if fail_index is not None:
+        env["FAIL_TEST_INDEX"] = str(fail_index)
+    if extra_env:
+        env.update({k: str(v) for k, v in extra_env.items()})
+    return _subprocess.Popen(
+        [
+            _sys.executable, "-m", "tendermint_tpu.cli", "--home", home, "node",
+            "--rpc.laddr", f"tcp://127.0.0.1:{rpc_port}",
+            "--p2p.laddr", "tcp://127.0.0.1:0",
+            "--log_level", "warning",
+        ],
+        env=env,
+        stdout=_subprocess.PIPE,
+        stderr=_subprocess.STDOUT,
+    )
+
+
+def rpc(port: int, method: str, timeout=5, **params):
+    req = _urllib_request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=_json.dumps(
+            {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with _urllib_request.urlopen(req, timeout=timeout) as resp:
+        body = _json.loads(resp.read().decode())
+    if body.get("error"):
+        raise RuntimeError(body["error"])
+    return body["result"]
+
+
+def wait_height(port: int, h: int, deadline_s: float = 60) -> int:
+    deadline = time.time() + deadline_s
+    last = -1
+    while time.time() < deadline:
+        try:
+            last = rpc(port, "status", timeout=2)["latest_block_height"]
+            if last >= h:
+                return last
+        except Exception:
+            pass
+        time.sleep(0.3)
+    return last

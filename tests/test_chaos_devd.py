@@ -246,11 +246,17 @@ def test_stalled_daemon_hits_stream_budget_not_io_timeout(chaos_env,
     sup = DaemonSupervisor(upstream, SIM_ENV)
     sup.start()
     plan = FaultPlan(seed=2)
-    plan.add("stall", "s2c", first=1, every=1, limit=1 << 30, stall_s=5.0)
     proxy = FaultProxy(chaos_env, upstream, plan).start()
     try:
         client = devd.DevdClient(chaos_env)
         assert client.stream_timeout == 0.5
+        # warm the full relay path (proxy accept thread + upstream dial)
+        # BEFORE arming the stall: under suite load the first accept can
+        # lag past the 0.5 s stream budget, making the client raise with
+        # zero frames relayed — faults_stall would read 0 (tier-1 flake).
+        # The rule is every=1 from first=1, so arming late loses nothing.
+        client.ping()
+        plan.add("stall", "s2c", first=1, every=1, limit=1 << 30, stall_s=5.0)
         t0 = time.monotonic()
         with pytest.raises(Exception):
             client.verify_stream(_items(32), chunk=8)

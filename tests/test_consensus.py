@@ -397,12 +397,21 @@ class TestWALTruncation:
         _, doc, _, _, _, wal_bytes, pre_pv = self._record(tmp_path)
         points = set()
         off = 0
+        # the v2 WAL is binary (newline bytes appear only inside JSON
+        # payloads), so "line" boundaries are arbitrary cut points — keep
+        # them, and add an even byte stride so the sweep density never
+        # depends on how many 0x0A bytes this run's frames happened to hold;
+        # the stride stays coarse because each point replays a full
+        # consensus state machine (~0.5 s) and the per-byte-exhaustive
+        # sweep already runs at the WAL layer in tests/test_wal_repair.py
         for ln in wal_bytes.splitlines(keepends=True):
             if len(ln) > 8:
                 points.add(off + len(ln) // 2)  # torn mid-line tail
                 points.add(off + len(ln) - 1)  # complete line, newline lost
             off += len(ln)
             points.add(off)  # clean cut after this line
+        for cut in range(8, len(wal_bytes), max(1, len(wal_bytes) // 16)):
+            points.add(cut)
         assert len(points) > 20, "recording produced a suspiciously short WAL"
         heights = {}
         for i, cut in enumerate(sorted(points)):

@@ -6,103 +6,26 @@ A node subprocess runs with FAIL_TEST_INDEX=i so the i-th fail point
 the process mid-commit; the restart must recover via WAL replay + ABCI
 handshake and keep committing on the same chain, with the persistent
 kvstore app's state intact.
+
+The subprocess scaffolding (fast config, node proc, RPC poll) is shared
+with the round-9 WAL torture tier: tests/consensus_common.py.
 """
 
 from __future__ import annotations
 
-import json
-import os
 import signal
 import subprocess
-import sys
 import time
-import urllib.request
 
 import pytest
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _free_port() -> int:
-    import socket
-
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def _write_fast_config(home: str) -> None:
-    """Speed up consensus for the subprocess (config.toml is what the CLI
-    node loads)."""
-    from tendermint_tpu.config import load_config
-    from tendermint_tpu.config.toml import config_to_toml
-
-    cfg = load_config(home)
-    c = cfg.consensus
-    c.timeout_propose = 0.3
-    c.timeout_prevote = 0.05
-    c.timeout_precommit = 0.05
-    c.timeout_commit = 0.05
-    c.skip_timeout_commit = True
-    cfg.base.db_backend = "filedb"
-    cfg.base.proxy_app = "persistent_kvstore"
-    with open(os.path.join(home, "config.toml"), "w") as f:
-        f.write(config_to_toml(cfg))
-
-
-def _node_proc(home: str, rpc_port: int, fail_index: int | None):
-    env = dict(
-        os.environ,
-        JAX_PLATFORMS="cpu",
-        TENDERMINT_TPU_DISABLE="1",
-        PYTHONPATH=REPO,
-    )
-    if fail_index is not None:
-        env["FAIL_TEST_INDEX"] = str(fail_index)
-    else:
-        env.pop("FAIL_TEST_INDEX", None)
-    return subprocess.Popen(
-        [
-            sys.executable, "-m", "tendermint_tpu.cli", "--home", home, "node",
-            "--rpc.laddr", f"tcp://127.0.0.1:{rpc_port}",
-            "--p2p.laddr", "tcp://127.0.0.1:0",
-            "--log_level", "warning",
-        ],
-        env=env,
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-    )
-
-
-def _rpc(port: int, method: str, timeout=5, **params):
-    req = urllib.request.Request(
-        f"http://127.0.0.1:{port}/",
-        data=json.dumps(
-            {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
-        ).encode(),
-        headers={"Content-Type": "application/json"},
-    )
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        body = json.loads(resp.read().decode())
-    if body.get("error"):
-        raise RuntimeError(body["error"])
-    return body["result"]
-
-
-def _wait_height(port: int, h: int, deadline_s: float = 60) -> int:
-    deadline = time.time() + deadline_s
-    last = -1
-    while time.time() < deadline:
-        try:
-            last = _rpc(port, "status", timeout=2)["latest_block_height"]
-            if last >= h:
-                return last
-        except Exception:
-            pass
-        time.sleep(0.3)
-    return last
+from consensus_common import (
+    free_port,
+    init_node_home,
+    node_proc,
+    rpc,
+    wait_height,
+)
 
 
 @pytest.mark.slow
@@ -110,18 +33,12 @@ def test_crash_restart_at_every_fail_point(tmp_path):
     """One crash-recover cycle per FAIL_TEST_INDEX (the 8 fail points:
     5 in consensus finalize-commit, 3 in apply-block)."""
     home = str(tmp_path / "persist")
-    subprocess.run(
-        [sys.executable, "-m", "tendermint_tpu.cli", "--home", home, "init",
-         "--chain-id", "persist-chain"],
-        check=True, capture_output=True,
-        env=dict(os.environ, PYTHONPATH=REPO),
-    )
-    _write_fast_config(home)
+    init_node_home(home, "persist-chain")
 
     committed_value = 0
     for fail_index in range(8):
-        port = _free_port()
-        proc = _node_proc(home, port, fail_index)
+        port = free_port()
+        proc = node_proc(home, port, fail_index)
         # wait for the crash (exit 99 from the fail point)
         deadline = time.time() + 60
         while proc.poll() is None and time.time() < deadline:
@@ -138,17 +55,17 @@ def test_crash_restart_at_every_fail_point(tmp_path):
         )
 
         # restart WITHOUT the fail index: must recover and keep going
-        port = _free_port()
-        proc = _node_proc(home, port, None)
+        port = free_port()
+        proc = node_proc(home, port, None)
         try:
-            h = _wait_height(port, 1, 60)
+            h = wait_height(port, 1, 60)
             assert h >= 1, f"no recovery after fail point {fail_index} (h={h})"
             # commit a tx to prove the recovered chain is live + app is sane
             committed_value += 1
             tx = f"persist-{fail_index}={committed_value}".encode()
-            res = _rpc(port, "broadcast_tx_commit", timeout=30, tx=tx.hex())
+            res = rpc(port, "broadcast_tx_commit", timeout=30, tx=tx.hex())
             assert res["deliver_tx"]["code"] == 0, res
-            q = _rpc(
+            q = rpc(
                 port, "abci_query", timeout=10,
                 data=f"persist-{fail_index}".encode().hex(),
             )

@@ -1,0 +1,262 @@
+"""WAL torture tier (round 9): crash a REAL node subprocess at chosen byte
+offsets of its WAL append stream (and at chunk-rotation boundaries), then
+prove restart recovers via repair + handshake + WAL replay with the
+committed chain byte-identical and the node still committing.
+
+The crash model is state/fail.py's torn-write hook: the WAL write crossing
+cumulative offset B is cut at exactly B, fsynced, and the process dies —
+the on-disk image is what a power failure at that instant leaves. The
+companion in-process tier (tests/test_wal_repair.py) sweeps EVERY byte
+offset of the repair logic cheaply; this tier samples offsets end-to-end
+through a real node (full sweep: the slow-marked test).
+
+Per cycle, the invariants of docs/crash-recovery.md:
+- restart reaches a height past the pre-crash chain and commits a fresh tx;
+- every height committed before the crash is BYTE-IDENTICAL after recovery
+  (block hash, part-set root, app hash, txs) — repair/replay never forks
+  or rewrites history;
+- no height at or below the last synced #ENDHEIGHT is lost;
+- the metrics RPC reports the v2 WAL (wal_format=2) and any repair.
+
+Scaffolding shared with tests/test_persist.py via consensus_common.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import time
+
+import pytest
+
+from consensus_common import free_port, init_node_home, node_proc, rpc, wait_height
+
+CYCLE_DEADLINE_S = 90
+
+
+def _store_fingerprints(home: str) -> dict:
+    """h -> (block hash, part-set root, app hash, txs) from the on-disk
+    block store; byte-identity of these across a recovery is the
+    no-fork/no-rewrite invariant."""
+    from tendermint_tpu.blockchain.store import BlockStore
+    from tendermint_tpu.libs.db import FileDB
+
+    store = BlockStore(FileDB(os.path.join(home, "data", "blockstore.db")))
+    out = {}
+    for h in range(1, store.height() + 1):
+        meta = store.load_block_meta(h)
+        block = store.load_block(h)
+        out[h] = (
+            meta.block_id.hash.hex(),
+            meta.block_id.parts_header.hash.hex(),
+            block.header.app_hash.hex(),
+            tuple(tx.hex() for tx in (block.data.txs or [])),
+        )
+    return out
+
+
+def _wal_last_synced_endheight(home: str) -> int:
+    """Last #ENDHEIGHT surviving in the crash image, via the READ-ONLY
+    view: read_wal_lines stops at a damaged frame exactly where repair
+    would cut, but never mutates the image — the restarting node must run
+    its OWN repair-on-open, not inherit one this helper already did."""
+    from tendermint_tpu.consensus.wal import decode_wal_line, read_wal_lines
+
+    try:
+        lines = read_wal_lines(os.path.join(home, "data", "cs.wal", "wal"))
+    except FileNotFoundError:
+        return -1  # crash landed before the WAL head existed
+    last = -1
+    for line in lines:
+        try:
+            decoded = decode_wal_line(line)
+        except Exception:
+            continue
+        if decoded and decoded[0] == "endheight":
+            last = decoded[1]
+    return last
+
+
+def _wait_exit(proc, deadline_s: float):
+    deadline = time.time() + deadline_s
+    while proc.poll() is None and time.time() < deadline:
+        time.sleep(0.2)
+    return proc.poll()
+
+
+def _stop(proc) -> None:
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(15)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+    if proc.stdout:
+        proc.stdout.close()
+
+
+def torture_cycle(tmp_path, name: str, crash_env: dict) -> str:
+    """One crash/recover cycle; returns a short outcome tag."""
+    home = str(tmp_path / name)
+    init_node_home(home, f"torture-{name}")
+
+    port = free_port()
+    proc = node_proc(home, port, extra_env=crash_env)
+    rc = _wait_exit(proc, CYCLE_DEADLINE_S)
+    if rc is None:
+        # offset beyond what this run wrote before the cycle deadline —
+        # a sampled-sweep cycle may legitimately not reach it
+        _stop(proc)
+        return "offset-not-reached"
+    out = proc.stdout.read().decode(errors="replace")[-2000:]
+    proc.stdout.close()
+    assert rc == 99, f"{name}: expected torn-write crash exit 99, got {rc}:\n{out}"
+
+    pre = _store_fingerprints(home)
+    h_sync = _wal_last_synced_endheight(home)
+    # durability floor: a height whose #ENDHEIGHT fsynced can never be lost
+    assert max(pre, default=0) >= h_sync, (
+        f"{name}: store lost height {h_sync} behind a synced ENDHEIGHT"
+    )
+
+    port = free_port()
+    proc = node_proc(home, port)
+    try:
+        target = max(pre, default=0) + 1
+        h = wait_height(port, target, CYCLE_DEADLINE_S)
+        assert h >= target, (
+            f"{name}: no recovery past pre-crash height {max(pre, default=0)}"
+            f" (h={h})"
+        )
+        res = rpc(
+            port, "broadcast_tx_commit", timeout=30,
+            tx=f"torture-{name}=1".encode().hex(),
+        )
+        assert res["deliver_tx"]["code"] == 0, res
+        m = rpc(port, "metrics", timeout=10)
+        assert m.get("wal_format") == 2, m.get("wal_format")
+    finally:
+        _stop(proc)
+
+    post = _store_fingerprints(home)
+    for height, fp in pre.items():
+        assert post[height] == fp, (
+            f"{name}: height {height} rewritten by recovery:\n"
+            f"pre={fp}\npost={post[height]}"
+        )
+    assert max(post, default=0) > max(pre, default=0)
+    return "recovered"
+
+
+# deterministic tier-1 sample: one offset inside height-1's record stream,
+# so the cycle tears REAL records and restart runs a REAL repair (tier-1
+# keeps exactly one end-to-end subprocess cycle for budget — the
+# in-process tests/test_wal_repair.py sweep stays exhaustive per byte,
+# and the dense subprocess sweep below rides the slow tier)
+TIER1_OFFSETS = (180,)
+SLOW_OFFSETS = tuple(range(0, 64)) + tuple(range(64, 1200, 11))
+
+
+def test_torn_write_sampled_offsets(tmp_path):
+    outcomes = {}
+    for b in TIER1_OFFSETS:
+        outcomes[b] = torture_cycle(
+            tmp_path, f"b{b}",
+            {"FAIL_TEST_MODE": "torn_write", "FAIL_TEST_WAL_BYTES": b},
+        )
+    # small offsets land within the first height's records: every tier-1
+    # cycle must actually crash and recover, not skip
+    assert set(outcomes.values()) == {"recovered"}, outcomes
+
+
+def _rotation_cycle(tmp_path, phase: str) -> None:
+    tag = torture_cycle(
+        tmp_path, f"rot-{phase}",
+        {
+            "FAIL_TEST_MODE": "rotate_crash",
+            "FAIL_TEST_ROTATE_INDEX": 0,
+            "FAIL_TEST_ROTATE_PHASE": phase,
+            "TENDERMINT_WAL_CHUNK_BYTES": 700,
+        },
+    )
+    assert tag == "recovered", (phase, tag)
+
+
+@pytest.mark.slow
+def test_rotation_boundary_crash_post_replace(tmp_path):
+    """Die right after the os.replace publishing a chunk — the nastiest
+    boundary image (numbered chunks, NO head file): restart must serve
+    the records across the boundary and keep committing. (Both subprocess
+    rotation phases ride the slow tier for tier-1 budget; tier 1 covers
+    the same disk images in-process via TestRotationBoundary, notably
+    test_missing_head_after_rotation_crash.)"""
+    _rotation_cycle(tmp_path, "post")
+
+
+@pytest.mark.slow
+def test_rotation_boundary_crash_pre_replace(tmp_path):
+    _rotation_cycle(tmp_path, "pre")
+
+
+@pytest.mark.slow
+def test_legacy_wal_home_still_recovers(tmp_path):
+    """A pre-round-9 node home (JSON-line WAL written by the old format)
+    must handshake + replay + keep committing after upgrade."""
+    home = str(tmp_path / "legacy")
+    init_node_home(home, "torture-legacy")
+    # run clean to height 2, stop, then rewrite the WAL as legacy lines
+    port = free_port()
+    proc = node_proc(home, port)
+    try:
+        assert wait_height(port, 2, CYCLE_DEADLINE_S) >= 2
+    finally:
+        _stop(proc)
+    pre = _store_fingerprints(home)
+
+    from tendermint_tpu.consensus.wal import WAL
+
+    wal_path = os.path.join(home, "data", "cs.wal", "wal")
+    wal = WAL(wal_path)
+    lines = wal.read_all_lines()
+    wal.group.close()
+    assert lines, "recorded run produced no WAL records"
+    from tendermint_tpu.libs.autofile import Group
+
+    for p in Group.list_chunks(wal_path):
+        os.unlink(p)
+    with open(wal_path, "w") as f:
+        f.write("".join(ln + "\n" for ln in lines))
+
+    port = free_port()
+    proc = node_proc(home, port)
+    try:
+        target = max(pre, default=0) + 1
+        assert wait_height(port, target, CYCLE_DEADLINE_S) >= target
+        m = rpc(port, "metrics", timeout=10)
+        assert m.get("wal_format") == 1, "legacy WAL must stay legacy"
+    finally:
+        _stop(proc)
+    post = _store_fingerprints(home)
+    for height, fp in pre.items():
+        assert post[height] == fp
+
+
+@pytest.mark.slow
+def test_torn_write_full_sweep(tmp_path):
+    """Dense byte-offset sweep through a real node: step 1 across the
+    deterministic head (magic + seeded ENDHEIGHT frame + first records),
+    then strided through height 1-2's stream. Every reached offset must
+    recover; the in-process tier already proves every-offset repair."""
+    outcomes: dict[int, str] = {}
+    for b in SLOW_OFFSETS:
+        outcomes[b] = torture_cycle(
+            tmp_path, f"s{b}",
+            {"FAIL_TEST_MODE": "torn_write", "FAIL_TEST_WAL_BYTES": b},
+        )
+    recovered = sum(1 for v in outcomes.values() if v == "recovered")
+    not_reached = [b for b, v in outcomes.items() if v == "offset-not-reached"]
+    assert recovered >= len(SLOW_OFFSETS) * 0.9, (
+        f"only {recovered}/{len(SLOW_OFFSETS)} offsets recovered; "
+        f"unreached: {not_reached}"
+    )
+    assert all(v in ("recovered", "offset-not-reached") for v in outcomes.values())
