@@ -24,7 +24,7 @@ test:
 # hold (dots no worse than the seed) — plus the chip-free hash-stream
 # smoke (the two asserted BENCH_r07 rows: streamed hash offload >= 1.3x
 # single-shot on the sim transport, flat host builder >= 1.5x recursive).
-tier1: hash-stream-smoke chaos-smoke wal-torture-smoke
+tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Chip-free bench smoke: every BASELINE config on the pinned CPU backend,
@@ -57,6 +57,15 @@ chaos-smoke:
 wal-torture-smoke:
 	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_WAL_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_wal.py
 
+# State-sync smoke, chip-free (~30 s): bench_statesync.py's reduced pass —
+# one producer -> light-verified restore round trip on a signedkv chain
+# with an injected corrupt chunk REJECTED, restore-vs-replay, and the
+# sim-transport streamed chunk-verify floor (>=1.3x). Runs as part of
+# `make tier1` (the protocol/reactor matrix lives in
+# tests/test_statesync.py, incl. the slow-marked 1k-block restore soak).
+statesync-smoke:
+	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_STATESYNC_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_statesync.py
+
 test_race:
 	$(PY) -m pytest tests/test_race.py -q
 
@@ -69,4 +78,4 @@ test_slow:
 native:
 	$(MAKE) -C native
 
-.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke
+.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke

@@ -20,6 +20,11 @@ Configs (BASELINE.md):
                  WAL throughput, repair/recovery scan on a torn 10k-record
                  log, byte-offset torture smoke (writes BENCH_r09.json;
                  chip-free BY CONSTRUCTION, asserts the >=1.3x floor)
+  9 statesync   — cold-start plane: snapshot restore vs fast-sync replay
+                  on a 300-block signedkv chain + streamed-vs-single-shot
+                  chunk verification on the sim transport (writes
+                  BENCH_r10.json; chip-free rows asserted >=1.3x, the
+                  live-daemon row auto-appends on a tunnel window)
 
 Each bench is its own process (the TPU is exclusive per process).
 Usage: python benches/run_all.py [--skip testnet,...]
@@ -44,6 +49,7 @@ BENCHES = {
     "6_devd_stream": [sys.executable, "benches/bench_devd_stream.py"],
     "7_chaos": [sys.executable, "benches/bench_chaos.py"],
     "8_wal": [sys.executable, "benches/bench_wal.py"],
+    "9_statesync": [sys.executable, "benches/bench_statesync.py"],
 }
 
 

@@ -72,6 +72,52 @@ class KVStoreApp(Application):
             return ResponseQuery(code=CODE_OK, key=data, log="does not exist")
         return ResponseQuery(code=CODE_OK, key=data, value=value, log="exists")
 
+    # -- state-sync hooks --------------------------------------------------
+
+    def snapshot(self) -> bytes | None:
+        """Canonical JSON of the committed (height, app_hash, state) —
+        sorted keys, so two replicas at the same height serialize
+        byte-identically (the statesync manifest digests depend on it)."""
+        return json.dumps(
+            {
+                "height": self.height,
+                "app_hash": self.app_hash.hex(),
+                "state": {k: v.hex() for k, v in self.state.items()},
+            },
+            sort_keys=True,
+        ).encode()
+
+    def restore(
+        self, data: bytes, height: int | None = None, app_hash: bytes | None = None
+    ) -> None:
+        if self.height != 0 or self.state:
+            raise ValueError("restore only valid on a fresh app")
+        obj = json.loads(data)
+        # shape-check before touching fields: a non-dict here would raise
+        # AttributeError, which escapes the restorer's ValueError net
+        if not isinstance(obj, dict) or not isinstance(obj.get("state"), dict):
+            raise ValueError("snapshot app state must be an object")
+        new_height = obj["height"]
+        claimed_hash = bytes.fromhex(obj["app_hash"])
+        state = {k: bytes.fromhex(v) for k, v in obj["state"].items()}
+        if not isinstance(new_height, int) or isinstance(new_height, bool) or new_height < 1:
+            raise ValueError(f"bad snapshot height {new_height!r}")
+        # the app hash is a pure function of the state map: recompute it
+        # rather than trust the snapshot's claim — a payload whose hash
+        # and state disagree must refuse here, before anything mutates
+        recomputed = simple_hash_from_map(state) if state else b""
+        if recomputed != claimed_hash:
+            raise ValueError("snapshot app_hash does not match its state")
+        if height is not None and new_height != height:
+            raise ValueError(
+                f"snapshot is at height {new_height}, expected {height}"
+            )
+        if app_hash is not None and claimed_hash != app_hash:
+            raise ValueError("snapshot app_hash does not match the verified hash")
+        self.height = new_height
+        self.app_hash = claimed_hash
+        self.state = state
+
 
 class PersistentKVStoreApp(KVStoreApp):
     """KVStore plus disk persistence and validator-set changes via
@@ -162,3 +208,33 @@ class PersistentKVStoreApp(KVStoreApp):
         res = super().commit()
         self._save()
         return res
+
+    # -- state-sync hooks: the persistent variant also carries its
+    # validator registry, and a restore lands on disk immediately so a
+    # restart handshakes at the snapshot height instead of replaying a
+    # chain whose pre-snapshot blocks the restored node never had ------
+
+    def snapshot(self) -> bytes | None:
+        obj = json.loads(super().snapshot())
+        obj["validators"] = self.validators
+        return json.dumps(obj, sort_keys=True).encode()
+
+    def restore(
+        self, data: bytes, height: int | None = None, app_hash: bytes | None = None
+    ) -> None:
+        obj = json.loads(data)
+        if not isinstance(obj, dict):
+            raise ValueError("snapshot app state must be an object")
+        validators = obj.get("validators", {})
+        if not isinstance(validators, dict):
+            raise ValueError("snapshot validators must be an object")
+        for k, power in validators.items():
+            if not isinstance(power, int) or isinstance(power, bool) or power < 1:
+                raise ValueError(f"bad validator power {power!r}")
+            try:
+                bytes.fromhex(k)
+            except (TypeError, ValueError):
+                raise ValueError("bad validator pubkey in snapshot")
+        super().restore(data, height=height, app_hash=app_hash)
+        self.validators = validators
+        self._save()

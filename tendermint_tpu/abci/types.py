@@ -216,3 +216,29 @@ class Application:
 
     def commit(self) -> ResponseCommit:
         return ResponseCommit()
+
+    # -- state-sync snapshot hooks (beyond the v0.5 ABCI surface: the
+    # reference era predates statesync; these mirror the later
+    # ListSnapshots/ApplySnapshotChunk shape at whole-state granularity) --
+
+    def snapshot(self) -> bytes | None:
+        """Deterministic byte serialization of the app's COMMITTED state
+        at its current height, or None when the app does not support
+        snapshots (the statesync producer then skips it). Must be a pure
+        read: called synchronously between Commit and the next
+        BeginBlock."""
+        return None
+
+    def restore(
+        self, data: bytes, height: int | None = None, app_hash: bytes | None = None
+    ) -> None:
+        """Replace the app's state wholesale with a snapshot()'s bytes.
+        Only valid on a fresh app (height 0). `height`/`app_hash`, when
+        given, are the LIGHT-VERIFIED values the snapshot must land on —
+        the app MUST validate `data` against them (and against its own
+        internal consistency, e.g. recomputing the app hash from the
+        restored state) and raise ValueError BEFORE mutating or
+        persisting anything: `data` is attacker input until it checks
+        out. The restorer re-checks the resulting Info() as a final
+        gate, but by then a badly-written app has already applied."""
+        raise NotImplementedError(f"{type(self).__name__} cannot restore snapshots")

@@ -62,6 +62,8 @@ class BlockchainReactor(Reactor, BaseService):
         status_update_interval: float = STATUS_UPDATE_INTERVAL,
         pipeline_depth: int = 8,
         group_sig_target: int = 4096,
+        post_apply_hook=None,
+        defer_for_statesync: bool = False,
     ):
         BaseService.__init__(self, name="blockchain.reactor")
         self.status_update_interval = status_update_interval
@@ -70,6 +72,12 @@ class BlockchainReactor(Reactor, BaseService):
             raise ValueError(
                 f"state ({state.last_block_height}) and store ({store.height()}) heights diverge"
             )
+        # statesync handoff (round 10): when a restore is pending, the
+        # pool must not start pulling from the genesis-height state this
+        # reactor was constructed with — start_after_statesync() re-seeds
+        # it at the restored height and starts the sync loop then
+        self.post_apply_hook = post_apply_hook
+        self._deferred = defer_for_statesync
         self.state = state
         self.proxy_app_conn = proxy_app_conn
         self.store = store
@@ -207,11 +215,36 @@ class BlockchainReactor(Reactor, BaseService):
     # -- lifecycle ---------------------------------------------------------
 
     def on_start(self) -> None:
-        if self.fast_sync:
-            self.pool.start()
-            threading.Thread(
-                target=self._pool_routine, daemon=True, name="bc.pool_routine"
-            ).start()
+        if self.fast_sync and not self._deferred:
+            self._start_sync()
+
+    def _start_sync(self) -> None:
+        self.pool.start()
+        threading.Thread(
+            target=self._pool_routine, daemon=True, name="bc.pool_routine"
+        ).start()
+
+    def start_after_statesync(self, state) -> None:
+        """Statesync handoff: a restore seeded the block store + state DB
+        at the snapshot height; adopt the restored state, re-point the
+        pool at the next height, and start syncing the tail. With
+        state=None (restore fell back), start from whatever the store
+        holds — genesis on a fresh node."""
+        if not self._deferred:
+            raise RuntimeError("reactor was not deferred for statesync")
+        self._deferred = False
+        if state is not None:
+            self.state = state.copy()
+        self.pool = BlockPool(
+            self.store.height() + 1,
+            request_fn=self._send_block_request,
+            timeout_fn=self._on_peer_timeout,
+        )
+        if self.fast_sync and self.is_running():
+            self._start_sync()
+            # peers connected during the restore already sent their
+            # status; ask again so the pool learns heights promptly
+            self.broadcast_status_request()
 
     def on_stop(self) -> None:
         self.pool.stop()
@@ -377,6 +410,13 @@ class BlockchainReactor(Reactor, BaseService):
         )
         self.stage_s["apply"] += time.perf_counter() - t0
         self.blocks_synced += 1
+        if self.post_apply_hook is not None:
+            # snapshot production during catch-up (round 10); best-effort
+            # by contract — the hook must never stall or kill the sync loop
+            try:
+                self.post_apply_hook(self.state, first)
+            except Exception:  # noqa: BLE001
+                self.logger.exception("post-apply hook failed at %d", first.header.height)
         return True
 
     def broadcast_status_request(self) -> None:

@@ -42,13 +42,31 @@ class BlockStore:
         self.db = db
         self._mtx = threading.Lock()
         self._height = 0
+        self._base = 0
         buf = db.get(_STORE_KEY)
         if buf:
-            self._height = json.loads(buf)["height"]
+            obj = json.loads(buf)
+            self._height = obj["height"]
+            # pre-round-10 stores have no base: a non-empty store starts
+            # at height 1 (nothing was ever pruned before base existed)
+            self._base = obj.get("base", 1 if self._height else 0)
 
     def height(self) -> int:
         with self._mtx:
             return self._height
+
+    def base(self) -> int:
+        """Lowest height this store can serve (round 10): >1 after a
+        statesync restore or prune_to — heights below it are legitimately
+        absent, not missing."""
+        with self._mtx:
+            return self._base
+
+    def _set_watermark_locked(self) -> None:
+        self.db.set_sync(
+            _STORE_KEY,
+            json.dumps({"height": self._height, "base": self._base}).encode(),
+        )
 
     # -- loads -------------------------------------------------------------
 
@@ -113,4 +131,61 @@ class BlockStore:
         )
         with self._mtx:
             self._height = height
-        self.db.set_sync(_STORE_KEY, json.dumps({"height": height}).encode())
+            if self._base == 0:
+                self._base = height  # first block this store ever held
+            self._set_watermark_locked()
+
+    def seed_snapshot(self, meta: BlockMeta, parts: list[Part], seen_commit: Commit) -> None:
+        """Statesync restore: install block H (meta + parts + seen
+        commit) as BOTH base and head of an empty store, so the restored
+        node serves /block and /commit at its base and save_block's
+        contiguity check accepts H+1 from fast sync. The caller verified
+        meta/parts/commit against the light-verified header chain."""
+        height = meta.header.height
+        if self.height() != 0:
+            raise ValueError(
+                f"seed_snapshot on a non-empty store (height {self.height()})"
+            )
+        if len(parts) != meta.block_id.parts_header.total:
+            raise ValueError("seed_snapshot: part count does not match meta")
+        self.db.set(_meta_key(height), json.dumps(meta.to_json(), sort_keys=True).encode())
+        for i, part in enumerate(parts):
+            self.db.set(_part_key(height, i), json.dumps(part.to_json(), sort_keys=True).encode())
+        self.db.set(
+            _seen_commit_key(height),
+            json.dumps(seen_commit.to_json(), sort_keys=True).encode(),
+        )
+        with self._mtx:
+            self._height = height
+            self._base = height
+            self._set_watermark_locked()
+
+    def prune_to(self, retain_height: int) -> int:
+        """Delete everything below `retain_height`; returns the number of
+        heights pruned. The watermark (with the new base) is flushed
+        FIRST, so a crash mid-prune leaves heights the store already
+        disowned — readers see base and treat them as pruned — never a
+        base claiming heights whose data is half-deleted."""
+        pruned = 0
+        with self._mtx:
+            if retain_height <= self._base:
+                return 0
+            if retain_height > self._height:
+                raise ValueError(
+                    f"cannot prune to {retain_height} past head {self._height}"
+                )
+            old_base, self._base = self._base, retain_height
+            self._set_watermark_locked()
+        for h in range(old_base, retain_height):
+            meta = self.load_block_meta(h)
+            if meta is not None:
+                for i in range(meta.block_id.parts_header.total):
+                    self.db.delete(_part_key(h, i))
+            self.db.delete(_meta_key(h))
+            self.db.delete(_commit_key(h))
+            self.db.delete(_seen_commit_key(h))
+            pruned += 1
+        # the canonical commit for height base-1 is block base's
+        # LastCommit, stored under base-1 at save time — below base now
+        self.db.delete(_commit_key(old_base - 1))
+        return pruned

@@ -160,12 +160,38 @@ class ConsensusConfig:
 
 
 @dataclass
+class StateSyncConfig:
+    """State-sync snapshot subsystem (round 10, docs/state-sync.md).
+    Both sides of the protocol live here: producing snapshots at height
+    intervals, and restoring from peers' snapshots on a cold start."""
+
+    root_dir: str = ""
+    # restore side: on an empty node, discover peer snapshots, light-
+    # verify + restore the newest, then fast-sync only the tail
+    enable: bool = False
+    # comma-separated RPC endpoints the light client verifies headers
+    # against during restore (empty + enable=True is a config error the
+    # node reports at startup)
+    rpc_servers: str = ""
+    # operator-pinned trust anchor; 0 walks trust from genesis
+    trust_height: int = 0
+    # producer side: snapshot every N committed heights (0 = off)
+    snapshot_interval: int = 0
+    snapshot_keep_recent: int = 2
+    chunk_size: int = 65536
+
+    def snapshot_dir(self) -> str:
+        return _root_join(self.root_dir, "data/snapshots")
+
+
+@dataclass
 class Config:
     base: BaseConfig = field(default_factory=BaseConfig)
     rpc: RPCConfig = field(default_factory=RPCConfig)
     p2p: P2PConfig = field(default_factory=P2PConfig)
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
 
     def set_root(self, root: str) -> "Config":
         self.base.root_dir = root
@@ -173,6 +199,7 @@ class Config:
         self.p2p.root_dir = root
         self.mempool.root_dir = root
         self.consensus.root_dir = root
+        self.statesync.root_dir = root
         return self
 
     def copy(self) -> "Config":
@@ -182,6 +209,7 @@ class Config:
             replace(self.p2p),
             replace(self.mempool),
             replace(self.consensus),
+            replace(self.statesync),
         )
 
 

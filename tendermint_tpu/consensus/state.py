@@ -92,6 +92,10 @@ class ConsensusState(BaseService):
 
         self.wal: WAL | None = None
         self.replay_mode = False
+        # post-apply hook (round 10): called synchronously after a block
+        # applies, between Commit and the next height — the statesync
+        # snapshot producer's interval point (node/node.py wires it)
+        self.post_apply_hook = None
         self.done_height = threading.Event()  # pulses on each commit (tests)
         self.n_steps = 0
         # liveness observability (round 8): wall seconds per committed
@@ -992,6 +996,15 @@ class ConsensusState(BaseService):
         )
 
         fail_point()
+
+        if self.post_apply_hook is not None and not self.replay_mode:
+            # snapshot production rides here: state_copy is the post-H
+            # state and the app just committed H — best-effort, a
+            # producer failure must never wedge consensus
+            try:
+                self.post_apply_hook(state_copy, block)
+            except Exception:  # noqa: BLE001
+                self.logger.exception("post-apply hook failed at %d", height)
 
         # events: NewBlock/NewBlockHeader + cached tx events, post-commit
         if self.evsw is not None:

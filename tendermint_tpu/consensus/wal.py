@@ -66,6 +66,11 @@ MAX_RECORD_BYTES = 8 * 1024 * 1024
 # on some platforms when handed to the C layer, and no sane group-commit
 # interval approaches an hour anyway
 _FLUSH_WAIT_CAP_S = 3600.0
+# the clean watermark (round 10) re-persists once the synced position has
+# advanced this far within one chunk (rotation crossings always persist):
+# bounds the post-crash deep scan to ~stride + the unsynced tail without
+# putting a sidecar write on every group commit
+_WATERMARK_STRIDE = 1024 * 1024
 
 
 def _frame(payload: bytes) -> bytes:
@@ -93,7 +98,7 @@ def _unused_path(path: str) -> str:
     return cand
 
 
-def scan_frames(buf: bytes) -> tuple[list[bytes], int | None]:
+def scan_frames(buf: bytes, start: int = 0) -> tuple[list[bytes], int | None]:
     """Parse one chunk's bytes into record payloads.
 
     Returns (payloads, bad_offset): bad_offset is None for a clean chunk,
@@ -104,13 +109,20 @@ def scan_frames(buf: bytes) -> tuple[list[bytes], int | None]:
     chunk at offset 0 leaves a zero-byte file in the group, and flagging
     it bad again on every later open would re-quarantine every newer
     chunk — including freshly fsynced #ENDHEIGHTs.
+
+    `start` > 0 resumes mid-chunk at a known frame boundary (the clean
+    watermark, round 10): the magic check is skipped — bytes before
+    `start` were covered by a synced flush and are trusted unread.
     """
     if not buf:
         return [], None
-    if not buf.startswith(MAGIC):
-        return [], 0
+    if start > 0:
+        off = start
+    else:
+        if not buf.startswith(MAGIC):
+            return [], 0
+        off = len(MAGIC)
     payloads: list[bytes] = []
-    off = len(MAGIC)
     n = len(buf)
     while off < n:
         if off + _FRAME.size > n:
@@ -205,6 +217,12 @@ class WAL(BaseService):
         self._synced_records = 0  # sum of group sizes (for the avg)
         self._repairs = 0
         self._truncated_bytes = 0
+        # clean-watermark plane (round 10, ROADMAP open item): chunks a
+        # synced flush already covered skip the open-time CRC deep scan
+        self._wm_path = wal_file + ".clean"
+        self._wm_written: tuple[int, int] | None = None  # (chunk_index, offset)
+        self._scan_skipped_chunks = 0
+        self._scan_skipped_bytes = 0
 
         self._legacy = self._detect_legacy()
         self._records_at_open = 0
@@ -249,19 +267,115 @@ class WAL(BaseService):
                 legacy_seen = True
         return legacy_seen
 
+    # -- clean watermark (round 10) ----------------------------------------
+
+    def _load_watermark(self) -> dict | None:
+        """The persisted clean watermark, validated against the chunk
+        files on disk — None (with a warning where it matters) whenever
+        anything disagrees, which falls back to the full deep scan. The
+        sidecar is written AFTER each covering fsync returns, so a valid
+        watermark can only ever trail durability, never lead it."""
+        try:
+            with open(self._wm_path) as f:
+                obj = json.load(f)
+            idx, off, rec = obj["chunk_index"], obj["offset"], obj["records"]
+        except (OSError, ValueError, KeyError):
+            return None
+        if not all(isinstance(v, int) and v >= 0 for v in (idx, off, rec)):
+            return None
+        if off < len(MAGIC):
+            return None
+        indices = Group._chunk_indices(self._path)
+        index_to_path = {i: f"{self._path}.{i:03d}" for i in indices}
+        if os.path.exists(self._path):
+            index_to_path[(indices[-1] + 1) if indices else 0] = self._path
+        target = index_to_path.get(idx)
+        if target is None or any(i not in index_to_path for i in range(idx)):
+            logger.warning(
+                "WAL clean watermark names chunk %d which is missing; "
+                "deep-scanning the full history", idx,
+            )
+            return None
+        if os.path.getsize(target) < off:
+            # fsynced bytes vanished: either the filesystem lost data or
+            # the log was hand-edited — both are full-forensics territory
+            logger.warning(
+                "WAL clean watermark covers %d byte(s) of %s but only %d "
+                "exist; deep-scanning the full history",
+                off, os.path.basename(target), os.path.getsize(target),
+            )
+            return None
+        return {"chunk_index": idx, "offset": off, "records": rec,
+                "path": target}
+
+    def _write_watermark(self, pos: tuple[int, int], records: int) -> None:
+        """Persist (chunk_index, offset, records-covered) atomically. Not
+        fsynced on purpose: a lost or torn sidecar only widens the next
+        open's scan — JSON that fails to parse reads as 'no watermark'."""
+        tmp = self._wm_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(
+                    {"chunk_index": pos[0], "offset": pos[1],
+                     "records": records}, f,
+                )
+            os.replace(tmp, self._wm_path)
+            self._wm_written = pos
+        except OSError:
+            logger.exception("WAL clean watermark write failed")
+
+    def _maybe_write_watermark(self, pos: tuple[int, int], records: int) -> None:
+        last = self._wm_written
+        if last is None or pos[0] > last[0] or (
+            pos[0] == last[0] and pos[1] - last[1] >= _WATERMARK_STRIDE
+        ):
+            self._write_watermark(pos, records)
+
+    def _drop_watermark(self) -> None:
+        try:
+            os.unlink(self._wm_path)
+        except FileNotFoundError:
+            pass
+        self._wm_written = None
+
     def _repair(self) -> int:
-        """Forward-scan every chunk; truncate at the first damaged record,
+        """Forward-scan the chunks; truncate at the first damaged record,
         backing the cut tail (and all later chunks) up to
-        <wal>.corrupt-<stamp>. Returns the surviving record count."""
+        <wal>.corrupt-<stamp>. Returns the surviving record count.
+
+        Chunks (and the watermark chunk's prefix) covered by the clean
+        watermark skip the deep scan: those bytes were fsynced before the
+        sidecar was written and a crash cannot have torn them — the scan
+        that used to be O(total history) per open is now O(bytes since
+        the last persisted watermark). TENDERMINT_WAL_DEEP_SCAN=1 forces
+        the full-history scan for forensics (historical-chunk bit rot is
+        out of the crash model, exactly like silent payload rot on
+        trusted local IPC in the device plane's contract)."""
+        wm = None
+        if int(env_number("TENDERMINT_WAL_DEEP_SCAN", 0, cast=int)):
+            logger.info("TENDERMINT_WAL_DEEP_SCAN=1: full-history WAL scan")
+        else:
+            wm = self._load_watermark()
         paths = Group.list_chunks(self._path)
-        records = 0
+        records = wm["records"] if wm else 0
+        wm_at = paths.index(wm["path"]) if wm else -1
         for i, p in enumerate(paths):
+            start = 0
+            if wm is not None:
+                if i < wm_at:
+                    self._scan_skipped_chunks += 1
+                    self._scan_skipped_bytes += os.path.getsize(p)
+                    continue
+                if i == wm_at:
+                    start = wm["offset"]
+                    self._scan_skipped_bytes += start
+                    self._wm_written = (wm["chunk_index"], wm["offset"])
             try:
                 with open(p, "rb") as f:
                     buf = f.read()
             except OSError:
                 continue
-            payloads, bad = scan_frames(buf)
+            payloads, bad = scan_frames(buf, start=start)
             records += len(payloads)
             if bad is None:
                 continue
@@ -282,6 +396,10 @@ class WAL(BaseService):
                 cut += os.path.getsize(dest)
             self._repairs += 1
             self._truncated_bytes += cut
+            # the watermark may name bytes (or whole chunks) the cut just
+            # removed; rather than reason about partial overlap, drop it —
+            # the next synced flush rebuilds it over the repaired log
+            self._drop_watermark()
             logger.warning(
                 "WAL repair: truncated %d byte(s) at %s offset %d (backup %s)",
                 cut, os.path.basename(p), bad, backup,
@@ -334,6 +452,14 @@ class WAL(BaseService):
             )
         else:
             self.sync()
+            if not self._legacy:
+                # exact watermark on clean close: the next open deep-scans
+                # nothing (the final sync drained every pending record)
+                with self._wmtx:
+                    pos = self.group.position() if self._pending == 0 else None
+                    covered = self._records_at_open + self._records
+                if pos is not None:
+                    self._write_watermark(pos, covered)
         self.group.close()
 
     def _flush_loop(self) -> None:
@@ -357,11 +483,19 @@ class WAL(BaseService):
         with self._sync_mtx:
             with self._wmtx:
                 batch = self._pending
+                # clean-watermark coordinate, captured while _wmtx blocks
+                # writers: the group position corresponds EXACTLY to the
+                # _records written so far, and the fsync below covers at
+                # least these bytes
+                pos = None if self._legacy else self.group.position()
+                covered = self._records_at_open + self._records
             if batch == 0:
                 return
             self.group.flush(sync=True)
             with self._wmtx:
                 self._account_sync(batch)
+            if pos is not None:
+                self._maybe_write_watermark(pos, covered)
 
     def _account_sync(self, batch: int) -> None:
         # caller holds self._wmtx
@@ -489,6 +623,11 @@ class WAL(BaseService):
                 "group_size_avg": round(self._synced_records / synced_groups, 2),
                 "repairs": self._repairs,
                 "truncated_bytes": self._truncated_bytes,
+                # clean-watermark plane (round 10): how much history the
+                # last open trusted without re-reading — skipped bytes at 0
+                # on a long-lived home means the watermark is not landing
+                "scan_skipped_chunks": self._scan_skipped_chunks,
+                "scan_skipped_bytes": self._scan_skipped_bytes,
                 "flush_interval_s": self._flush_interval_s,
                 "sync_every_write": int(self._sync_every),
                 # seconds since the last fsync: pending>0 with a growing

@@ -155,6 +155,16 @@ class Group:
         indices = Group._chunk_indices(self._head_path)
         return indices[-1] if indices else -1
 
+    def position(self) -> tuple[int, int]:
+        """(index the head will take when it rotates, OS-flushed head
+        size) — the clean-watermark coordinate (consensus/wal.py, round
+        10). Captured under the append lock, so the offset always lands
+        on a record boundary: writers append whole frames and rotation
+        only happens between writes."""
+        with self._mtx:
+            self._head.flush()
+            return self._max_index() + 1, self._head.tell()
+
     def close(self) -> None:
         with self._mtx:
             self._head.flush()

@@ -49,6 +49,29 @@ def _parse_laddr(laddr: str) -> str:
     return laddr.split("://", 1)[-1]
 
 
+class _FailoverRPC:
+    """Spread the statesync light client's reads over every configured
+    rpc_server: each call tries the servers in order and the first
+    TRANSPORT-level success wins (a server that answers with bad data
+    still fails verification upstream — failover is for dead endpoints,
+    not lying ones)."""
+
+    def __init__(self, clients: list):
+        self._clients = clients
+
+    def __getattr__(self, name):
+        def call(**kw):
+            last_exc = None
+            for c in self._clients:
+                try:
+                    return getattr(c, name)(**kw)
+                except Exception as exc:  # noqa: BLE001 — try the next server
+                    last_exc = exc
+            raise last_exc
+
+        return call
+
+
 def default_new_node(config) -> "Node":
     """node/node.go:74-110: load/generate privval, default app client."""
     priv_validator = PrivValidatorFS.load_or_generate(
@@ -174,6 +197,49 @@ class Node(BaseService):
         self.mempool.init_wal()
         self.mempool_reactor = MempoolReactor(config.mempool, self.mempool)
 
+        # -- statesync (round 10, docs/state-sync.md): snapshot store is
+        # always constructed (serving is free); the producer hooks the
+        # post-apply point when an interval is configured and the local
+        # app supports snapshots; restore mode arms when enabled on a
+        # node that is still at genesis with an empty block store -------
+        from tendermint_tpu.statesync import SnapshotProducer, SnapshotStore
+
+        sc = config.statesync
+        self.snapshot_store = SnapshotStore(sc.snapshot_dir())
+        from tendermint_tpu.abci.types import Application
+
+        self.snapshot_producer = None
+        if sc.snapshot_interval > 0:
+            # support probe by method identity — actually CALLING
+            # snapshot() here would serialize the app's whole committed
+            # state at node construction just to throw it away
+            if local_app is not None and type(local_app).snapshot is not Application.snapshot:
+                self.snapshot_producer = SnapshotProducer(
+                    self.snapshot_store,
+                    local_app,
+                    self.block_store,
+                    hasher=self.hasher,
+                    interval=sc.snapshot_interval,
+                    keep_recent=sc.snapshot_keep_recent,
+                    chunk_size=sc.chunk_size,
+                )
+            else:
+                logger.warning(
+                    "statesync.snapshot_interval=%d but app %s has no "
+                    "snapshot support; producer disabled",
+                    sc.snapshot_interval, config.base.proxy_app,
+                )
+        statesync_restore = (
+            sc.enable
+            and self.block_store.height() == 0
+            and state.last_block_height == 0
+        )
+        if sc.enable and not statesync_restore:
+            logger.info(
+                "statesync enabled but node already has a chain "
+                "(store height %d); using fast sync", self.block_store.height(),
+            )
+
         # -- consensus ----------------------------------------------------
         self.consensus_state = ConsensusState(
             config.consensus,
@@ -186,6 +252,8 @@ class Node(BaseService):
         if priv_validator is not None:
             self.consensus_state.set_priv_validator(priv_validator)
         self.consensus_state.set_event_switch(self.evsw)
+        if self.snapshot_producer is not None:
+            self.consensus_state.post_apply_hook = self.snapshot_producer.maybe_snapshot
         self.consensus_reactor = ConsensusReactor(self.consensus_state, fast_sync)
         self.consensus_reactor.set_event_switch(self.evsw)
 
@@ -200,7 +268,37 @@ class Node(BaseService):
             async_batch_verifier=self.verifier.verify_batch_async,
             part_hasher=self.hasher.part_leaf_hashes,
             part_tree_hasher=self.hasher.part_set_tree,
+            post_apply_hook=(
+                self.snapshot_producer.maybe_snapshot
+                if self.snapshot_producer is not None else None
+            ),
+            defer_for_statesync=statesync_restore,
         )
+
+        # -- statesync reactor: always serves local snapshots; in restore
+        # mode it also drives discovery -> light-verified restore -> the
+        # fast-sync handoff (start_after_statesync picks up the tail) ----
+        from tendermint_tpu.statesync.reactor import StateSyncReactor
+
+        restorer = None
+        if statesync_restore:
+            restorer = self._make_restorer(sc, local_app, genesis_doc, state_db)
+            statesync_restore = restorer is not None
+            if not statesync_restore:
+                # misconfigured restore must not strand the node: fall
+                # back to plain fast sync (the reactor stays serve-only)
+                self.blockchain_reactor.start_after_statesync(None)
+        self.statesync_reactor = StateSyncReactor(
+            self.snapshot_store,
+            restorer=restorer,
+            enabled=statesync_restore,
+            on_complete=self._on_statesync_complete,
+        )
+        if statesync_restore:
+            logger.info(
+                "statesync: restore armed (light verify via %s, trust height %d)",
+                sc.rpc_servers or "genesis", sc.trust_height,
+            )
 
         # -- p2p switch (node.go:231-245) ---------------------------------
         peer_config = PeerConfig(
@@ -214,6 +312,7 @@ class Node(BaseService):
         self.sw.add_reactor("MEMPOOL", self.mempool_reactor)
         self.sw.add_reactor("BLOCKCHAIN", self.blockchain_reactor)
         self.sw.add_reactor("CONSENSUS", self.consensus_reactor)
+        self.sw.add_reactor("STATESYNC", self.statesync_reactor)
 
         self.addr_book = AddrBook(
             config.p2p.addr_book(), config.p2p.addr_book_strict
@@ -247,6 +346,69 @@ class Node(BaseService):
         self.listener: Listener | None = None
         self.rpc_server = None
         self.grpc_server = None
+
+    # -- statesync wiring --------------------------------------------------
+
+    def _make_restorer(self, sc, local_app, genesis_doc, state_db):
+        """Build the restore-side Restorer, or None (with a logged
+        reason) when the configuration cannot support a restore."""
+        from tendermint_tpu.statesync import Restorer
+
+        if local_app is None:
+            logger.warning(
+                "statesync restore needs an in-process app (got %s); "
+                "falling back to fast sync", self.config.base.proxy_app,
+            )
+            return None
+        servers = [s.strip() for s in sc.rpc_servers.split(",") if s.strip()]
+        if not servers:
+            logger.warning(
+                "statesync.enable without statesync.rpc_servers; the light "
+                "client has nothing to verify against — falling back to "
+                "fast sync",
+            )
+            return None
+        from tendermint_tpu.rpc.client import HTTPClient
+        from tendermint_tpu.rpc.light import LightClient
+        from tendermint_tpu.types.validator import Validator
+        from tendermint_tpu.types.validator_set import ValidatorSet
+
+        vs = ValidatorSet(
+            [Validator.new(v.pub_key, v.power) for v in genesis_doc.validators]
+        )
+        clients = [HTTPClient(s) for s in servers]
+        light_client = LightClient(
+            clients[0] if len(clients) == 1 else _FailoverRPC(clients),
+            genesis_doc.chain_id,
+            vs,
+            trusted_height=sc.trust_height,
+            batch_verifier=self.verifier.commit_batch_verifier(),
+        )
+        return Restorer(
+            genesis_doc,
+            local_app,
+            state_db,
+            self.block_store,
+            hasher=self.hasher,
+            light_client=light_client,
+            batch_verifier=self.verifier.commit_batch_verifier(),
+        )
+
+    def _on_statesync_complete(self, restored_state) -> None:
+        """Restore finished (or fell back with None): adopt the restored
+        state everywhere that cached a genesis-height copy, then hand the
+        tail to fast sync."""
+        if restored_state is not None:
+            # the consensus state keeps waiting in fast-sync mode: the
+            # eventual switch_to_consensus (from the blockchain reactor)
+            # seeds it with the fast-synced state, which now starts at
+            # the restored height
+            self.state = restored_state
+            logger.info(
+                "statesync restore complete at height %d; fast-syncing the tail",
+                restored_state.last_block_height,
+            )
+        self.blockchain_reactor.start_after_statesync(restored_state)
 
     # -- lifecycle (node.go:310-352) --------------------------------------
 

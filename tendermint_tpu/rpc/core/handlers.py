@@ -78,8 +78,9 @@ def genesis(ctx) -> dict:
 
 def blockchain_info(ctx, min_height: int = 0, max_height: int = 0) -> dict:
     store_height = ctx.block_store.height()
+    floor = max(1, ctx.block_store.base())
     max_height = min(store_height, max_height) if max_height else store_height
-    min_height = max(1, min_height) if min_height else max(1, max_height - 20 + 1)
+    min_height = max(floor, min_height) if min_height else max(floor, max_height - 20 + 1)
     if min_height > max_height:
         raise RPCError(f"min height {min_height} > max height {max_height}")
     metas = []
@@ -90,12 +91,25 @@ def blockchain_info(ctx, min_height: int = 0, max_height: int = 0) -> dict:
     return {"last_height": store_height, "block_metas": metas}
 
 
+def _check_pruned(ctx, height: int) -> None:
+    """A store restored from a snapshot (or pruned) legitimately starts
+    above height 1: queries below its base get a CLEAR error, never a
+    None-decoding surprise (round-10 satellite)."""
+    base = ctx.block_store.base()
+    if height < base:
+        raise RPCError(
+            f"height {height} is below the store's base {base} "
+            "(pruned or restored from a snapshot)"
+        )
+
+
 def block(ctx, height: int) -> dict:
     height = int(height)
     if height <= 0:
         raise RPCError("height must be greater than 0")
     if height > ctx.block_store.height():
         raise RPCError("height must be less than or equal to the head")
+    _check_pruned(ctx, height)
     meta = ctx.block_store.load_block_meta(height)
     blk = ctx.block_store.load_block(height)
     return {
@@ -111,6 +125,7 @@ def commit(ctx, height: int) -> dict:
         raise RPCError("height must be greater than 0")
     if height > store_height:
         raise RPCError("height must be less than or equal to the head")
+    _check_pruned(ctx, height)
     meta = ctx.block_store.load_block_meta(height)
     if meta is None:  # pruned or mid-write height inside the valid range
         raise RPCError(f"no block meta for height {height}")
@@ -318,6 +333,23 @@ def abci_info(ctx) -> dict:
 # -- unsafe (rpc/core/net.go, dev.go, mempool.go) -----------------------------
 
 
+def snapshots(ctx) -> dict:
+    """State-sync discovery over RPC (round 10): the node's locally held
+    snapshots in manifest-lite form, newest first — what an operator (or
+    an out-of-band bootstrapper) reads before pointing a fresh node's
+    statesync at this one. docs/state-sync.md."""
+    node = ctx.node
+    store = getattr(node, "snapshot_store", None)
+    if store is None:
+        return {"snapshots": []}
+    out = []
+    for h in reversed(store.heights()):
+        m = store.load_manifest(h)
+        if m is not None:
+            out.append(m.lite())
+    return {"snapshots": out}
+
+
 def unsafe_dial_seeds(ctx, seeds) -> dict:
     if isinstance(seeds, str):
         seeds = [s for s in seeds.split(",") if s]
@@ -348,6 +380,7 @@ def metrics(ctx) -> dict:
         getattr(ctx.consensus_state, "height_seconds_max", 0.0), 3
     )
     out["blockstore_height"] = ctx.block_store.height()
+    out["blockstore_base"] = ctx.block_store.base()
     out["consensus_peer_msg_drops"] = ctx.consensus_state.peer_msg_drops
     # host durability plane (round 9): WAL group-commit shape + repair
     # history — wal_repairs moving is how an operator learns a crash left
@@ -378,6 +411,17 @@ def metrics(ctx) -> dict:
         out["fastsync_rate_blocks_per_sec"] = round(bc.sync_rate, 3)
         for stage, secs in bc.stage_s.items():
             out[f"fastsync_{stage}_s"] = round(secs, 3)
+    # statesync plane (round 10): producer cadence + serving counters +
+    # restore progress — statesync_chunk_failures / _peers_banned moving
+    # is how an operator sees a peer feeding a joining node bad chunks
+    ss_r = getattr(node, "statesync_reactor", None)
+    if ss_r is not None:
+        for k, v in ss_r.stats().items():
+            out[f"statesync_{k}"] = v
+    producer = getattr(node, "snapshot_producer", None)
+    if producer is not None:
+        for k, v in producer.stats().items():
+            out.setdefault(f"statesync_{k}", v)
     verifier = getattr(node, "verifier", None)
     if verifier is not None:
         for k, v in verifier.stats().items():
@@ -457,6 +501,7 @@ ROUTES_TABLE = {
     "validators": (validators, ["height"]),
     "dump_consensus_state": (dump_consensus_state, []),
     "evidence": (evidence, []),
+    "snapshots": (snapshots, []),
     "metrics": (metrics, []),
     "tx": (tx, ["hash", "prove"]),
     "unconfirmed_txs": (unconfirmed_txs, []),

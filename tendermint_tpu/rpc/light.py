@@ -50,6 +50,28 @@ class LightClient:
         )
         return cls(client, doc.chain_id, vs, trusted_height=0, **kw)
 
+    def copy(self) -> "LightClient":
+        """A speculative clone sharing this client's transport and
+        current trust: advancing the clone never mutates this instance
+        (advance() only REBINDS validators/height/_trusted_header, it
+        never mutates the set in place). The statesync restorer walks a
+        clone per candidate snapshot and adopts it only once the
+        manifest binds — a forged high-height offer must not advance
+        trust past lower, honest snapshots."""
+        c = LightClient(
+            self.client, self.chain_id, self.validators, self.height,
+            batch_verifier=self.batch_verifier,
+        )
+        c._trusted_header = self._trusted_header
+        return c
+
+    def trusted_header(self) -> Header | None:
+        """The last header advance() fully verified (None until the first
+        advance past an anchor). The statesync restorer reads headers H
+        and H+1 off the walk to bind a snapshot manifest to the verified
+        chain."""
+        return self._trusted_header
+
     # -- header verification ------------------------------------------------
 
     def verify_header(self, height: int, _res: dict | None = None) -> Header:

@@ -103,11 +103,10 @@ class State:
         return s
 
     @classmethod
-    def load_state(cls, db: DB, genesis_doc: GenesisDoc) -> "State | None":
-        buf = db.get(_STATE_KEY)
-        if not buf:
-            return None
-        obj = json.loads(buf)
+    def from_json_obj(cls, db: DB, genesis_doc: GenesisDoc, obj: dict) -> "State":
+        """Rehydrate a State from its to_json() form — the load_state
+        body, also used by the statesync restore path on a snapshot's
+        embedded state object."""
         s = cls(db, genesis_doc)
         s.last_block_height = obj["last_block_height"]
         s.last_block_id = BlockID.from_json(obj["last_block_id"])
@@ -117,6 +116,13 @@ class State:
         s.app_hash = bytes.fromhex(obj["app_hash"])
         s.last_height_validators_changed = obj["last_height_validators_changed"]
         return s
+
+    @classmethod
+    def load_state(cls, db: DB, genesis_doc: GenesisDoc) -> "State | None":
+        buf = db.get(_STATE_KEY)
+        if not buf:
+            return None
+        return cls.from_json_obj(db, genesis_doc, json.loads(buf))
 
     @classmethod
     def get_state(cls, db: DB, genesis_doc: GenesisDoc) -> "State":
@@ -221,6 +227,20 @@ class State:
 
     def params(self):
         return self.genesis_doc.consensus_params
+
+    def seed_restored(self, validators_info: dict) -> None:
+        """Statesync restore: persist this (light-verified) state as THE
+        state, plus the validator-history records load_validators needs
+        for heights at/after the snapshot (statesync/producer.py
+        validators_info_records). The caller verified every record's set
+        against the header chain before handing it here."""
+        with self._mtx:
+            for h_str, info in validators_info.items():
+                self.db.set_sync(
+                    _validators_key(int(h_str)),
+                    json.dumps(info, sort_keys=True).encode(),
+                )
+            self.db.set_sync(_STATE_KEY, self.bytes_())
 
     def equals(self, other: "State") -> bool:
         return self.bytes_() == other.bytes_()

@@ -1,0 +1,215 @@
+"""Snapshot producer: export a deterministic snapshot of (app state +
+state + block-store tail) at configured height intervals.
+
+Runs SYNCHRONOUSLY on the post-apply hook (consensus finalize_commit /
+fast-sync _try_sync), between one block's Commit and the next height's
+first DeliverTx — the only point where app.snapshot() is guaranteed to
+observe exactly height H. The in-process apps serialize in microseconds
+to low milliseconds at test scales; a deployment whose app state is
+huge raises snapshot_interval, it does not move the hook.
+
+Payload (format 1, canonical JSON, sort_keys — byte-identical across
+replicas at the same height):
+
+    {
+      "format": 1, "chain_id": ..., "height": H,
+      "app_state": hex(app.snapshot()),
+      "state": State.to_json() AFTER applying H,
+      "validators_info": {height: saveValidatorsInfo record, ...},
+      "block": {"meta": ..., "seen_commit": ..., "parts": [...]}
+    }
+
+The block section carries height H itself (meta + parts + seen commit)
+so a restored node can serve /block and /commit at its base height and
+seed a BlockStore whose head is real, not a phantom watermark.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from tendermint_tpu.libs.envknob import env_number
+from tendermint_tpu.statesync.snapshot import (
+    MAX_CHUNK_BYTES,
+    Manifest,
+    SnapshotStore,
+    chunk_payload,
+)
+
+logger = logging.getLogger("statesync.producer")
+
+DEFAULT_CHUNK_SIZE = 64 * 1024
+
+
+def validators_info_records(state) -> dict:
+    """The state-DB validator-history records a restored node needs so
+    load_validators resolves for every height it can be asked about
+    (>= the snapshot height): a self-contained full set at H (the set
+    that SIGNED H), the current set at its last-changed height, and the
+    pointer record for H+1 (state/state.py saveValidatorsInfo shape)."""
+    h = state.last_block_height
+    lhc = max(state.last_height_validators_changed, 1)
+    records: dict = {}
+    # the full current set lives where the last-changed pointer lands
+    records[str(lhc)] = {
+        "last_height_changed": lhc,
+        "validator_set": state.validators.to_json(),
+    }
+    # height H resolves directly to the set that signed it (when lhc == H
+    # the set changed entering H, so validators == last_validators
+    # membership-wise and either record serves)
+    records.setdefault(
+        str(h),
+        {"last_height_changed": h, "validator_set": state.last_validators.to_json()},
+    )
+    if str(h + 1) not in records:
+        records[str(h + 1)] = {"last_height_changed": lhc}
+    return records
+
+
+def build_payload(state, app_state: bytes, block_store) -> dict:
+    """The JSON payload object for a snapshot at state.last_block_height.
+    Raises SnapshotError-ish ValueError when the block store cannot serve
+    the height (e.g. it was just pruned past it)."""
+    h = state.last_block_height
+    meta = block_store.load_block_meta(h)
+    seen = block_store.load_seen_commit(h)
+    if meta is None or seen is None:
+        raise ValueError(f"block store cannot serve height {h} for snapshot")
+    parts = []
+    for i in range(meta.block_id.parts_header.total):
+        part = block_store.load_block_part(h, i)
+        if part is None:
+            raise ValueError(f"missing part {i} of block {h}")
+        parts.append(part.to_json())
+    return {
+        "format": 1,
+        "chain_id": state.chain_id,
+        "height": h,
+        "app_state": app_state.hex(),
+        "state": state.to_json(),
+        "validators_info": validators_info_records(state),
+        "block": {
+            "meta": meta.to_json(),
+            "seen_commit": seen.to_json(),
+            "parts": parts,
+        },
+    }
+
+
+def encode_payload(obj: dict) -> bytes:
+    import json
+
+    return json.dumps(obj, sort_keys=True).encode()
+
+
+class SnapshotProducer:
+    def __init__(
+        self,
+        store: SnapshotStore,
+        app,
+        block_store,
+        hasher=None,
+        interval: int = 0,
+        keep_recent: int = 2,
+        chunk_size: int | None = None,
+    ):
+        self.store = store
+        self.app = app
+        self.block_store = block_store
+        self.hasher = hasher
+        self.interval = interval
+        self.keep_recent = keep_recent
+        if chunk_size is None:
+            chunk_size = int(
+                env_number(
+                    "TENDERMINT_STATESYNC_CHUNK_BYTES", DEFAULT_CHUNK_SIZE, cast=int
+                )
+            )
+        if chunk_size < 1024:
+            logger.warning(
+                "statesync chunk size %d B < 1 KiB floor; clamping", chunk_size
+            )
+            chunk_size = 1024
+        if chunk_size > MAX_CHUNK_BYTES:
+            # a wider chunk would pass local framing but every peer's
+            # manifest/chunk decode (and the wire capacity) rejects it —
+            # clamp so the snapshots produced are actually servable
+            logger.warning(
+                "statesync chunk size %d B > %d ceiling; clamping",
+                chunk_size, MAX_CHUNK_BYTES,
+            )
+            chunk_size = MAX_CHUNK_BYTES
+        self.chunk_size = chunk_size
+        # gauges (statesync_* in the metrics RPC)
+        self.snapshots_taken = 0
+        self.snapshot_failures = 0
+        self.last_snapshot_height = 0
+        self.last_snapshot_seconds = 0.0
+
+    def _chunk_digests(self, chunks: list[bytes]) -> list[bytes]:
+        """Per-chunk RIPEMD-160 through the hashing gateway when one is
+        wired (streamed devd plane / AVX batch / CPU fallback — the same
+        routing ladder the part plane rides), plain CPU otherwise."""
+        if self.hasher is not None:
+            return self.hasher.part_leaf_hashes(chunks)
+        from tendermint_tpu.statesync.snapshot import chunk_digest
+
+        return [chunk_digest(c) for c in chunks]
+
+    def maybe_snapshot(self, state, block=None) -> int | None:
+        """The post-apply hook: snapshot when the just-applied height
+        lands on the interval. NEVER raises — a snapshot failure must
+        not take down the consensus or fast-sync path that called it."""
+        h = state.last_block_height
+        if self.interval <= 0 or h == 0 or h % self.interval != 0:
+            return None
+        try:
+            return self.snapshot(state)
+        except Exception:  # noqa: BLE001 — producer is best-effort
+            self.snapshot_failures += 1
+            logger.exception("snapshot at height %d failed", h)
+            return None
+
+    def snapshot(self, state) -> int:
+        """Export a snapshot at state.last_block_height. Returns the
+        height. Raises on apps without snapshot support or a block store
+        that cannot serve the height."""
+        t0 = time.perf_counter()
+        h = state.last_block_height
+        app_state = self.app.snapshot()
+        if app_state is None:
+            raise ValueError(f"{type(self.app).__name__} does not support snapshots")
+        payload = encode_payload(build_payload(state, app_state, self.block_store))
+        chunks = chunk_payload(payload, self.chunk_size)
+        manifest = Manifest(
+            height=h,
+            chain_id=state.chain_id,
+            chunk_size=self.chunk_size,
+            total_bytes=len(payload),
+            chunk_digests=self._chunk_digests(chunks),
+            header_hash=state.last_block_id.hash,
+            app_hash=state.app_hash,
+        )
+        self.store.save(manifest, chunks)
+        self.store.prune(self.keep_recent)
+        self.snapshots_taken += 1
+        self.last_snapshot_height = h
+        self.last_snapshot_seconds = round(time.perf_counter() - t0, 4)
+        logger.info(
+            "snapshot at height %d: %d chunk(s), %d bytes, root %s (%.1f ms)",
+            h, manifest.chunks, len(payload), manifest.root.hex()[:12],
+            self.last_snapshot_seconds * 1000,
+        )
+        return h
+
+    def stats(self) -> dict:
+        return {
+            "interval": self.interval,
+            "snapshots_taken": self.snapshots_taken,
+            "snapshot_failures": self.snapshot_failures,
+            "last_snapshot_height": self.last_snapshot_height,
+            "last_snapshot_seconds": self.last_snapshot_seconds,
+            **self.store.stats(),
+        }

@@ -70,6 +70,10 @@ class BlockStoreRPC:
     def height(self) -> int:
         raise NotImplementedError
 
+    def base(self) -> int:
+        """Lowest servable height (round 10: >1 after prune/restore)."""
+        raise NotImplementedError
+
     def load_block_meta(self, height: int):
         raise NotImplementedError
 

@@ -259,3 +259,80 @@ def test_advance_rejects_unchained_header():
     lc = LightClient(stub, CHAIN, old_set.copy())
     with pytest.raises(LightClientError, match="does not chain"):
         lc.advance(3)
+
+
+# -- >1/3 validator-set turnover (the statesync restore trust path) ----------
+#
+# A snapshot restore light-walks from its trust anchor to the snapshot
+# height, so it must survive validator-set changes where MORE THAN A
+# THIRD of the set turns over in one height — beyond the classic
+# bisection skip-verify limit, fine for the sequential rule as long as
+# the surviving old validators still carry > 2/3 of the OLD set's power
+# on the transition commit (rpc/light.py _check_old_set_overlap).
+
+
+def test_advance_accepts_over_one_third_turnover():
+    """Old set {v1:7, v2:2}; the new set keeps only v1 and adds two
+    newcomers holding 40/47 of the new power — way past 1/3 turnover.
+    v1 alone carries 7/9 > 2/3 of the OLD power, so the sequential rule
+    adopts the set; the walk then continues under it."""
+    pv1, pv2, pv3, pv4 = _pv(), _pv(), _pv(), _pv()
+    v1 = Validator.new(pv1.get_pub_key(), 7)
+    old_set = ValidatorSet([v1.copy(), Validator.new(pv2.get_pub_key(), 2)])
+    privs = {pv.get_address(): pv for pv in (pv1, pv2, pv3, pv4)}
+
+    stub = StubClient()
+    prev_id = None
+    for h in (1, 2):
+        hd = _header(h, old_set, prev_id)
+        stub.add_height(hd, _commit_for(hd, old_set, privs), old_set)
+        prev_id = BlockID(hd.hash(), PartSetHeader(1, b"\x01" * 20))
+
+    new_set = ValidatorSet([
+        v1.copy(),
+        Validator.new(pv3.get_pub_key(), 20),
+        Validator.new(pv4.get_pub_key(), 20),
+    ])
+    hd3 = _header(3, new_set, prev_id)
+    stub.add_height(hd3, _commit_for(hd3, new_set, privs), new_set)
+    prev_id = BlockID(hd3.hash(), PartSetHeader(1, b"\x01" * 20))
+    # one more height under the NEW set: trust must keep walking
+    hd4 = _header(4, new_set, prev_id)
+    stub.add_height(hd4, _commit_for(hd4, new_set, privs), new_set)
+
+    lc = LightClient(stub, CHAIN, old_set.copy())
+    lc.advance(4)
+    assert lc.height == 4
+    assert lc.validators.hash() == new_set.hash()
+
+
+def test_advance_rejects_exactly_two_thirds_old_overlap():
+    """The overlap rule is STRICTLY greater than 2/3: a transition where
+    the surviving old validators carry exactly 2/3 of the old power must
+    be refused (the boundary an attacker holding 1/3 of the old keys
+    would otherwise exploit)."""
+    pv1, pv2 = _pv(), _pv()
+    v1 = Validator.new(pv1.get_pub_key(), 2)
+    old_set = ValidatorSet([v1.copy(), Validator.new(pv2.get_pub_key(), 1)])
+    privs = {pv1.get_address(): pv1, pv2.get_address(): pv2}
+
+    stub = StubClient()
+    prev_id = None
+    for h in (1, 2):
+        hd = _header(h, old_set, prev_id)
+        stub.add_height(hd, _commit_for(hd, old_set, privs), old_set)
+        prev_id = BlockID(hd.hash(), PartSetHeader(1, b"\x01" * 20))
+
+    # v2 (1/3 of old power) is dropped; only v1 (exactly 2/3) survives to
+    # sign. The attacker dominates the new set so ITS +2/3 tally passes.
+    atk = _pv()
+    privs[atk.get_address()] = atk
+    new_set = ValidatorSet([v1.copy(), Validator.new(atk.get_pub_key(), 100)])
+    hd3 = _header(3, new_set, prev_id)
+    stub.add_height(hd3, _commit_for(hd3, new_set, privs), new_set)
+
+    lc = LightClient(stub, CHAIN, old_set.copy())
+    with pytest.raises(LightClientError, match="signed only 2/3"):
+        lc.advance(3)
+    assert lc.validators.hash() == old_set.hash()
+    assert lc.height == 2

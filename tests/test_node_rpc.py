@@ -162,6 +162,9 @@ def test_commit_missing_meta_is_rpc_error():
         def height(self):
             return 5
 
+        def base(self):
+            return 1
+
         def load_block_meta(self, h):
             return None
 

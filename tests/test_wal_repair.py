@@ -161,10 +161,18 @@ class TestCorruptionSchedules:
 
 
 class TestRotationBoundary:
-    def test_corrupt_middle_chunk_quarantines_later_chunks(self, tmp_path):
+    def test_corrupt_middle_chunk_quarantines_later_chunks(
+        self, tmp_path, monkeypatch
+    ):
         """With a tiny chunk size the log spans several chunks; damage in a
         middle chunk truncates there AND moves every later chunk out of
-        the group (ordering past a hole is unprovable)."""
+        the group (ordering past a hole is unprovable).
+
+        Bit rot in a chunk an earlier synced flush covered is outside the
+        crash model the clean watermark optimizes for (round 10), so this
+        runs under the forensics knob — the full-history scan whose
+        quarantine semantics this test pins."""
+        monkeypatch.setenv("TENDERMINT_WAL_DEEP_SCAN", "1")
         base = str(tmp_path / "rot" / "wal")
         _build_wal(base, n=12, chunk_size=256)
         from tendermint_tpu.libs.autofile import Group
@@ -219,11 +227,16 @@ class TestRotationBoundary:
         assert w.stats()["repairs"] == 1
         w.group.close()
 
-    def test_zero_byte_chunk_is_clean_not_redamaged(self, tmp_path):
+    def test_zero_byte_chunk_is_clean_not_redamaged(self, tmp_path, monkeypatch):
         """A prior repair can truncate a chunk to 0 bytes (damage at its
         magic). Later opens must treat that empty chunk as clean — NOT
         re-flag it and quarantine every newer chunk (which would discard
-        freshly fsynced records and #ENDHEIGHTs written since)."""
+        freshly fsynced records and #ENDHEIGHTs written since).
+
+        Runs under the forensics knob: the in-place magic destruction is
+        historical-chunk rot the clean watermark deliberately skips, and
+        the zero-byte-chunk invariant it pins belongs to the full scan."""
+        monkeypatch.setenv("TENDERMINT_WAL_DEEP_SCAN", "1")
         base = str(tmp_path / "z" / "wal")
         _build_wal(base, n=12, chunk_size=256)
         from tendermint_tpu.libs.autofile import Group
@@ -627,3 +640,113 @@ class TestLegacyDetection:
         assert w.stats()["format"] == 2 and w.stats()["repairs"] == 1
         assert _corrupt_backups(base), "damaged bytes must survive as backup"
         w.group.close()
+
+
+class TestCleanWatermark:
+    """Round 10: the `<wal>.clean` sidecar bounds the open-time deep scan
+    to bytes written since the last synced flush (ROADMAP's O(total
+    history) open item). The watermark may only ever TRAIL durability —
+    every test here checks either the skip or the fallback to the full
+    scan when the sidecar and the files disagree."""
+
+    def test_clean_close_skips_covered_history(self, tmp_path):
+        base = str(tmp_path / "wm" / "wal")
+        _build_wal(base, n=12, chunk_size=256)
+        from tendermint_tpu.libs.autofile import Group
+
+        n_rotated = len(Group.list_chunks(base)) - 1
+        assert n_rotated >= 2
+        assert os.path.exists(base + ".clean")
+        w = WAL(base)
+        s = w.stats()
+        assert s["repairs"] == 0
+        assert s["scan_skipped_chunks"] == n_rotated
+        assert s["scan_skipped_bytes"] > 0
+        # the skipped history still serves reads and the marker search
+        assert w.lines_after_height(12) == []
+        w.group.close()
+
+    def test_skipped_open_counts_records_like_a_full_scan(self, tmp_path):
+        base = str(tmp_path / "cnt" / "wal")
+        _build_wal(base, n=9, chunk_size=256)
+        fast = WAL(base)
+        fast.group.close()
+        os.environ["TENDERMINT_WAL_DEEP_SCAN"] = "1"
+        try:
+            full = WAL(base)
+            full.group.close()
+        finally:
+            del os.environ["TENDERMINT_WAL_DEEP_SCAN"]
+        assert full.stats()["scan_skipped_chunks"] == 0
+        assert fast._records_at_open == full._records_at_open
+
+    def test_tear_past_watermark_still_repaired(self, tmp_path):
+        """The crash window the watermark leaves open is bytes after the
+        last synced flush — a tear there must still be found and cut,
+        WITHOUT rescanning the covered chunks."""
+        base = str(tmp_path / "tear" / "wal")
+        _build_wal(base, n=12, chunk_size=256)
+        from tendermint_tpu.libs.autofile import Group
+
+        n_rotated = len(Group.list_chunks(base)) - 1
+        before = WAL(base)
+        n_before = len(before.read_all_lines())
+        before.group.close()
+        with open(base, "ab") as f:
+            f.write(b"\x00\x00\x00\x00\x00\x00\x00\x00torn post-flush bytes")
+        w = WAL(base)
+        s = w.stats()
+        assert s["repairs"] == 1 and s["truncated_bytes"] > 0
+        assert s["scan_skipped_chunks"] == n_rotated, "repair rescanned history"
+        assert len(w.read_all_lines()) == n_before
+        assert _corrupt_backups(base)
+        # repair dropped the sidecar: the next open deep-scans until a
+        # synced flush rebuilds it
+        assert not os.path.exists(base + ".clean")
+        w.group.close()
+
+    def test_watermark_past_actual_size_falls_back_to_full_scan(self, tmp_path):
+        """Fsynced bytes that VANISH (fs rollback, hand-edit) invalidate
+        the sidecar — the open must notice and deep-scan everything."""
+        base = str(tmp_path / "lost" / "wal")
+        _build_wal(base, n=12, chunk_size=256)
+        with open(base, "r+b") as f:
+            f.truncate(max(os.path.getsize(base) - 3, 0))
+        w = WAL(base)
+        s = w.stats()
+        assert s["scan_skipped_chunks"] == 0 and s["scan_skipped_bytes"] == 0
+        assert s["repairs"] == 1  # the torn tail record was cut
+        w.group.close()
+
+    def test_garbage_sidecar_is_ignored_not_fatal(self, tmp_path):
+        base = str(tmp_path / "junk" / "wal")
+        _build_wal(base, n=6)
+        for junk in (b"", b"not json", b'{"chunk_index": "x"}',
+                     b'{"chunk_index": -1, "offset": 8, "records": 1}'):
+            with open(base + ".clean", "wb") as f:
+                f.write(junk)
+            w = WAL(base)
+            s = w.stats()
+            assert s["repairs"] == 0
+            assert s["scan_skipped_bytes"] == 0, junk
+            w.group.close()
+
+    def test_mid_run_crash_image_keeps_rotated_chunks_skipped(self, tmp_path):
+        """Without a clean stop (the crash case) the sidecar persisted at
+        the last rotation crossing still covers the rotated history; only
+        the newer bytes deep-scan on restart."""
+        base = str(tmp_path / "crash" / "wal")
+        w = WAL(base, flush_interval_s=60.0, chunk_size=256)
+        w.start()
+        for i in range(12):
+            w.save(WALMessage.timeout(TimeoutInfo(1.0 + i, 1 + i, 0, 3)))
+            w.write_end_height(i + 1)
+        n_records = len(w.read_all_lines())
+        w.group.close()  # no stop(): simulates a crash
+        assert os.path.exists(base + ".clean")
+        r = WAL(base)
+        s = r.stats()
+        assert s["repairs"] == 0
+        assert s["scan_skipped_chunks"] >= 1
+        assert r._records_at_open == n_records
+        r.group.close()
