@@ -153,6 +153,18 @@ def main() -> None:
         assert recovery <= MAX_RECOVERY_S, (
             f"recovery {recovery:.2f}s exceeds the {MAX_RECOVERY_S}s floor"
         )
+        # round 11: the kill schedule is SCRAPE-visible — assert on the
+        # telemetry registry's exported counters (the surface GET
+        # /metrics serves), not by reaching into the harness objects
+        from tendermint_tpu.libs import telemetry
+
+        scraped = {
+            f.name: f.samples[0][2]
+            for f in telemetry.default_registry().collect() if f.samples
+        }
+        assert scraped.get("faults_kill", 0) >= N_KILLS, scraped
+        assert scraped.get("faults_supervisor_kills", 0) >= N_KILLS
+        assert scraped.get("faults_supervisor_restarts", 0) >= N_KILLS
     finally:
         sup.stop()
         gateway.reset_devd_breaker()

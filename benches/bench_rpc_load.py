@@ -6,7 +6,11 @@ Boots a real `tendermint_tpu.cli node` process (kvstore, ephemeral home),
 opens the /websocket endpoint, streams BENCH_RPC_TXS broadcast_tx_async
 frames while a drain thread counts acceptances, and measures:
 - accepted tx/s through the full RPC + mempool ingress path,
-- block/commit progress while under load (the node must keep committing).
+- block/commit progress while under load (the node must keep committing),
+- round 11: Prometheus scrape cost — GET /metrics hammered concurrently
+  with the load (latency p50/max, >= 40 families, one consensus_trace
+  pulled, consensus height_seconds not moved by the scrapes; the row
+  merges into BENCH_r11.json beside bench_telemetry's sections).
 
 Prints ONE JSON line like the other benches. Run from the repo root.
 """
@@ -26,6 +30,23 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 N_TXS = int(os.environ.get("BENCH_RPC_TXS", "5000"))
 RPC_PORT = int(os.environ.get("BENCH_RPC_PORT", "47321"))
+N_SCRAPES = int(os.environ.get("BENCH_RPC_SCRAPES", "100"))
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _scrape(port: int) -> tuple[float, int]:
+    """(seconds, family count) for one GET /metrics Prometheus scrape."""
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10
+    ) as r:
+        assert r.headers["Content-Type"].startswith(
+            "text/plain; version=0.0.4"
+        ), r.headers["Content-Type"]
+        text = r.read().decode()
+    dt = time.perf_counter() - t0
+    fams = sum(1 for l in text.splitlines() if l.startswith("# TYPE "))
+    return dt, fams
 
 
 def _status(port: int) -> dict | None:
@@ -92,6 +113,28 @@ def main() -> int:
         th = threading.Thread(target=drain, daemon=True)
         th.start()
 
+        # scrape-cost row (round 11): a Prometheus scraper hammers GET
+        # /metrics WHILE the broadcast load runs — a scrape must be an
+        # O(gauges) render, never something that stalls consensus or the
+        # ingress path. Latencies recorded; liveness judged below.
+        scrape_times: list[float] = []
+        scrape_fams = {"n": 0}
+        scrape_errs = {"n": 0}
+        scrape_stop = threading.Event()
+
+        def scraper():
+            while not scrape_stop.is_set() and len(scrape_times) < N_SCRAPES:
+                try:
+                    dt, fams = _scrape(RPC_PORT)
+                    scrape_times.append(dt)
+                    scrape_fams["n"] = fams
+                except Exception:  # noqa: BLE001 — counted, judged after
+                    scrape_errs["n"] += 1
+                time.sleep(0.02)
+
+        scraper_th = threading.Thread(target=scraper, daemon=True)
+        scraper_th.start()
+
         t0 = time.perf_counter()
         for i in range(N_TXS):
             tx = b"load-%06d=v" % i
@@ -101,6 +144,10 @@ def main() -> int:
             }).encode())
         assert done.wait(300), "response drain stalled"
         elapsed = time.perf_counter() - t0
+        # finish the scrape quota against the still-running node, then
+        # read the liveness gauges the scrape must not have moved
+        scraper_th.join(timeout=60)
+        scrape_stop.set()
         # liveness: the flooded txs must land in blocks — on a 1-core box
         # the burst can starve consensus DURING the load window, so allow
         # a post-load commit window before judging
@@ -113,10 +160,68 @@ def main() -> int:
                 if blocks > 0:
                     break
             time.sleep(1.0)
+        # scrape row judgment: every scrape answered, the family bar
+        # held, one consensus_trace pulls, and consensus liveness did
+        # not degrade under the scrape+broadcast overlap (a scrape that
+        # stalled the receive routine would blow height_seconds_max out
+        # to the stall length — tens of seconds, not this bound)
+        assert scrape_errs["n"] == 0, f"{scrape_errs['n']} scrapes failed"
+        assert len(scrape_times) >= min(N_SCRAPES, 20), len(scrape_times)
+        assert scrape_fams["n"] >= 40, f"{scrape_fams['n']} families"
+        ordered = sorted(scrape_times)
+        scrape_p50 = ordered[len(ordered) // 2]
+        scrape_max = ordered[-1]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{RPC_PORT}/",
+            data=json.dumps({"method": "metrics", "params": {},
+                             "id": 9}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            m = json.loads(r.read().decode())["result"]
+        assert m["consensus_height_seconds_max"] < 15.0, (
+            "consensus stalled under scrape load: "
+            f"height_seconds_max={m['consensus_height_seconds_max']}"
+        )
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{RPC_PORT}/",
+            data=json.dumps({"method": "consensus_trace",
+                             "params": {"last": 1}, "id": 10}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            traces = json.loads(r.read().decode())["result"]["traces"]
+        assert traces and traces[0]["segments"], "no trace under load"
+        scrape_row = {
+            "scrapes": len(scrape_times),
+            "families": scrape_fams["n"],
+            "scrape_ms_p50": round(scrape_p50 * 1000, 2),
+            "scrape_ms_max": round(scrape_max * 1000, 2),
+            "height_seconds_last": m["consensus_height_seconds_last"],
+            "height_seconds_max": m["consensus_height_seconds_max"],
+            "blocks_committed_during_load": blocks,
+            "note": (
+                "GET /metrics hammered concurrently with the ws "
+                "broadcast burst; height_seconds_max < 15s asserted "
+                "(a scrape-induced stall would dwarf it)"
+            ),
+        }
         ws.close()
 
         assert accepted["err"] == 0, f"{accepted['err']} tx rejected"
         assert blocks > 0, "node stopped committing under RPC load"
+        # merge into BENCH_r11.json beside bench_telemetry's sections
+        record_path = os.path.join(ROOT, "BENCH_r11.json")
+        try:
+            with open(record_path) as f:
+                record = json.load(f)
+        except (OSError, ValueError):
+            record = {}
+        record["rpc_scrape"] = scrape_row
+        record.setdefault("metric", "telemetry plane: scrape cost")
+        with open(record_path, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
         print(json.dumps({
             "metric": "rpc_ws_broadcast_tx_per_sec",
             "value": round(N_TXS / elapsed, 1),
@@ -128,6 +233,7 @@ def main() -> int:
                 "blocks_committed_during_load": blocks,
                 "transport": "websocket (RFC6455, JSON-RPC frames)",
                 "app": "kvstore(local)",
+                "scrape": scrape_row,
             },
         }))
         return 0

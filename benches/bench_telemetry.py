@@ -1,0 +1,287 @@
+"""Telemetry-plane bench + smoke (round 11): the instrumentation must
+never silently tax the planes it watches, and the exposition surface
+must actually serve scrapers.
+
+Rows (written to BENCH_r11.json under "telemetry"; the rpc-load bench
+owns the "rpc_scrape" section of the same file):
+
+- observe_ns:   raw Histogram.observe cost (the hot-path primitive the
+                devd/WAL/mempool instruments pay per event)
+- gate_overhead: the mempool signed-burst gate (the `5_mempool` shape —
+                SigBatcher -> gateway verify). ASSERTED < 2%: the bound
+                is computed as (instrument events the burst actually
+                executed) x (micro-measured worst-case per-event cost,
+                with a 3x safety margin) / burst wall time — an UPPER
+                bound on the instrumentation tax that stays meaningful
+                on this 2-core box, where end-to-end A/B deltas swing
+                +-20% run to run (the raw enabled-vs-disabled
+                interleaved timings are recorded beside it as context,
+                not asserted — measuring a real <0.1% delta through
+                that noise would be a coin flip, and a guard that
+                flakes is a guard that gets deleted). A regression that
+                adds per-TX instrumentation (2048 events instead of 4)
+                or a slow observe (lock convoy) moves the asserted
+                bound by orders of magnitude and fails loudly.
+- node_smoke:   boot a real kvstore node, scrape GET /metrics (valid
+                0.0.4 text, >= 40 families spanning every plane), pull
+                one consensus_trace and assert its segments sum to the
+                height's wall clock within 5%
+
+BENCH_TELEMETRY_SMOKE=1 shrinks the burst for the ~15 s tier-1 gate
+(`make metrics-smoke`); the smoke asserts but never writes (the
+bench_partset convention). Prints ONE JSON line. Run from the repo root.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+SMOKE = os.environ.get("BENCH_TELEMETRY_SMOKE", "") == "1"
+N_SIGNED = int(os.environ.get(
+    "BENCH_TELEMETRY_TXS", "2048" if SMOKE else "4096"
+))
+REPEATS = int(os.environ.get("BENCH_TELEMETRY_REPEATS",
+                             "4" if SMOKE else "5"))
+MAX_OVERHEAD_PCT = float(os.environ.get(
+    "BENCH_TELEMETRY_MAX_OVERHEAD_PCT", "2.0"
+))
+MIN_FAMILIES = int(os.environ.get("BENCH_TELEMETRY_MIN_FAMILIES", "40"))
+
+
+def bench_observe_ns() -> dict:
+    """Raw instrument cost: one labeled + one bare observe."""
+    from tendermint_tpu.libs import telemetry
+
+    reg = telemetry.Registry()
+    h = reg.histogram("bench_seconds")
+    n = 200_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        h.observe(0.001)
+    bare = (time.perf_counter() - t0) / n * 1e9
+    hl = reg.histogram("bench_labeled_seconds", labelnames=("op",))
+    child = hl.labels(op="verify")
+    t0 = time.perf_counter()
+    for i in range(n):
+        child.observe(0.001)
+    labeled = (time.perf_counter() - t0) / n * 1e9
+    return {
+        "observe_ns": round(bare, 1),
+        "observe_labeled_child_ns": round(labeled, 1),
+        "n": n,
+    }
+
+
+def _gate_burst_once(txs, want: int) -> tuple[float, int]:
+    """One mempool signed-burst gate pass (the 5_mempool clean shape);
+    returns (elapsed seconds, instrument observes executed)."""
+    from tendermint_tpu.abci.apps.signedkv import SignedKVStoreApp, parse_sig_tx
+    from tendermint_tpu.abci.client import LocalClient
+    from tendermint_tpu.config import test_config
+    from tendermint_tpu.mempool import Mempool
+    from tendermint_tpu.mempool.mempool import SigBatcher
+    from tendermint_tpu.ops.gateway import Verifier
+    from tendermint_tpu.proxy.app_conn import AppConnMempool
+
+    cfg = test_config().mempool
+    cfg.root_dir = tempfile.mkdtemp(prefix="bench-telemetry-gate-")
+    app = SignedKVStoreApp(verify_in_app=False)
+    verifier = Verifier(min_tpu_batch=32)
+    batcher = SigBatcher(verifier, parse_sig_tx, max_batch=512,
+                         max_wait_s=0.002)
+    mp = Mempool(cfg, AppConnMempool(LocalClient(app, threading.RLock())),
+                 sig_batcher=batcher)
+    # warm the verify path off the clock
+    verifier.verify_batch([parse_sig_tx(t) for t in txs[:256]])
+    observes0 = batcher._batch_hist.count
+    t0 = time.perf_counter()
+    for tx in txs:
+        mp.check_tx(tx)
+    deadline = time.perf_counter() + 120.0
+    while mp.size() != want:
+        assert time.perf_counter() < deadline, \
+            f"gate drain stalled at {mp.size()}/{want}"
+        mp.flush_app_conn()
+        time.sleep(0.002)
+    elapsed = time.perf_counter() - t0
+    batcher.stop()
+    return elapsed, batcher._batch_hist.count - observes0
+
+
+def bench_gate_overhead(observe_row: dict) -> dict:
+    """The histogram-overhead guard (module docstring has the method):
+    asserted bound = events x 3x-margined per-event cost / wall time;
+    the interleaved enabled/disabled end-to-end timings ride along as
+    unasserted context."""
+    from tendermint_tpu.abci.apps.signedkv import make_sig_tx
+    from tendermint_tpu.libs import telemetry
+
+    seeds = [bytes([i + 1]) * 32 for i in range(64)]
+    txs = [
+        make_sig_tx(seeds[i % 64], b"tk%06d=v%d" % (i, i))
+        for i in range(N_SIGNED)
+    ]
+    on_s, off_s = float("inf"), float("inf")
+    observes = 0
+    for i in range(REPEATS):
+        # alternate arm ORDER each repeat: box-load drift (this is a
+        # 2-core box; anything else running lands on the bench) must
+        # not systematically favor one arm's min
+        order = (True, False) if i % 2 == 0 else (False, True)
+        for on in order:
+            telemetry.set_enabled(on)
+            try:
+                t, n_obs = _gate_burst_once(txs, N_SIGNED)
+            finally:
+                telemetry.set_enabled(True)
+            if on:
+                on_s = min(on_s, t)
+                observes = max(observes, n_obs)
+            else:
+                off_s = min(off_s, t)
+    assert observes >= 1, "instrumented burst recorded no observes"
+    # worst-case per-event cost: the slower of the bare/labeled observe
+    # micro-measurements, tripled for margin, + ~200ns for the two
+    # perf_counter reads bracketing each observe
+    per_event_ns = 3.0 * max(observe_row["observe_ns"],
+                             observe_row["observe_labeled_child_ns"]) + 200.0
+    overhead_pct = observes * per_event_ns / (on_s * 1e9) * 100.0
+    raw_delta_pct = (on_s - off_s) / off_s * 100.0
+    row = {
+        "shape": "5_mempool signed-burst gate (clean)",
+        "signed_txs": N_SIGNED,
+        "repeats_min_of": REPEATS,
+        "instrument_events": observes,
+        "per_event_cost_ns_3x_margin": round(per_event_ns, 1),
+        "overhead_pct_bound": round(overhead_pct, 4),
+        "max_overhead_pct_asserted": MAX_OVERHEAD_PCT,
+        "enabled_s": round(on_s, 4),
+        "disabled_s": round(off_s, 4),
+        "enabled_sigs_per_sec": round(N_SIGNED / on_s, 1),
+        "disabled_sigs_per_sec": round(N_SIGNED / off_s, 1),
+        "raw_ab_delta_pct_unasserted": round(raw_delta_pct, 2),
+    }
+    assert overhead_pct < MAX_OVERHEAD_PCT, (
+        f"hot-path instrumentation bound {overhead_pct:.3f}% "
+        f"(floor {MAX_OVERHEAD_PCT}%) on the mempool gate: {row}"
+    )
+    return row
+
+
+def bench_node_smoke() -> dict:
+    """Boot a node, scrape /metrics, pull a consensus_trace."""
+    from tendermint_tpu.config import reset_test_root
+    from tendermint_tpu.node import default_new_node
+    from tendermint_tpu.rpc.client import HTTPClient
+
+    home = tempfile.mkdtemp(prefix="bench-telemetry-node-")
+    cfg = reset_test_root(home)
+    cfg.base.proxy_app = "kvstore"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    node = default_new_node(cfg)
+    node.start()
+    try:
+        deadline = time.time() + 60
+        while node.block_store.height() < 2 and time.time() < deadline:
+            time.sleep(0.1)
+        assert node.block_store.height() >= 2, "node never committed"
+        url = f"http://127.0.0.1:{node.rpc_port()}"
+
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
+            ctype = r.headers["Content-Type"]
+            text = r.read().decode()
+        scrape_ms = (time.perf_counter() - t0) * 1000
+        assert ctype.startswith("text/plain; version=0.0.4"), ctype
+        families = {}
+        for line in text.strip().splitlines():
+            if line.startswith("# TYPE "):
+                _h, _t, name, kind = line.split()
+                families[name] = kind
+        assert len(families) >= MIN_FAMILIES, (
+            f"{len(families)} families < {MIN_FAMILIES}"
+        )
+        for fam in ("consensus_height", "wal_format", "gateway_breaker_state",
+                    "gateway_verify_tpu_sigs", "gateway_hash_tpu_leaves",
+                    "mempool_size", "statesync_snapshots", "fastsync_active",
+                    "p2p_peers_outbound"):
+            assert fam in families, f"missing family {fam}"
+        assert families["wal_fsync_seconds"] == "histogram"
+
+        client = HTTPClient(f"127.0.0.1:{node.rpc_port()}")
+        traces = client.consensus_trace(last=3)["traces"]
+        assert traces, "no consensus traces"
+        t = traces[0]
+        total = sum(t["segments"].values())
+        tol = max(0.05 * t["wall_s"], 0.005)
+        assert abs(total - t["wall_s"]) <= tol, (total, t["wall_s"])
+        assert "verify_cpu_sigs" in t["device"]
+        # flat RPC and scrape agree on the legacy gauge set
+        flat = client.metrics()
+        missing = [k for k in flat if k not in families]
+        assert not missing, f"scrape lost flat gauges: {missing[:8]}"
+        return {
+            "families": len(families),
+            "scrape_ms": round(scrape_ms, 2),
+            "flat_keys": len(flat),
+            "traced_heights": len(traces),
+            "trace_wall_s": t["wall_s"],
+            "trace_segments_sum_s": round(total, 6),
+        }
+    finally:
+        node.stop()
+
+
+def main() -> None:
+    observe_row = bench_observe_ns()
+    rows = {
+        "observe": observe_row,
+        "gate_overhead": bench_gate_overhead(observe_row),
+        "node_smoke": bench_node_smoke(),
+    }
+    record_path = os.path.join(ROOT, "BENCH_r11.json")
+    if not SMOKE:
+        # merge-write: bench_rpc_load owns the "rpc_scrape" section of
+        # the same artifact (never clobber it)
+        try:
+            with open(record_path) as f:
+                record = json.load(f)
+        except (OSError, ValueError):
+            record = {}
+        record["recorded_at"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        )
+        record["metric"] = (
+            "telemetry plane: instrumentation overhead + exposition smoke"
+        )
+        record["telemetry"] = rows
+        with open(record_path, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+
+    print(json.dumps({
+        "metric": "telemetry_gate_overhead_pct",
+        "value": rows["gate_overhead"]["overhead_pct_bound"],
+        "unit": "%",
+        "vs_baseline": 1.0,  # host-path guard: no reference numbers exist
+        "detail": {
+            "families": rows["node_smoke"]["families"],
+            "scrape_ms": rows["node_smoke"]["scrape_ms"],
+            "observe_ns": rows["observe"]["observe_ns"],
+            "smoke": SMOKE,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,14 @@ Configs (BASELINE.md):
                   chunk verification on the sim transport (writes
                   BENCH_r10.json; chip-free rows asserted >=1.3x, the
                   live-daemon row auto-appends on a tunnel window)
+ 10 telemetry    — observability plane: hot-path instrumentation overhead
+                  on the mempool signed-burst gate (asserted <2%) +
+                  Prometheus exposition smoke (writes the "telemetry"
+                  section of BENCH_r11.json; chip-free)
+ 11 rpc_load     — ws broadcast burst against a live node + the round-11
+                  scrape-cost row: GET /metrics hammered under load must
+                  not move consensus height_seconds (writes the
+                  "rpc_scrape" section of BENCH_r11.json; chip-free)
 
 Each bench is its own process (the TPU is exclusive per process).
 Usage: python benches/run_all.py [--skip testnet,...]
@@ -50,6 +58,8 @@ BENCHES = {
     "7_chaos": [sys.executable, "benches/bench_chaos.py"],
     "8_wal": [sys.executable, "benches/bench_wal.py"],
     "9_statesync": [sys.executable, "benches/bench_statesync.py"],
+    "10_telemetry": [sys.executable, "benches/bench_telemetry.py"],
+    "11_rpc_load": [sys.executable, "benches/bench_rpc_load.py"],
 }
 
 

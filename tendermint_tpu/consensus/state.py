@@ -27,6 +27,7 @@ import time
 from dataclasses import dataclass
 
 from tendermint_tpu.consensus import messages as msgs
+from tendermint_tpu.consensus import trace as ctrace
 from tendermint_tpu.consensus.height_vote_set import HeightVoteSet
 from tendermint_tpu.consensus.round_state import RoundState, RoundStep
 from tendermint_tpu.consensus.ticker import TickerI, TimeoutInfo, TimeoutTicker
@@ -106,6 +107,13 @@ class ConsensusState(BaseService):
         self._height_started = time.monotonic()
         self.height_seconds_last = 0.0
         self.height_seconds_max = 0.0
+        # per-height trace spans (round 11): the liveness gauges say a
+        # height was slow, the recorder says WHERE the time went —
+        # step-partitioned wall clock + device-vs-CPU attribution,
+        # served by the consensus_trace RPC (consensus/trace.py)
+        self.trace = ctrace.TraceRecorder(
+            device_probe=self._trace_device_probe
+        )
 
         # duplicate-vote evidence (beyond reference: state.go:1438-1447
         # punts with a TODO; we record validated pairs — types/evidence)
@@ -140,6 +148,25 @@ class ConsensusState(BaseService):
 
     def get_round_state(self) -> RoundState:
         return self.rs  # single-writer; readers treat as snapshot
+
+    def _trace_device_probe(self) -> dict:
+        """Gateway counter snapshot for per-height device attribution
+        (consensus/trace.py): how many verify sigs / hash leaves this
+        height ran on-device vs on the CPU fallback, and the breaker
+        state bracketing it. breaker_state -1 = no breaker (not the devd
+        route)."""
+        v = self.verifier.stats()
+        h = self.part_hasher.stats()
+        return {
+            "verify_tpu_sigs": v.get("tpu_sigs", 0),
+            "verify_cpu_sigs": v.get("cpu_sigs", 0),
+            "hash_tpu_leaves": h.get("tpu_leaves", 0),
+            "hash_cpu_leaves": h.get("cpu_leaves", 0),
+            "breaker_opens": v.get("breaker_opens",
+                                   h.get("breaker_opens", 0)),
+            "breaker_state": v.get("breaker_state",
+                                   h.get("breaker_state", -1)),
+        }
 
     def is_proposer(self) -> bool:
         proposer = self.rs.validators.get_proposer()
@@ -181,6 +208,7 @@ class ConsensusState(BaseService):
         # fast-sync/handshake/idle time and pins height_seconds_max to a
         # number that never measured a consensus round
         self._height_started = time.monotonic()
+        self.trace.begin(self.rs.height, now=self._height_started)
         self.schedule_round_0(self.rs)
 
     def start_routines(self, max_steps: int = 0) -> None:
@@ -195,6 +223,7 @@ class ConsensusState(BaseService):
         )
         self._thread.start()
         self._height_started = time.monotonic()  # see on_start
+        self.trace.begin(self.rs.height, now=self._height_started)
 
     # soft cap on peer-originated messages waiting in _inputs: beyond it
     # the PEER forwarder drops instead of growing the combined queue
@@ -405,6 +434,10 @@ class ConsensusState(BaseService):
         if self.wal is not None:
             self.wal.save(WALMessage.event_round_state(rs_event))
         self.n_steps += 1
+        # step transitions drive the height trace's segment clock
+        # (single-writer: only this receive routine marks)
+        self.trace.mark(ctrace.step_segment(self.rs.step))
+        self.trace.note_round(self.rs.round_)
         if self.evsw is not None:
             self.evsw.fire_event(tev.EVENT_NEW_ROUND_STEP, rs_event)
 
@@ -724,21 +757,28 @@ class ConsensusState(BaseService):
             self.logger.error("propose without last commit (+2/3 missing)")
             return None, None
         txs = self.mempool.reap(self.config.max_block_size_txs)
-        return Block.make_block(
-            height=rs.height,
-            chain_id=self.state.chain_id,
-            txs=txs,
-            commit=commit,
-            prev_block_id=self.state.last_block_id,
-            val_hash=self.state.validators.hash(),
-            app_hash=self.state.app_hash,
-            part_size=self.state.params().block_gossip.block_part_size_bytes,
-            part_hasher=self.part_hasher.part_leaf_hashes,
-            # proposal part sets: leaf digests + the whole proof tree in
-            # one offload pass when the hash plane serves (devd
-            # hash_stream tree frame); None -> the flat host builder
-            part_tree_hasher=self.part_hasher.part_set_tree,
-        )
+        t0 = time.perf_counter()
+        try:
+            return Block.make_block(
+                height=rs.height,
+                chain_id=self.state.chain_id,
+                txs=txs,
+                commit=commit,
+                prev_block_id=self.state.last_block_id,
+                val_hash=self.state.validators.hash(),
+                app_hash=self.state.app_hash,
+                part_size=self.state.params().block_gossip.block_part_size_bytes,
+                part_hasher=self.part_hasher.part_leaf_hashes,
+                # proposal part sets: leaf digests + the whole proof tree in
+                # one offload pass when the hash plane serves (devd
+                # hash_stream tree frame); None -> the flat host builder
+                part_tree_hasher=self.part_hasher.part_set_tree,
+            )
+        finally:
+            # overlapping attribution: block build (part hashing + tx
+            # root) happens INSIDE the propose segment, so it rides the
+            # trace's aux table, never the segment sum
+            self.trace.note("part_hash_s", time.perf_counter() - t0)
 
     # -- step: prevote -----------------------------------------------------
 
@@ -967,6 +1007,10 @@ class ConsensusState(BaseService):
             "finalizing commit of block %d: hash=%s txs=%d",
             height, block.hash().hex()[:12], block.header.num_txs,
         )
+        # trace: the commit-wait segment ends here; the finalize
+        # sub-phases (save -> apply -> snapshot hook -> events) partition
+        # the rest of the height's wall time
+        self.trace.mark("block_save")
 
         fail_point()
 
@@ -983,6 +1027,7 @@ class ConsensusState(BaseService):
 
         fail_point()
 
+        self.trace.mark("apply")
         state_copy = self.state.copy()
         event_cache = EventCache(self.evsw) if self.evsw is not None else _NullCache()
         sm.apply_block(
@@ -997,6 +1042,7 @@ class ConsensusState(BaseService):
 
         fail_point()
 
+        self.trace.mark("snapshot_hook")
         if self.post_apply_hook is not None and not self.replay_mode:
             # snapshot production rides here: state_copy is the post-H
             # state and the app just committed H — best-effort, a
@@ -1007,6 +1053,7 @@ class ConsensusState(BaseService):
                 self.logger.exception("post-apply hook failed at %d", height)
 
         # events: NewBlock/NewBlockHeader + cached tx events, post-commit
+        self.trace.mark("events")
         if self.evsw is not None:
             self.evsw.fire_event(tev.EVENT_NEW_BLOCK, tev.EventDataNewBlock(block))
             self.evsw.fire_event(
@@ -1022,6 +1069,11 @@ class ConsensusState(BaseService):
             self.height_seconds_max, self.height_seconds_last
         )
         self._height_started = now
+        # seal this height's trace on the SAME clock reading the gauge
+        # used (segments must sum to height_seconds_last), then start
+        # the next height's
+        self.trace.finish(height, self.height_seconds_last, now=now)
+        self.trace.begin(height + 1, now=now)
 
         self.update_to_state(state_copy)
         self.done_height.set()

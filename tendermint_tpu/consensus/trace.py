@@ -1,0 +1,198 @@
+"""Per-height consensus trace spans (round 11).
+
+``consensus_height_seconds_last`` says a height was slow; it never said
+WHERE the wall time went. Every latency-overlap lever on the ROADMAP
+(big-committee batch verify, pipelined execution, sharded device plane)
+needs exactly that breakdown, so the receive routine now attributes each
+committed height's wall clock to named segments:
+
+    new_height -> new_round -> propose -> prevote -> prevote_wait ->
+    precommit -> precommit_wait -> commit (waiting for the full block)
+    -> block_save -> apply -> snapshot_hook -> events
+
+The step segments fall out of the existing ``new_step`` transitions (the
+receive routine is the single writer, so marks are lock-free); the
+finalize sub-phases are marked explicitly in ``finalize_commit``. The
+segments PARTITION the height's wall time — they sum to the same clock
+``height_seconds_last`` reads (the consensus_trace RPC contract asserts
+within 5%). Auxiliary attributions that OVERLAP segments (part hashing
+inside propose) ride ``aux`` and never enter the sum.
+
+Device attribution: the recorder snapshots the verify/hash gateway
+counters and breaker state at height start and commit, so each trace
+carries the height's device-vs-CPU split — a breaker-open height
+visibly attributes its verify/hash work to the CPU fallback (the chaos
+tier asserts this).
+
+Completed traces land in a ring buffer (TENDERMINT_TRACE_RING, default
+128) served by the ``consensus_trace`` RPC and the operator CLI
+``python -m tendermint_tpu.ops.trace``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from tendermint_tpu.consensus.round_state import RoundStep
+from tendermint_tpu.libs.envknob import env_number as _env_number
+
+# canonical segment order (display + docs/observability.md diagram)
+SEGMENTS = (
+    "new_height", "new_round", "propose", "prevote", "prevote_wait",
+    "precommit", "precommit_wait", "commit", "block_save", "apply",
+    "snapshot_hook", "events",
+)
+
+_STEP_SEGMENTS = {
+    RoundStep.NEW_HEIGHT: "new_height",
+    RoundStep.NEW_ROUND: "new_round",
+    RoundStep.PROPOSE: "propose",
+    RoundStep.PREVOTE: "prevote",
+    RoundStep.PREVOTE_WAIT: "prevote_wait",
+    RoundStep.PRECOMMIT: "precommit",
+    RoundStep.PRECOMMIT_WAIT: "precommit_wait",
+    RoundStep.COMMIT: "commit",
+}
+
+# device-probe keys differenced per height; anything else in the probe
+# dict records as <key>_start / <key>_end (state, not a counter)
+_DELTA_KEYS = (
+    "verify_tpu_sigs", "verify_cpu_sigs",
+    "hash_tpu_leaves", "hash_cpu_leaves",
+    "breaker_opens",
+)
+
+
+def step_segment(step: int) -> str:
+    return _STEP_SEGMENTS.get(step, "new_height")
+
+
+class HeightTrace:
+    """One committed height's wall-time breakdown. Immutable once built
+    (the ring hands references to RPC readers on other threads)."""
+
+    __slots__ = ("height", "segments", "aux", "device", "total_s",
+                 "wall_s", "rounds", "completed_at")
+
+    def __init__(self, height, segments, aux, device, wall_s, rounds):
+        self.height = height
+        self.segments = segments
+        self.aux = aux
+        self.device = device
+        self.total_s = sum(segments.values())
+        self.wall_s = wall_s
+        self.rounds = rounds
+        self.completed_at = time.time()
+
+    def to_json(self) -> dict:
+        return {
+            "height": self.height,
+            "rounds": self.rounds,
+            "wall_s": round(self.wall_s, 6),
+            "total_s": round(self.total_s, 6),
+            "segments": {k: round(v, 6) for k, v in self.segments.items()},
+            "aux": {k: round(v, 6) for k, v in self.aux.items()},
+            "device": dict(self.device),
+            "completed_at": self.completed_at,
+        }
+
+
+class TraceRecorder:
+    """Single-writer segment clock + ring of completed HeightTraces.
+
+    ``mark``/``note`` run only on the consensus receive routine and touch
+    no lock (lock-cheap by construction); ``finish`` seals the active
+    trace into the ring under the ring lock; ``last`` reads the ring from
+    RPC threads under the same lock."""
+
+    def __init__(self, device_probe=None, ring: int | None = None):
+        if ring is None:
+            ring = max(1, int(_env_number("TENDERMINT_TRACE_RING", 128,
+                                          cast=int)))
+        self._ring: deque[HeightTrace] = deque(maxlen=ring)
+        self._ring_mtx = threading.Lock()
+        self._device_probe = device_probe
+        self._height = 0
+        self._segments: dict[str, float] = {}
+        self._aux: dict[str, float] = {}
+        self._rounds = 0
+        self._cur = "new_height"
+        self._last_t = time.monotonic()
+        # finish()'s end snapshot doubles as the next begin()'s start —
+        # one probe per height boundary, not two back-to-back on the
+        # receive routine
+        self._dev_carry: dict | None = None
+        self._dev_start: dict = self._probe()
+
+    def _probe(self) -> dict:
+        if self._device_probe is None:
+            return {}
+        try:
+            return dict(self._device_probe())
+        except Exception:  # noqa: BLE001 — attribution must never wedge
+            # the receive routine; a failed probe costs one height's
+            # device split, nothing else
+            return {}
+
+    def begin(self, height: int, now: float | None = None) -> None:
+        """Start the clock for `height` (fresh segment table + device
+        snapshot)."""
+        self._height = height
+        self._segments = {}
+        self._aux = {}
+        self._rounds = 0
+        self._cur = "new_height"
+        self._last_t = now if now is not None else time.monotonic()
+        if self._dev_carry is not None:
+            self._dev_start, self._dev_carry = self._dev_carry, None
+        else:
+            self._dev_start = self._probe()
+
+    def mark(self, segment: str, now: float | None = None) -> None:
+        """Close the current segment at `now` and start `segment`.
+        Re-marking the current segment is a cheap no-op boundary."""
+        now = now if now is not None else time.monotonic()
+        dt = now - self._last_t
+        if dt > 0:
+            self._segments[self._cur] = self._segments.get(self._cur, 0.0) + dt
+        self._last_t = now
+        self._cur = segment
+
+    def note(self, key: str, seconds: float) -> None:
+        """Auxiliary overlapping attribution (e.g. part_hash_s inside
+        propose) — reported, never summed into the partition."""
+        self._aux[key] = self._aux.get(key, 0.0) + seconds
+
+    def note_round(self, round_: int) -> None:
+        self._rounds = max(self._rounds, round_ + 1)
+
+    def finish(self, height: int, wall_s: float,
+               now: float | None = None) -> HeightTrace:
+        """Seal the active trace (closing the open segment at `now`) and
+        push it onto the ring."""
+        self.mark("done", now=now)
+        end = self._probe()
+        self._dev_carry = end  # the next begin() starts from this reading
+        start = self._dev_start
+        device: dict = {}
+        for k in _DELTA_KEYS:
+            if k in end or k in start:
+                device[k] = end.get(k, 0) - start.get(k, 0)
+        for k in end:
+            if k not in _DELTA_KEYS:
+                device[f"{k}_start"] = start.get(k)
+                device[f"{k}_end"] = end.get(k)
+        tr = HeightTrace(height, dict(self._segments), dict(self._aux),
+                         device, wall_s, max(self._rounds, 1))
+        with self._ring_mtx:
+            self._ring.append(tr)
+        return tr
+
+    def last(self, n: int = 10) -> list[HeightTrace]:
+        """Newest-first slice of the completed-trace ring."""
+        n = max(1, int(n))
+        with self._ring_mtx:
+            items = list(self._ring)
+        return list(reversed(items))[:n]

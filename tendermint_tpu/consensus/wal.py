@@ -208,6 +208,23 @@ class WAL(BaseService):
             chunk_size = 64
         os.makedirs(os.path.dirname(wal_file) or ".", exist_ok=True)
 
+        # latency distributions (round 11): how long each group-commit
+        # fsync took and how many records it covered — the histograms
+        # the durability-policy knobs are tuned against (scrape-only;
+        # the flat wal_* gauges stay the legacy metrics-RPC surface)
+        from tendermint_tpu.libs import telemetry
+
+        reg = telemetry.default_registry()
+        self._fsync_hist = reg.histogram(
+            "wal_fsync_seconds",
+            "WAL group-commit fsync latency (one fsync per group)",
+        )
+        self._group_hist = reg.histogram(
+            "wal_group_records",
+            "records covered by one WAL group-commit fsync",
+            buckets=telemetry.size_buckets(16384),
+        )
+
         # gauges (exported as wal_* via the metrics RPC)
         self._records = 0
         self._fsyncs = 0
@@ -491,7 +508,10 @@ class WAL(BaseService):
                 covered = self._records_at_open + self._records
             if batch == 0:
                 return
+            t0 = time.perf_counter()
             self.group.flush(sync=True)
+            self._fsync_hist.observe(time.perf_counter() - t0)
+            self._group_hist.observe(batch)
             with self._wmtx:
                 self._account_sync(batch)
             if pos is not None:

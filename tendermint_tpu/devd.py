@@ -1231,6 +1231,37 @@ def set_socket_wrapper(wrapper) -> None:
     _socket_wrapper = wrapper
 
 
+# -- client latency distributions (round 11) ----------------------------------
+#
+# The counters above say HOW MUCH rode each transport; these histograms
+# say how LONG it took — the distributions the pipelining/sharding PRs
+# will be judged against (docs/observability.md). Process-wide (the devd
+# client is process-global), labeled by plane: op="verify" | "hash".
+
+_hist_cache: dict = {}
+
+
+def _latency_hists():
+    """(per-chunk stream wait, single-shot round trip) histograms off
+    the CURRENT default telemetry registry — re-fetched when tests swap
+    the registry, cached otherwise so the hot path pays a dict probe."""
+    from tendermint_tpu.libs import telemetry
+
+    reg = telemetry.default_registry()
+    if _hist_cache.get("reg") is not reg:
+        _hist_cache["chunk"] = reg.histogram(
+            "devd_stream_chunk_seconds",
+            "per-chunk result wait on an active devd stream (writer "
+            "overlap means this is the residual, not the full RTT)",
+            labelnames=("op",),
+        )
+        _hist_cache["single"] = reg.histogram(
+            "devd_single_shot_seconds",
+            "single-shot devd pickle round trip (whole batch)",
+            labelnames=("op",),
+        )
+        _hist_cache["reg"] = reg
+    return _hist_cache["chunk"], _hist_cache["single"]
 
 
 class DevdClient:
@@ -1380,8 +1411,12 @@ class DevdClient:
         return rep
 
     def verify_batch(self, items) -> list[bool]:
+        t0 = time.perf_counter()
         rep = self.request({"op": "verify", "items": list(items)},
                            timeout=self.io_timeout)
+        _latency_hists()[1].labels(op="verify").observe(
+            time.perf_counter() - t0
+        )
         if not rep.get("ok"):
             raise DevdError(rep.get("error", "verify failed"))
         return rep["results"]
@@ -1594,9 +1629,12 @@ class DevdClient:
     def _collect_stream(self, conn, writer, werr, n_chunks: int) -> list[bool]:
         import numpy as np
 
+        chunk_hist = _latency_hists()[0].labels(op="verify")
         out: list[bool] = []
         for want in range(n_chunks):
+            t0 = time.perf_counter()
             payload = _recv_raw_frame(conn)
+            chunk_hist.observe(time.perf_counter() - t0)
             status, idx = struct.unpack_from("<BI", payload, 0)
             if status == STREAM_ERR:
                 # the resolver's DevdError handler discards the conn and
@@ -1641,10 +1679,14 @@ class DevdClient:
     def hash_batch(self, items, mode: str = "part", tree: bool = False):
         """Single-shot daemon hashing: one pickle frame each way. Digest
         list; with tree=True, (digests, postorder internal nodes)."""
+        t0 = time.perf_counter()
         rep = self.request({
             "op": "hash", "mode": mode,
             "items": [bytes(b) for b in items], "tree": bool(tree),
         }, timeout=self.io_timeout)
+        _latency_hists()[1].labels(op="hash").observe(
+            time.perf_counter() - t0
+        )
         if not rep.get("ok"):
             raise DevdError(rep.get("error", "hash failed"))
         with self._mtx:
@@ -1688,9 +1730,12 @@ class DevdClient:
 
     def _collect_hash_stream(self, conn, writer, werr, n_chunks: int,
                              want_tree: bool):
+        chunk_hist = _latency_hists()[0].labels(op="hash")
         digests: list[bytes] = []
         for want in range(n_chunks):
+            t0 = time.perf_counter()
             payload = _recv_raw_frame(conn)
+            chunk_hist.observe(time.perf_counter() - t0)
             status, idx = struct.unpack_from("<BI", payload, 0)
             if status == STREAM_ERR:
                 # resolver discards + reaps (see _collect_stream)

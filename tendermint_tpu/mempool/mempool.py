@@ -81,6 +81,18 @@ class SigBatcher:
         # delivered == submitted - refused.
         self.delivered = 0
         self.fail_open = 0  # batches delivered un-verified (see _deliver)
+        # round 11: per-batch gate latency distribution (dispatch ->
+        # verdicts delivered) — scrape-only; the flat mempool_sig_gate_*
+        # gauges stay the legacy metrics-RPC surface. One observe per
+        # BATCH, so the burst hot path pays nothing per tx (the <2%
+        # overhead floor benches/bench_telemetry.py asserts).
+        from tendermint_tpu.libs import telemetry
+
+        self._batch_hist = telemetry.default_registry().histogram(
+            "mempool_sig_gate_batch_seconds",
+            "sig-gate batch wall time: verify dispatch to verdicts "
+            "delivered",
+        )
         # Intake is a plain list under a condition variable, swapped out
         # wholesale by the drain thread — NOT a queue.Queue: at burst
         # rates the per-item timed gets (one condition wait each) cost
@@ -162,6 +174,7 @@ class SigBatcher:
                 self._deliver(*pending.popleft())
 
     def _deliver(self, batch: list, resolver) -> None:
+        t0 = time.perf_counter()
         try:
             oks = resolver() if resolver is not None else None
         except Exception:  # noqa: BLE001 — fail OPEN (round-8 latch
@@ -183,6 +196,7 @@ class SigBatcher:
                 batch, oks if oks is not None else [True] * len(batch)
             )
         ]
+        self._batch_hist.observe(time.perf_counter() - t0)
         self.delivered += len(results)
         try:
             self.on_results(results)

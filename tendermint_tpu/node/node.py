@@ -347,6 +347,14 @@ class Node(BaseService):
         self.rpc_server = None
         self.grpc_server = None
 
+        # -- telemetry plane (round 11): one registry wires every
+        # subsystem's gauges + the process-wide instrument set; the
+        # metrics RPC renders its flat legacy dict and GET /metrics its
+        # Prometheus text (node/telemetry.py is the canonical naming map)
+        from tendermint_tpu.node.telemetry import build_registry
+
+        self.telemetry = build_registry(self)
+
     # -- statesync wiring --------------------------------------------------
 
     def _make_restorer(self, sc, local_app, genesis_doc, state_db):

@@ -1,0 +1,153 @@
+"""Node telemetry wiring: THE canonical ``<plane>_<name>`` metric map.
+
+Every gauge the node exports — through the legacy ``metrics`` JSON RPC
+(flat dict) AND the Prometheus ``GET /metrics`` endpoint — is wired
+here, in one place, with DIRECT attribute reads: a renamed field on any
+producer object raises at collect time instead of silently exporting a
+stale default (the PR-4 loud-wiring convention; this replaces the old
+handler's ``getattr(..., 0.0)`` guards and the statesync ``setdefault``
+collision dance).
+
+Canonical plane prefixes (full catalog: docs/observability.md):
+
+    consensus_*        ConsensusState position + liveness gauges
+    blockstore_*       BlockStore head/base
+    wal_*              consensus WAL durability gauges (after start)
+    evidence_*         duplicate-vote evidence pool
+    mempool_*          pool depth + sig-gate accounting
+    p2p_*              switch peer counts
+    fastsync_*         BlockchainReactor progress + stage seconds
+    statesync_*        reactor serving/restore + producer cadence
+    gateway_verify_*   Verifier counters (+ stream/breaker/faults on devd)
+    gateway_hash_*     Hasher counters (+ stream/breaker/faults on devd)
+    gateway_breaker_*  the shared circuit breaker, every route (scrape-only)
+
+plus the process-wide instruments the default registry carries
+(devd_stream_chunk_seconds / devd_single_shot_seconds histograms,
+wal_fsync_seconds / wal_group_records, mempool_sig_gate_batch_seconds,
+gateway_hash_batch_seconds, faults_*).
+
+``legacy=True`` producers make up the byte-compatible metrics-RPC dict;
+``legacy=False`` ones are scrape-only, so the legacy flat key set never
+drifts.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.libs import telemetry
+from tendermint_tpu.ops import gateway
+
+
+def build_registry(node) -> telemetry.Registry:
+    """Wire `node`'s subsystems into a Registry chained to the
+    process-wide default (each node in a test process keeps its own
+    producer table; instruments are shared)."""
+    # materialize the process-wide instrument families up front so a
+    # scrape's family set is STABLE from the first height: the devd
+    # latency histograms otherwise appear only after the first devd op,
+    # and the faults_* producer only once ops/faults is imported (it
+    # registers itself at import)
+    from tendermint_tpu import devd
+    from tendermint_tpu.ops import faults  # noqa: F401 — import = register
+
+    devd._latency_hists()
+
+    reg = telemetry.Registry(parent=telemetry.default_registry())
+    cs = node.consensus_state
+
+    def consensus() -> dict:
+        rs = cs.get_round_state()
+        return {
+            "height": rs.height,
+            "round": rs.round_,
+            "step": int(rs.step),
+            # liveness (round 8): wall seconds per committed height —
+            # the "did a round stall behind a sick device plane" signal
+            "height_seconds_last": round(cs.height_seconds_last, 3),
+            "height_seconds_max": round(cs.height_seconds_max, 3),
+            "peer_msg_drops": cs.peer_msg_drops,
+        }
+
+    reg.register_producer("consensus", consensus)
+
+    reg.register_producer(
+        "blockstore",
+        lambda: {
+            "height": node.block_store.height(),
+            "base": node.block_store.base(),
+        },
+    )
+
+    def wal() -> dict:
+        # host durability plane (round 9): group-commit shape + repair
+        # history. The WAL opens at consensus start, so the wal_* keys
+        # appear once the node runs (same presence rule as pre-registry)
+        w = cs.wal
+        return {} if w is None else w.stats()
+
+    reg.register_producer("wal", wal)
+
+    reg.register_producer(
+        "evidence", lambda: {"count": cs.evidence_pool.size()}
+    )
+
+    def mempool() -> dict:
+        out = {"size": node.mempool.size()}
+        batcher = node.mempool.sig_batcher
+        if batcher is not None:
+            out["sig_gate_dropped"] = batcher.dropped
+            out["sig_gate_delivered"] = batcher.delivered
+            out["sig_gate_fail_open"] = batcher.fail_open
+        return out
+
+    reg.register_producer("mempool", mempool)
+
+    def p2p() -> dict:
+        outbound, inbound, dialing = node.sw.num_peers()
+        return {
+            "peers_outbound": outbound,
+            "peers_inbound": inbound,
+            "peers_dialing": dialing,
+        }
+
+    reg.register_producer("p2p", p2p)
+
+    def fastsync() -> dict:
+        bc = node.blockchain_reactor
+        out = {
+            "active": int(bool(bc.fast_sync)),
+            "blocks_synced": bc.blocks_synced,
+            "rate_blocks_per_sec": round(bc.sync_rate, 3),
+        }
+        for stage, secs in bc.stage_s.items():
+            out[f"{stage}_s"] = round(secs, 3)
+        return out
+
+    reg.register_producer("fastsync", fastsync)
+
+    def statesync() -> dict:
+        # reactor owns the store gauges; the producer exports only its
+        # own cadence keys (statesync/producer.py) — collision-free by
+        # construction, so a plain merge is safe
+        out = dict(node.statesync_reactor.stats())
+        if node.snapshot_producer is not None:
+            out.update(node.snapshot_producer.stats())
+        return out
+
+    reg.register_producer("statesync", statesync)
+
+    # device plane: tpu_sigs moving is how an operator confirms the
+    # device path is live; stream_*/breaker_*/faults_* fold in on the
+    # devd route (ops/gateway stats contracts)
+    reg.register_producer("gateway_verify", node.verifier.stats)
+    reg.register_producer("gateway_hash", node.hasher.stats)
+
+    # the shared breaker, exported UNCONDITIONALLY for scrapers (on
+    # non-devd routes the verifier/hasher stats omit it, but a scrape
+    # must always show the degradation plane). Scrape-only: adding it to
+    # the flat RPC would change the legacy key set.
+    reg.register_producer(
+        "gateway", lambda: gateway.devd_breaker().stats(), legacy=False
+    )
+
+    return reg

@@ -217,6 +217,45 @@ def global_counters() -> dict:
     return out
 
 
+# -- telemetry plane (round 11) -----------------------------------------------
+#
+# The fault counters and the supervisor's kill/restart totals register
+# into the process-wide telemetry registry, so a chaos soak asserts on
+# SCRAPED metrics (GET /metrics, or registry flatten) instead of
+# reaching into harness objects — the same surface production has.
+
+_sup_totals = {"kills": 0, "restarts": 0}
+_sup_mtx = threading.Lock()
+
+
+def _note_supervisor(kind: str) -> None:
+    with _sup_mtx:
+        _sup_totals[kind] += 1
+
+
+def telemetry_counters() -> dict:
+    """faults_* across every registered plan + supervisor churn totals
+    (flat numerics; registered as a scrape-only producer below)."""
+    out = global_counters()
+    with _sup_mtx:
+        out["faults_supervisor_kills"] = _sup_totals["kills"]
+        out["faults_supervisor_restarts"] = _sup_totals["restarts"]
+    return out
+
+
+def _install_telemetry(reg) -> None:
+    # prefix "": the keys already carry the canonical faults_ prefix.
+    # legacy=False: scrape-only — the metrics RPC's flat key set must
+    # stay byte-compatible (faults_* already ride gateway_verify_* /
+    # gateway_hash_* there on the devd route)
+    reg.register_producer("", telemetry_counters, legacy=False)
+
+
+from tendermint_tpu.libs import telemetry as _telemetry  # noqa: E402
+
+_telemetry.on_default_registry(_install_telemetry)
+
+
 # -- in-process deployment: DevdClient socket wrapper -------------------------
 
 
@@ -605,6 +644,11 @@ class DaemonSupervisor:
         self.restarts = 0
 
     def start(self, wait_held_s: float = 30.0) -> None:
+        if self.plan is not None:
+            # kills noted on the plan must be scrape-visible (round 11):
+            # register it so global_counters()/the telemetry producer
+            # aggregate it like the injection harnesses' plans
+            register(self.plan)
         repo = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))
         ))
@@ -669,6 +713,7 @@ class DaemonSupervisor:
             pass
         self.proc = None
         self.kills += 1
+        _note_supervisor("kills")
         if self.plan is not None:
             self.plan.note("kill")
 
@@ -678,6 +723,7 @@ class DaemonSupervisor:
         # startup probe handles the stale socket, so just restart
         self.start(wait_held_s=wait_held_s)
         self.restarts += 1
+        _note_supervisor("restarts")
 
     def churn(self, down_s: float = 0.5, up_s: float = 2.0,
               cycles: int = 0) -> None:
@@ -699,6 +745,7 @@ class DaemonSupervisor:
                     logger.exception("chaos restart failed")
                     break
                 self.restarts += 1
+                _note_supervisor("restarts")
                 n += 1
                 if self._churn_stop.wait(up_s):
                     break
@@ -733,6 +780,8 @@ class DaemonSupervisor:
             except Exception:  # noqa: BLE001 — already gone
                 pass
             self.proc = None
+        if self.plan is not None:
+            unregister(self.plan)
 
 
 # -- standalone shim process --------------------------------------------------

@@ -1160,6 +1160,14 @@ class Hasher:
         # repropose/re-validate window is a handful of recent sets
         self._tx_roots: OrderedDict[tuple, bytes] = OrderedDict()
         self._tx_roots_cap = 16
+        # round 11: full distribution behind batch_ms_last/_avg (one
+        # observe per offload batch; scrape-only via GET /metrics)
+        from tendermint_tpu.libs import telemetry
+
+        self._batch_hist = telemetry.default_registry().histogram(
+            "gateway_hash_batch_seconds",
+            "hash-offload batch wall time (devd IPC or in-process kernel)",
+        )
 
     def stats(self) -> dict:
         with self._mtx:
@@ -1209,6 +1217,7 @@ class Hasher:
             devd_breaker().record_success()
 
     def _note_batch(self, n_bytes: int, dt_s: float) -> None:
+        self._batch_hist.observe(dt_s)
         ms = dt_s * 1000.0
         with self._mtx:
             s = self._stats

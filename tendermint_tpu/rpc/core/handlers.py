@@ -364,73 +364,28 @@ def metrics(ctx) -> dict:
     depth, peer counts, fast-sync progress, and the TPU gateway counters
     (tpu_sigs moving is how an operator confirms the device path is live).
     Beyond-reference observability: the reference declares a go-metrics
-    dep it never wires (SURVEY.md §5); here the node exports one."""
-    out: dict = {}
-    rs = ctx.consensus_state.get_round_state()
-    out["consensus_height"] = rs.height
-    out["consensus_round"] = rs.round_
-    out["consensus_step"] = int(rs.step)
-    # liveness gauges (round 8): wall seconds per committed height —
-    # the operator-facing "did a round stall behind a sick device
-    # plane" signal the chaos soak asserts on
-    out["consensus_height_seconds_last"] = round(
-        getattr(ctx.consensus_state, "height_seconds_last", 0.0), 3
-    )
-    out["consensus_height_seconds_max"] = round(
-        getattr(ctx.consensus_state, "height_seconds_max", 0.0), 3
-    )
-    out["blockstore_height"] = ctx.block_store.height()
-    out["blockstore_base"] = ctx.block_store.base()
-    out["consensus_peer_msg_drops"] = ctx.consensus_state.peer_msg_drops
-    # host durability plane (round 9): WAL group-commit shape + repair
-    # history — wal_repairs moving is how an operator learns a crash left
-    # a torn tail that recovery already cleaned (docs/crash-recovery.md),
-    # the same way breaker_* surfaces device-plane degradation
-    wal = ctx.consensus_state.wal
-    if wal is not None:
-        for k, v in wal.stats().items():
-            out[f"wal_{k}"] = v
-    pool = getattr(ctx.consensus_state, "evidence_pool", None)
-    if pool is not None:
-        out["evidence_count"] = pool.size()
-    out["mempool_size"] = ctx.mempool.size()
-    batcher = getattr(ctx.mempool, "sig_batcher", None)
-    if batcher is not None:
-        out["mempool_sig_gate_dropped"] = batcher.dropped
-        out["mempool_sig_gate_delivered"] = batcher.delivered
-        out["mempool_sig_gate_fail_open"] = batcher.fail_open
-    outbound, inbound, dialing = ctx.switch.num_peers()
-    out["p2p_peers_outbound"] = outbound
-    out["p2p_peers_inbound"] = inbound
-    out["p2p_peers_dialing"] = dialing
-    node = ctx.node
-    bc = getattr(node, "blockchain_reactor", None)
-    if bc is not None:
-        out["fastsync_active"] = int(bool(bc.fast_sync))
-        out["fastsync_blocks_synced"] = bc.blocks_synced
-        out["fastsync_rate_blocks_per_sec"] = round(bc.sync_rate, 3)
-        for stage, secs in bc.stage_s.items():
-            out[f"fastsync_{stage}_s"] = round(secs, 3)
-    # statesync plane (round 10): producer cadence + serving counters +
-    # restore progress — statesync_chunk_failures / _peers_banned moving
-    # is how an operator sees a peer feeding a joining node bad chunks
-    ss_r = getattr(node, "statesync_reactor", None)
-    if ss_r is not None:
-        for k, v in ss_r.stats().items():
-            out[f"statesync_{k}"] = v
-    producer = getattr(node, "snapshot_producer", None)
-    if producer is not None:
-        for k, v in producer.stats().items():
-            out.setdefault(f"statesync_{k}", v)
-    verifier = getattr(node, "verifier", None)
-    if verifier is not None:
-        for k, v in verifier.stats().items():
-            out[f"gateway_verify_{k}"] = v
-    hasher = getattr(node, "hasher", None)
-    if hasher is not None:
-        for k, v in hasher.stats().items():
-            out[f"gateway_hash_{k}"] = v
-    return out
+    dep it never wires (SURVEY.md §5); here the node exports one.
+
+    Round 11: the dict is rendered FROM the node's telemetry registry
+    (node/telemetry.py holds the canonical <plane>_<name> wiring; the
+    same registry serves Prometheus text on GET /metrics). Byte-
+    compatible with the pre-registry handler: same flat key set, same
+    values. The wiring is DIRECT — a renamed attribute fails loudly here
+    instead of silently dropping a gauge (PR-4 convention; the old
+    handler's getattr(..., 0.0) defaults and setdefault collision
+    handling are gone)."""
+    return ctx.node.telemetry.flatten()
+
+
+def consensus_trace(ctx, last: int = 10) -> dict:
+    """The last `last` committed heights' wall-time traces, newest
+    first: step-partitioned segments (propose -> prevote-wait ->
+    precommit-wait -> commit -> apply -> snapshot-hook), overlapping
+    aux attributions (part hashing), and the height's device-vs-CPU
+    verify/hash split with breaker state (consensus/trace.py). Operator
+    CLI: python -m tendermint_tpu.ops.trace."""
+    rec = ctx.consensus_state.trace
+    return {"traces": [t.to_json() for t in rec.last(int(last))]}
 
 
 def unsafe_flush_mempool(ctx) -> dict:
@@ -503,6 +458,7 @@ ROUTES_TABLE = {
     "evidence": (evidence, []),
     "snapshots": (snapshots, []),
     "metrics": (metrics, []),
+    "consensus_trace": (consensus_trace, ["last"]),
     "tx": (tx, ["hash", "prove"]),
     "unconfirmed_txs": (unconfirmed_txs, []),
     "num_unconfirmed_txs": (num_unconfirmed_txs, []),

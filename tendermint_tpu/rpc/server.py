@@ -159,6 +159,13 @@ class RPCServer(BaseService):
                 if parsed.path == "/websocket":
                     self._serve_websocket()
                     return
+                if parsed.path == "/metrics":
+                    # Prometheus text exposition 0.0.4 (round 11): real
+                    # scrapers point here. The flat JSON form of the same
+                    # gauges stays on the `metrics` JSON-RPC method (POST
+                    # / websocket), which this GET path now shadows.
+                    self._serve_prometheus()
+                    return
                 method = parsed.path.strip("/")
                 if not method:
                     self._respond({"routes": sorted(server.routes)})
@@ -176,6 +183,29 @@ class RPCServer(BaseService):
                 except Exception as exc:  # noqa: BLE001
                     server.logger.exception("rpc error")
                     self._rpc_error("", f"{type(exc).__name__}: {exc}")
+
+            def _serve_prometheus(self):
+                from tendermint_tpu.libs import telemetry
+
+                node = getattr(server.ctx, "node", None)
+                reg = getattr(node, "telemetry", None)
+                if reg is None:
+                    # context without a node (mock harnesses): serve the
+                    # process-wide instruments rather than 404ing the
+                    # scrape target
+                    reg = telemetry.default_registry()
+                try:
+                    body = reg.render_prometheus().encode()
+                except Exception:  # noqa: BLE001 — a scrape must never
+                    # take the RPC thread down with it
+                    server.logger.exception("prometheus render failed")
+                    self.send_error(500, "metrics render failed")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", telemetry.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             # -- websocket -------------------------------------------------
 

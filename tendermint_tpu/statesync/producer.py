@@ -205,11 +205,15 @@ class SnapshotProducer:
         return h
 
     def stats(self) -> dict:
+        """Producer-side gauges ONLY. The shared SnapshotStore's gauges
+        are exported by the reactor's stats() (the reactor always exists
+        on a node; round 11 removed the duplicate store fold-in here so
+        the statesync_* wiring in node/telemetry.py is collision-free —
+        no more setdefault ordering deciding which copy wins)."""
         return {
             "interval": self.interval,
             "snapshots_taken": self.snapshots_taken,
             "snapshot_failures": self.snapshot_failures,
             "last_snapshot_height": self.last_snapshot_height,
             "last_snapshot_seconds": self.last_snapshot_seconds,
-            **self.store.stats(),
         }

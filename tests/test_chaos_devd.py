@@ -575,6 +575,35 @@ def test_consensus_commits_through_daemon_churn(chaos_env):
         before = hasher.stats()["tpu_part_batches"]
         hasher.part_leaf_hashes(parts)
         assert hasher.stats()["tpu_part_batches"] == before + 1
+
+        # round 11: breaker-open heights visibly attribute their hash
+        # work to the CPU fallback in the per-height traces
+        # (consensus/trace.py) — kill the daemon FOR GOOD and commit a
+        # few more heights on the open breaker
+        dead_hasher = _chaos_hasher(chaos_env)  # resolved while serving
+        dead_blocks, dead_cs = _run_consensus_run(
+            3, [], hasher=dead_hasher, during=lambda _blocks: sup.kill(),
+        )
+        assert len(dead_blocks) >= 3
+        newest = dead_cs.trace.last(1)[0].to_json()
+        dev = newest["device"]
+        assert dev["hash_cpu_leaves"] > 0, dev
+        assert dev["hash_tpu_leaves"] == 0, dev
+        assert dev["breaker_state_end"] != gateway.CircuitBreaker.CLOSED, dev
+        # the segment partition holds under chaos too
+        tol = max(0.05 * newest["wall_s"], 0.005)
+        total = sum(newest["segments"].values())
+        assert abs(total - newest["wall_s"]) <= tol, (total, newest["wall_s"])
+        # the same attribution is scrape-visible: the supervisor's churn
+        # registered into the telemetry plane (ops/faults satellite),
+        # asserting on metrics instead of reaching into the harness
+        from tendermint_tpu.libs import telemetry
+
+        fams = {
+            f.name: f for f in telemetry.default_registry().collect()
+        }
+        assert fams["faults_supervisor_kills"].samples[0][2] >= 1
+        assert fams["faults_supervisor_restarts"].samples[0][2] >= 1
     finally:
         sup.stop()
 
