@@ -1,0 +1,168 @@
+"""Network chaos bench (round 12): recovery time and committed-tx
+throughput of a REAL-TCP testnet under link faults (docs/secure-p2p.md).
+
+The device-plane chaos bench (BENCH_r08) measured how one process rides
+a sick chip; this one measures how a NETWORK of full nodes — real
+listeners, the in-repo SecretConnection encrypting every byte, every
+link relayed through ops/netfaults proxies — rides a broken wire:
+
+Rows:
+- baseline:       committed heights/s and committed tx/s, fault-free
+- partition_heal: seconds from heal() until the chain commits 2 fresh
+                  heights on every node (re-peering + re-proposing),
+                  median over N_CYCLES halt/heal cycles
+- churn:          committed tx/s while rolling listener kill/restart
+                  churns one node at a time (+ delta vs baseline)
+
+Asserted floors (chip-free — this gates `make net-chaos-smoke` in
+tier1):
+- the partitioned chain actually HALTS (safety: no quorum, no commits)
+- every cycle recovers: heal-to-commit <= MAX_RECOVERY_S (default 30 s;
+  measured ~1-3 s with the bench's tight reconnect cadence)
+- final byte-identical convergence across every node (block hash,
+  part-set root, app hash per height)
+
+BENCH_NETCHAOS_SMOKE=1 shrinks the net to 4 nodes / 1 cycle for the
+tier-1 gate (~35 s). Prints ONE JSON line like the other benches;
+writes BENCH_r12.json on full runs.
+Run from the repo root: python benches/bench_netchaos.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tests"))
+
+SMOKE = os.environ.get("BENCH_NETCHAOS_SMOKE", "") == "1"
+N_NODES = int(os.environ.get("BENCH_NETCHAOS_NODES", "4" if SMOKE else "5"))
+N_CYCLES = int(os.environ.get("BENCH_NETCHAOS_CYCLES", "1" if SMOKE else "3"))
+BASE_S = float(os.environ.get("BENCH_NETCHAOS_BASE_S", "6" if SMOKE else "12"))
+MAX_RECOVERY_S = float(os.environ.get("BENCH_NETCHAOS_MAX_RECOVERY_S", "30"))
+
+
+def _pump_txs(net, tag: str, n: int) -> None:
+    for i in range(n):
+        net.broadcast_tx(f"{tag}-{i}={i}".encode(), via=i % len(net.nodes))
+
+
+def _committed_txs(net, upto: int) -> int:
+    store = net.nodes[0].block_store
+    return sum(
+        store.load_block(h).header.num_txs for h in range(1, upto + 1)
+    )
+
+
+def main() -> None:
+    # hermetic like tests/conftest.py: never dial a production daemon,
+    # and pin the CPU platform before jax loads
+    os.environ.setdefault("TENDERMINT_DEVD_SOCK", "/nonexistent/devd.sock")
+    os.environ.setdefault("TENDERMINT_TPU_PLATFORM", "cpu")
+
+    from netchaos_common import ChaosNet, wait_until
+
+    root = tempfile.mkdtemp(prefix="bench-netchaos-")
+    net = ChaosNet(N_NODES, root)
+    rows = []
+    try:
+        t0 = time.perf_counter()
+        net.start()
+        assert net.wait_height(2, timeout=120), net.heights()
+        boot_s = time.perf_counter() - t0
+
+        # -- baseline ------------------------------------------------------
+        h0 = min(net.heights())
+        tx0 = _committed_txs(net, h0)
+        t0 = time.perf_counter()
+        deadline = t0 + BASE_S
+        i = 0
+        while time.perf_counter() < deadline:
+            _pump_txs(net, f"base{i}", 20)
+            i += 1
+            time.sleep(0.1)
+        assert net.wait_height(min(net.heights()) + 1, timeout=60)
+        base_wall = time.perf_counter() - t0
+        h1 = min(net.heights())
+        base_heights_s = (h1 - h0) / base_wall
+        base_tx_s = (_committed_txs(net, h1) - tx0) / base_wall
+        rows.append({
+            "mode": "baseline", "nodes": N_NODES, "boot_s": round(boot_s, 2),
+            "heights_per_s": round(base_heights_s, 3),
+            "committed_tx_per_s": round(base_tx_s, 1),
+        })
+
+        # -- partition-heal cycles ----------------------------------------
+        recoveries = []
+        for c in range(N_CYCLES):
+            # a split with no +2/3 side must HALT the chain
+            net.partition(set(range((N_NODES // 2) + (N_NODES % 2), N_NODES)))
+            h_stall = max(net.heights())
+            time.sleep(1.5)
+            assert max(net.heights()) <= h_stall + 1, (
+                "chain committed through a quorumless partition"
+            )
+            stalled = max(net.heights())
+            t0 = time.perf_counter()
+            net.heal()
+            assert net.wait_height(stalled + 2, timeout=MAX_RECOVERY_S), (
+                f"cycle {c}: no recovery within {MAX_RECOVERY_S}s "
+                f"({net.heights()})"
+            )
+            recoveries.append(time.perf_counter() - t0)
+        rows.append({
+            "mode": "partition_heal", "cycles": N_CYCLES,
+            "recovery_s_median": round(statistics.median(recoveries), 2),
+            "recovery_s_max": round(max(recoveries), 2),
+            "asserted_max_s": MAX_RECOVERY_S,
+        })
+
+        # -- churn throughput ---------------------------------------------
+        h0 = min(net.heights())
+        tx0 = _committed_txs(net, h0)
+        t0 = time.perf_counter()
+        for c in range(max(1, N_CYCLES)):
+            net.churn_listener((c % (N_NODES - 1)) + 1, down_s=0.4)
+            _pump_txs(net, f"churn{c}", 30)
+            assert net.wait_height(max(net.heights()) + 1, timeout=60)
+        assert wait_until(
+            lambda: all(n.sw.peers.size() >= N_NODES - 2 for n in net.nodes),
+            timeout=60,
+        ), [n.sw.peers.size() for n in net.nodes]
+        churn_wall = time.perf_counter() - t0
+        h1 = min(net.heights())
+        churn_tx_s = (_committed_txs(net, h1) - tx0) / churn_wall
+        rows.append({
+            "mode": "churn", "churns": max(1, N_CYCLES),
+            "committed_tx_per_s": round(churn_tx_s, 1),
+            "vs_baseline": round(churn_tx_s / base_tx_s, 2) if base_tx_s else None,
+        })
+
+        # -- final byte-identity ------------------------------------------
+        top = min(net.heights())
+        net.assert_converged(top)
+        rows.append({"mode": "convergence", "upto_height": top, "ok": True})
+    finally:
+        net.stop()
+
+    record = {
+        "bench": "netchaos",
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": "cpu",
+        "smoke": SMOKE,
+        "rows": rows,
+    }
+    if not SMOKE:
+        with open(os.path.join(ROOT, "BENCH_r12.json"), "w") as f:
+            json.dump(record, f, indent=2)
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,12 @@ Configs (BASELINE.md):
                   scrape-cost row: GET /metrics hammered under load must
                   not move consensus height_seconds (writes the
                   "rpc_scrape" section of BENCH_r11.json; chip-free)
+ 12 netchaos     — network plane: real-TCP testnet (in-repo
+                  SecretConnection + ops/netfaults link proxies) through
+                  partition-heal cycles + listener churn; recovery time
+                  and committed-tx/s recorded, halt-under-partition and
+                  byte-identical convergence asserted (writes
+                  BENCH_r12.json; chip-free)
 
 Each bench is its own process (the TPU is exclusive per process).
 Usage: python benches/run_all.py [--skip testnet,...]
@@ -60,6 +66,7 @@ BENCHES = {
     "9_statesync": [sys.executable, "benches/bench_statesync.py"],
     "10_telemetry": [sys.executable, "benches/bench_telemetry.py"],
     "11_rpc_load": [sys.executable, "benches/bench_rpc_load.py"],
+    "12_netchaos": [sys.executable, "benches/bench_netchaos.py"],
 }
 
 

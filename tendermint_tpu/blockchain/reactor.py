@@ -64,6 +64,7 @@ class BlockchainReactor(Reactor, BaseService):
         group_sig_target: int = 4096,
         post_apply_hook=None,
         defer_for_statesync: bool = False,
+        evidence_pool=None,
     ):
         BaseService.__init__(self, name="blockchain.reactor")
         self.status_update_interval = status_update_interval
@@ -77,6 +78,11 @@ class BlockchainReactor(Reactor, BaseService):
         # reactor was constructed with — start_after_statesync() re-seeds
         # it at the restored height and starts the sync loop then
         self.post_apply_hook = post_apply_hook
+        # round 12: fast-synced blocks carry evidence too — the pool must
+        # learn it or the node re-proposes already-on-chain pieces once
+        # it switches to consensus (mark_committed is the only dedup
+        # against chain history)
+        self.evidence_pool = evidence_pool
         self._deferred = defer_for_statesync
         self.state = state
         self.proxy_app_conn = proxy_app_conn
@@ -410,6 +416,8 @@ class BlockchainReactor(Reactor, BaseService):
         )
         self.stage_s["apply"] += time.perf_counter() - t0
         self.blocks_synced += 1
+        if first.evidence.evidence and self.evidence_pool is not None:
+            self.evidence_pool.mark_committed(first.evidence.evidence)
         if self.post_apply_hook is not None:
             # snapshot production during catch-up (round 10); best-effort
             # by contract — the hook must never stall or kill the sync loop

@@ -773,6 +773,11 @@ class ConsensusState(BaseService):
                 # one offload pass when the hash plane serves (devd
                 # hash_stream tree frame); None -> the flat host builder
                 part_tree_hasher=self.part_hasher.part_set_tree,
+                # drain detected-but-uncommitted double-signs into the
+                # proposal: one detecting node puts the proof ON CHAIN
+                # for everyone (types/evidence.py round 12; a block may
+                # only carry evidence STRICTLY older than itself)
+                evidence=self.evidence_pool.pending(before_height=rs.height),
             )
         finally:
             # overlapping attribution: block build (part hashing + tx
@@ -1041,6 +1046,12 @@ class ConsensusState(BaseService):
         )
 
         fail_point()
+
+        # the committed block's evidence section is now chain history:
+        # never re-propose it, and adopt pieces other nodes detected
+        # (validated above in validate_block)
+        if block.evidence.evidence:
+            self.evidence_pool.mark_committed(block.evidence.evidence)
 
         self.trace.mark("snapshot_hook")
         if self.post_apply_hook is not None and not self.replay_mode:

@@ -240,6 +240,18 @@ def gen_priv_key_ed25519(seed: bytes | None = None) -> PrivKeyEd25519:
     return PrivKeyEd25519(hashlib.sha256(seed).digest())
 
 
+def pub_key_from_bytes(b: bytes):
+    """Type-tagged key bytes (the `bytes_()` encoding) back to a key
+    object — wire input: any violation is ValueError."""
+    if not isinstance(b, (bytes, bytearray)) or len(b) < 1:
+        raise ValueError("empty pubkey bytes")
+    if b[0] == TYPE_ED25519:
+        return PubKeyEd25519(bytes(b[1:]))
+    if b[0] == TYPE_SECP256K1:
+        return PubKeySecp256k1(bytes(b[1:]))
+    raise ValueError(f"unknown pubkey type {b[0]}")
+
+
 def pub_key_from_json(obj):
     if not isinstance(obj, (list, tuple)) or len(obj) != 2:
         raise ValueError(f"unknown pubkey encoding {obj!r}")

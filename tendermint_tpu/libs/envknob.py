@@ -22,3 +22,18 @@ def env_number(name: str, default, cast=float):
     except ValueError:
         logger.warning("ignoring malformed %s=%r; using %r", name, raw, default)
         return default
+
+
+def env_str(name: str, default: str, allowed=()):
+    """Enumerated string knob: a value outside `allowed` warns and falls
+    back (same contract as env_number — a typo never kills startup)."""
+    raw = os.environ.get(name, "").strip().lower()
+    if not raw:
+        return default
+    if allowed and raw not in allowed:
+        logger.warning(
+            "ignoring unknown %s=%r (allowed: %s); using %r",
+            name, raw, "|".join(allowed), default,
+        )
+        return default
+    return raw

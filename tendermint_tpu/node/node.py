@@ -273,6 +273,7 @@ class Node(BaseService):
                 if self.snapshot_producer is not None else None
             ),
             defer_for_statesync=statesync_restore,
+            evidence_pool=self.consensus_state.evidence_pool,
         )
 
         # -- statesync reactor: always serves local snapshots; in restore

@@ -25,7 +25,8 @@ Canonical plane prefixes (full catalog: docs/observability.md):
 plus the process-wide instruments the default registry carries
 (devd_stream_chunk_seconds / devd_single_shot_seconds histograms,
 wal_fsync_seconds / wal_group_records, mempool_sig_gate_batch_seconds,
-gateway_hash_batch_seconds, faults_*).
+gateway_hash_batch_seconds, faults_*, p2p_secretconn_* transport
+counters, netfaults_* network-chaos aggregates).
 
 ``legacy=True`` producers make up the byte-compatible metrics-RPC dict;
 ``legacy=False`` ones are scrape-only, so the legacy flat key set never
@@ -49,8 +50,10 @@ def build_registry(node) -> telemetry.Registry:
     # registers itself at import)
     from tendermint_tpu import devd
     from tendermint_tpu.ops import faults  # noqa: F401 — import = register
+    from tendermint_tpu.p2p import secret_connection
 
     devd._latency_hists()
+    secret_connection._counters()
 
     reg = telemetry.Registry(parent=telemetry.default_registry())
     cs = node.consensus_state

@@ -1,0 +1,455 @@
+"""Network fault tier (round 12): per-link TCP chaos for real testnets.
+
+PR 3 built the DEVICE-plane fault harness (ops/faults.py: the UDS wire
+between a node and its devd daemon). This module is the same idea one
+layer up — the p2p NETWORK between nodes — now that the encrypted
+transport is in-repo (crypto/x25519, crypto/chacha20poly1305) and
+multi-node tests run over real TCP instead of loopback fabrics.
+
+A `LinkProxy` fronts ONE directed p2p link: the dialing node is given
+the proxy's address instead of the listener's, and every byte of the
+connection (both directions of the TCP stream) relays through it. On
+top of the byte relay sit the network fault controls:
+
+- `partition()` / `heal()`: live connections are torn down
+  (shutdown-then-close — the PR-3 lesson: close() alone never wakes a
+  blocked recv) and new connects are refused until healed. The dialing
+  switch's persistent-peer reconnect loop keeps retrying through the
+  outage, so healing is observable as re-peering WITHOUT test
+  intervention.
+- `set_delay(c2s_s=, s2c_s=)`: ASYMMETRIC per-direction latency — each
+  relayed chunk sleeps before forwarding, so a link can be slow one way
+  and fast the other (the classic consensus-timeout aggravator).
+- `set_reorder(n)`: swap the next n pairs of adjacent chunks. The
+  SecretConnection's counter-nonce AEAD makes stream reordering
+  DETECTABLE-BY-DESIGN: the receiver sees an authentication failure,
+  poisons the connection, and the peer drops loudly (then reconnects).
+  The scenario matrix asserts exactly that — reorder is tamper, not
+  silent corruption.
+- an optional `FaultPlan` (ops/faults taxonomy, reused verbatim) fires
+  refuse/stall on connects and stall/drop/corrupt/truncate on relayed
+  chunks, so the seeded deterministic schedules from the device tier
+  drive network chaos too. `corrupt` here flips a byte INSIDE the
+  encrypted stream — unlike the trusted local devd IPC, this wire is
+  AEAD-protected, so payload corruption is in-contract and must surface
+  as a loud peer error.
+
+`NetFabric` owns all the directed links of an N-node testnet and maps
+group-level operations (partition {0,1} | {2,3,4}, heal_all, per-link
+delay) onto them. Peer churn — the listener-kill/restart arm — lives
+with the node harness (tests/netchaos_common.py) because it owns the
+listeners; the fabric contributes the link-level side (drop_all on the
+churned node's links).
+
+Counters: every link counts conns/refusals/bytes/injected faults into
+flat `stats()` dicts, aggregated across registered fabrics into
+scrape-only `netfaults_*` telemetry (same convention as faults_*), so a
+chaos soak asserts on the scraped surface production has.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import socket
+import threading
+import time
+
+from tendermint_tpu.ops.faults import FaultPlan, _kill_sock
+
+logger = logging.getLogger("ops.netfaults")
+
+_CHUNK = 65536
+
+_COUNTER_KEYS = (
+    "conns", "conns_refused", "bytes_c2s", "bytes_s2c",
+    "partitions", "heals", "partition_drops",
+    "delays_injected", "reorders_injected", "plan_faults",
+)
+
+
+class LinkProxy:
+    """One directed p2p link (dialer -> listener) as a TCP byte relay
+    with injectable network faults. Thread-per-connection-direction; all
+    control mutations are lock-guarded and take effect on the next chunk
+    (delay/reorder) or immediately (partition)."""
+
+    def __init__(self, upstream: tuple[str, int],
+                 plan: FaultPlan | None = None, name: str = ""):
+        self.upstream = upstream
+        self.plan = plan
+        self.name = name or f"link->{upstream[0]}:{upstream[1]}"
+        self._mtx = threading.Lock()
+        self._partitioned = False
+        self._delay = {"c2s": 0.0, "s2c": 0.0}
+        self._reorder_budget = 0
+        self._counters = {k: 0 for k in _COUNTER_KEYS}
+        self._conns: list[socket.socket] = []
+        self._stop = threading.Event()
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(64)
+        srv.settimeout(0.3)
+        self._srv = srv
+        self.addr = srv.getsockname()[:2]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"netfault-accept:{self.name}",
+        )
+        self._accept_thread.start()
+
+    # -- addressing ---------------------------------------------------------
+
+    @property
+    def laddr(self) -> str:
+        """host:port the DIALING node should be pointed at (seeds /
+        persistent_peers entry)."""
+        return f"{self.addr[0]}:{self.addr[1]}"
+
+    # -- chaos controls -----------------------------------------------------
+
+    def partition(self) -> None:
+        """Sever the link: refuse new connects, reset live connections.
+        Reset (not blackhole) keeps test wall-clock bounded; the slow-
+        link failure mode is modeled by set_delay instead."""
+        with self._mtx:
+            already = self._partitioned
+            self._partitioned = True
+            if not already:
+                self._counters["partitions"] += 1
+        self._drop_all(count_as="partition_drops")
+
+    def heal(self) -> None:
+        with self._mtx:
+            if self._partitioned:
+                self._counters["heals"] += 1
+            self._partitioned = False
+
+    def partitioned(self) -> bool:
+        with self._mtx:
+            return self._partitioned
+
+    def set_delay(self, c2s_s: float = 0.0, s2c_s: float = 0.0) -> None:
+        """Asymmetric one-way latency, applied per relayed chunk."""
+        with self._mtx:
+            self._delay["c2s"] = max(0.0, float(c2s_s))
+            self._delay["s2c"] = max(0.0, float(s2c_s))
+
+    def set_reorder(self, swaps: int) -> None:
+        """Swap the next `swaps` pairs of adjacent relayed chunks
+        (either direction claims from the shared budget). The AEAD layer
+        detects each swap as tampering — the assertion the scenario
+        matrix makes."""
+        with self._mtx:
+            self._reorder_budget = max(0, int(swaps))
+
+    def drop_all(self) -> None:
+        """Reset live connections without partitioning (peer-churn
+        support: the next dial succeeds)."""
+        self._drop_all(count_as=None)
+
+    def stats(self) -> dict:
+        with self._mtx:
+            out = {f"netfaults_{k}": v for k, v in self._counters.items()}
+            out["netfaults_partitioned"] = int(self._partitioned)
+            return out
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        self._drop_all(count_as=None)
+        if self._accept_thread.is_alive():
+            self._accept_thread.join(timeout=5.0)
+
+    # -- internals ----------------------------------------------------------
+
+    def _note(self, key: str, v: int = 1) -> None:
+        with self._mtx:
+            self._counters[key] += v
+
+    def _drop_all(self, count_as: str | None) -> None:
+        with self._mtx:
+            conns, self._conns = self._conns, []
+            if count_as and conns:
+                self._counters[count_as] += len(conns)
+        for c in conns:
+            _kill_sock(c)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with self._mtx:
+                dark = self._partitioned
+            f = None
+            if not dark and self.plan is not None:
+                f = self.plan.pick("connect", supported=("refuse", "stall"))
+                if f is not None:
+                    self._note("plan_faults")
+            if dark or (f is not None and f.kind == "refuse"):
+                self._note("conns_refused")
+                _kill_sock(conn)
+                continue
+            if f is not None and f.kind == "stall":
+                time.sleep(f.stall_s)
+            try:
+                up = socket.create_connection(self.upstream, timeout=5.0)
+                # the connect timeout must NOT linger as an IO timeout: a
+                # relay direction that idles 5 s (vote channel between
+                # rounds) would raise and kill the whole link (the
+                # FaultProxy learned the same lesson in PR 3)
+                up.settimeout(None)
+            except OSError:
+                # upstream listener down (churn window): the dialer sees
+                # exactly what a dead node produces
+                self._note("conns_refused")
+                _kill_sock(conn)
+                continue
+            self._note("conns")
+            with self._mtx:
+                self._conns += [conn, up]
+            for src, dst, direction in ((conn, up, "c2s"), (up, conn, "s2c")):
+                threading.Thread(
+                    target=self._relay, args=(src, dst, direction),
+                    daemon=True, name=f"netfault-{direction}:{self.name}",
+                ).start()
+
+    def _relay(self, src: socket.socket, dst: socket.socket,
+               direction: str) -> None:
+        held: bytes | None = None
+        try:
+            while not self._stop.is_set():
+                try:
+                    data = src.recv(_CHUNK)
+                except socket.timeout:
+                    # only armed while a chunk is held for reordering: an
+                    # idle stream must not blackhole the held bytes (the
+                    # peer may be WAITING on them — nothing else would
+                    # ever arrive to trigger the swap)
+                    if held is not None:
+                        dst.sendall(held)
+                        held = None
+                    src.settimeout(None)
+                    continue
+                if not data:
+                    return
+                self._note(f"bytes_{direction}", len(data))
+                if self.plan is not None:
+                    f = self.plan.pick(
+                        direction,
+                        supported=("stall", "drop", "truncate", "corrupt"),
+                    )
+                    if f is not None:
+                        self._note("plan_faults")
+                        if f.kind == "stall":
+                            time.sleep(f.stall_s)
+                        elif f.kind == "drop":
+                            return
+                        elif f.kind == "truncate":
+                            dst.sendall(data[: max(1, len(data) // 2)])
+                            return
+                        elif f.kind == "corrupt":
+                            # inside the ENCRYPTED stream: the AEAD must
+                            # flag it (in-contract, unlike devd IPC)
+                            buf = bytearray(data)
+                            buf[self.plan.corrupt_offset(0, len(buf))] ^= 0xFF
+                            data = bytes(buf)
+                with self._mtx:
+                    delay = self._delay["c2s" if direction == "c2s" else "s2c"]
+                    want_reorder = self._reorder_budget > 0 and held is None
+                    if want_reorder:
+                        self._reorder_budget -= 1
+                if delay > 0:
+                    self._note("delays_injected")
+                    time.sleep(delay)
+                if want_reorder:
+                    held = data  # hold this chunk, release after the next
+                    src.settimeout(0.25)  # idle flush bound (see above)
+                    continue
+                dst.sendall(data)
+                if held is not None:
+                    self._note("reorders_injected")
+                    dst.sendall(held)
+                    held = None
+                    src.settimeout(None)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            if held is not None:
+                try:
+                    dst.sendall(held)
+                except OSError:
+                    pass
+            for s in (src, dst):
+                _kill_sock(s)
+
+
+class NetFabric:
+    """The directed links of one testnet: link (i, j) carries the
+    connection node i DIALED to node j (the harness gives i the proxy's
+    laddr as its seed for j). Group operations map onto per-link
+    controls; everything heals."""
+
+    def __init__(self, name: str = "netfabric"):
+        self.name = name
+        self._links: dict[tuple[int, int], LinkProxy] = {}
+        self._mtx = threading.Lock()
+        register_fabric(self)
+
+    def add_link(self, i: int, j: int, upstream: tuple[int, int] | tuple,
+                 plan: FaultPlan | None = None) -> LinkProxy:
+        link = LinkProxy(tuple(upstream), plan=plan, name=f"{self.name}:{i}->{j}")
+        with self._mtx:
+            self._links[(i, j)] = link
+        return link
+
+    def link(self, i: int, j: int) -> LinkProxy | None:
+        with self._mtx:
+            return self._links.get((i, j))
+
+    def links(self) -> dict:
+        with self._mtx:
+            return dict(self._links)
+
+    def links_of(self, node: int) -> list[LinkProxy]:
+        with self._mtx:
+            return [
+                l for (i, j), l in self._links.items() if node in (i, j)
+            ]
+
+    # -- group chaos --------------------------------------------------------
+
+    def partition_groups(self, group_a) -> None:
+        """Sever every link crossing the {group_a} | {rest} boundary."""
+        ga = set(group_a)
+        for (i, j), link in self.links().items():
+            if (i in ga) != (j in ga):
+                link.partition()
+
+    def heal_all(self) -> None:
+        for link in self.links().values():
+            link.heal()
+
+    def set_delay(self, i: int, j: int, c2s_s: float = 0.0,
+                  s2c_s: float = 0.0) -> None:
+        link = self.link(i, j)
+        if link is None:
+            raise KeyError(f"no link {i}->{j}")
+        link.set_delay(c2s_s=c2s_s, s2c_s=s2c_s)
+
+    def stats(self) -> dict:
+        """Aggregate flat counters over every link (the scrape surface)."""
+        out = {f"netfaults_{k}": 0 for k in _COUNTER_KEYS}
+        out["netfaults_partitioned"] = 0
+        out["netfaults_links"] = 0
+        for link in self.links().values():
+            out["netfaults_links"] += 1
+            for k, v in link.stats().items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    def stop(self) -> None:
+        for link in self.links().values():
+            link.stop()
+        unregister_fabric(self)
+
+
+# -- telemetry (scrape-only, the ops/faults convention) -----------------------
+
+_fabrics: list[NetFabric] = []
+_reg_mtx = threading.Lock()
+
+
+def register_fabric(fabric: NetFabric) -> NetFabric:
+    with _reg_mtx:
+        if fabric not in _fabrics:
+            _fabrics.append(fabric)
+    return fabric
+
+
+def unregister_fabric(fabric: NetFabric) -> None:
+    with _reg_mtx:
+        if fabric in _fabrics:
+            _fabrics.remove(fabric)
+
+
+def telemetry_counters() -> dict:
+    out = {f"netfaults_{k}": 0 for k in _COUNTER_KEYS}
+    out["netfaults_partitioned"] = 0
+    out["netfaults_links"] = 0
+    with _reg_mtx:
+        fabrics = list(_fabrics)
+    for fabric in fabrics:
+        for k, v in fabric.stats().items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+def _install_telemetry(reg) -> None:
+    # scrape-only: the legacy metrics-RPC key set stays frozen. The
+    # producer registers under its OWN prefix — producers are keyed by
+    # prefix, so a second ""-prefixed registration would silently
+    # REPLACE ops/faults' (exactly the collision that broke the chaos
+    # suite's faults_supervisor_* assertions when this module first
+    # shipped); the canonical netfaults_ names are rebuilt by stripping
+    # the stats() prefix and letting the registry re-add it
+    def produce() -> dict:
+        return {
+            k[len("netfaults_"):]: v
+            for k, v in telemetry_counters().items()
+        }
+
+    reg.register_producer("netfaults", produce, legacy=False)
+
+
+from tendermint_tpu.libs import telemetry as _telemetry  # noqa: E402
+
+_telemetry.on_default_registry(_install_telemetry)
+
+
+# -- standalone shim process --------------------------------------------------
+
+
+def main(argv=None) -> int:
+    """Run one LinkProxy as its own process (multi-process harnesses:
+    point a node's seed entry at --listen-report's printed address).
+    Counters print as ONE json line on SIGTERM/SIGINT."""
+    ap = argparse.ArgumentParser(description=LinkProxy.__doc__)
+    ap.add_argument("--upstream", required=True, help="host:port of the listener")
+    ap.add_argument("--delay-c2s", type=float, default=0.0)
+    ap.add_argument("--delay-s2c", type=float, default=0.0)
+    ap.add_argument("--reorder", type=int, default=0,
+                    help="swap the next N adjacent chunk pairs")
+    args = ap.parse_args(argv)
+
+    host, port = args.upstream.rsplit(":", 1)
+    proxy = LinkProxy((host, int(port)))
+    proxy.set_delay(c2s_s=args.delay_c2s, s2c_s=args.delay_s2c)
+    if args.reorder:
+        proxy.set_reorder(args.reorder)
+    done = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: done.set())
+    logging.basicConfig(level=logging.INFO)
+    # parseable: harnesses read the first line for the dial address
+    print(proxy.laddr, flush=True)
+    logger.info("link proxy %s -> %s", proxy.laddr, args.upstream)
+    done.wait()
+    stats = proxy.stats()
+    proxy.stop()
+    print(json.dumps(stats))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

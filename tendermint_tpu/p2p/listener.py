@@ -85,6 +85,18 @@ class Listener:
     def stop(self) -> None:
         if not self._closed:
             self._closed = True
+            # shutdown-then-close (the PR-3 socket-teardown lesson, here
+            # for LISTENING sockets): close() alone does not wake a
+            # thread blocked in accept() — the in-flight syscall pins the
+            # open file description, so the socket stays bound AND
+            # listening until a connection happens to arrive, which both
+            # leaks the accept thread and holds the port against a
+            # listener restart (the chaos tier's churn arm rebinds the
+            # same port on purpose)
+            try:
+                self.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self.sock.close()
             except OSError:

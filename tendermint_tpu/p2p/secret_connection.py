@@ -1,5 +1,5 @@
 """Authenticated-encryption transport (reference: p2p/secret_connection.go,
-spec docs/specification/secure-p2p.rst).
+spec docs/secure-p2p.md + docs/specification/secure-p2p.rst).
 
 Same STS-like shape as the reference, modern primitives (this framework
 defines its own wire protocol, so no nacl-secretbox compatibility):
@@ -15,25 +15,81 @@ defines its own wire protocol, so no nacl-secretbox compatibility):
    verify — authenticating the node identity key (secret_connection.go:49-101).
 
 Frames: [len:2 BE][ciphertext = plaintext+16B tag], plaintext <=1024B.
+
+The primitives are IN-REPO (crypto/x25519.py, crypto/chacha20poly1305.py
+— pure-Python pinned to the RFC 7748/8439 vectors, with `cryptography`
+and ctypes-libcrypto fast paths selected via TENDERMINT_SECRETCONN_BACKEND),
+so the encrypted transport works on any host. The wire bytes are
+backend-independent: both ends may run different backends.
+
+Failure semantics (round 12):
+- an AEAD authentication failure is TAMPERING, never EOF: the connection
+  poisons itself, the stream closes, and every current/later read raises
+  SecretConnectionError — a bit-flipped frame surfaces as a loud peer
+  error (switch: "stopping peer for error"), not a graceful hangup;
+- the handshake is deadline-bounded (TENDERMINT_SECRETCONN_HANDSHAKE_S,
+  default 20 s): a stalled or byte-dribbling peer cannot pin the
+  handshake thread forever;
+- both families count in p2p_secretconn_* telemetry (process-wide
+  instruments, materialized by node/telemetry.py like the devd
+  histograms).
 """
 
 from __future__ import annotations
 
 import hashlib
 import json
+import socket
 import struct
 import threading
+import time
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-
+from tendermint_tpu.crypto.chacha20poly1305 import ChaCha20Poly1305, InvalidTag
 from tendermint_tpu.crypto.keys import PrivKeyEd25519, PubKeyEd25519, SignatureEd25519
+from tendermint_tpu.crypto.x25519 import X25519PrivateKey, X25519PublicKey
+from tendermint_tpu.libs import telemetry
+from tendermint_tpu.libs.envknob import env_number
 
 DATA_MAX_SIZE = 1024
 _LEN = struct.Struct(">H")
+
+DEFAULT_HANDSHAKE_S = 20.0
+
+
+class SecretConnectionError(ConnectionError):
+    """Cryptographic failure on the link: tampered/reordered frame,
+    bad challenge signature — never a routine peer hangup."""
+
+
+class HandshakeTimeout(ConnectionError):
+    """The key/auth exchange did not complete within the deadline."""
+
+
+def _counters() -> dict:
+    """p2p_secretconn_* counter families (create-or-get from the CURRENT
+    default registry each call, so instruments survive test resets —
+    node/telemetry.py materializes them so the scrape family set is
+    stable from the first height)."""
+    reg = telemetry.default_registry()
+    return {
+        "handshakes": reg.counter(
+            "p2p_secretconn_handshakes_total",
+            "completed SecretConnection handshakes",
+        ),
+        "handshake_failures": reg.counter(
+            "p2p_secretconn_handshake_failures_total",
+            "SecretConnection handshakes failed (bad peer bytes, EOF, "
+            "invalid challenge signature)",
+        ),
+        "handshake_timeouts": reg.counter(
+            "p2p_secretconn_handshake_timeouts_total",
+            "SecretConnection handshakes abandoned at the deadline",
+        ),
+        "auth_failures": reg.counter(
+            "p2p_secretconn_auth_failures_total",
+            "AEAD frame authentication failures (tamper/reorder/desync)",
+        ),
+    }
 
 
 def _hkdf(secret: bytes, info: bytes, length: int = 64) -> bytes:
@@ -50,12 +106,59 @@ def _hkdf(secret: bytes, info: bytes, length: int = 64) -> bytes:
 class SecretConnection:
     """Wraps a stream; satisfies the stream interface itself."""
 
-    def __init__(self, stream, priv_key: PrivKeyEd25519):
+    def __init__(self, stream, priv_key: PrivKeyEd25519,
+                 handshake_timeout_s: float | None = None):
         self.stream = stream
+        if handshake_timeout_s is None:
+            handshake_timeout_s = env_number(
+                "TENDERMINT_SECRETCONN_HANDSHAKE_S", DEFAULT_HANDSHAKE_S
+            )
+        self._deadline = (
+            time.monotonic() + handshake_timeout_s
+            if handshake_timeout_s and handshake_timeout_s > 0 else None
+        )
+        # the Switch arms its own admission timeout on the socket BEFORE
+        # building the peer (add_peer_from_stream); remember it so the
+        # deadline bookkeeping below restores it rather than clearing it
+        # — wiping it would leave the NodeInfo half of admission
+        # unbounded against a peer that stalls after the secret handshake
+        sock = self._sock()
+        self._prior_sock_timeout = None
+        if sock is not None:
+            try:
+                self._prior_sock_timeout = sock.gettimeout()
+            except OSError:
+                pass
+        self._poisoned: SecretConnectionError | None = None
+        try:
+            self._handshake(stream, priv_key)
+        except HandshakeTimeout:
+            _counters()["handshake_timeouts"].inc()
+            _counters()["handshake_failures"].inc()
+            raise
+        except socket.timeout as exc:
+            # a deadline-armed WRITE tripped (sendall past the budget)
+            _counters()["handshake_timeouts"].inc()
+            _counters()["handshake_failures"].inc()
+            raise HandshakeTimeout(
+                "secret connection: handshake timed out"
+            ) from exc
+        except Exception:
+            _counters()["handshake_failures"].inc()
+            raise
+        else:
+            _counters()["handshakes"].inc()
+        finally:
+            self._deadline = None
+            self._restore_sock_timeout()
+
+    def _handshake(self, stream, priv_key: PrivKeyEd25519) -> None:
         eph_priv = X25519PrivateKey.generate()
         eph_pub = eph_priv.public_key().public_bytes_raw()
+        self.backend = eph_priv.backend
 
         # 1. ephemeral exchange (concurrent-safe: write then read)
+        self._bound_to_deadline()
         stream.write(eph_pub)
         remote_eph = self._read_exact(32)
 
@@ -82,25 +185,78 @@ class SecretConnection:
                 "sig": priv_key.sign(challenge).to_json(),
             }
         ).encode()
+        self._bound_to_deadline()
         self.write(auth)
         remote_auth = json.loads(self._read_msg().decode())
         remote_pub = PubKeyEd25519.from_json(remote_auth["pub_key"])
         remote_sig = SignatureEd25519.from_json(remote_auth["sig"])
         if not remote_pub.verify_bytes(challenge, remote_sig):
             stream.close()
-            raise ConnectionError("secret connection: challenge signature invalid")
+            raise SecretConnectionError(
+                "secret connection: challenge signature invalid"
+            )
         self._remote_pubkey = remote_pub
 
     def remote_pubkey(self) -> PubKeyEd25519:
         return self._remote_pubkey
+
+    # -- handshake deadline -------------------------------------------------
+
+    def _sock(self) -> socket.socket | None:
+        return getattr(self.stream, "sock", None)
+
+    def _bound_to_deadline(self) -> None:
+        """Bound the next blocking socket op by the remaining handshake
+        budget (streams without a socket — in-process pipes under test
+        fabrics are socketpairs, so they have one — simply stay
+        unbounded). A byte-dribbling peer is covered because the
+        deadline is ABSOLUTE: every read re-arms with what's left."""
+        if self._deadline is None:
+            return
+        remaining = self._deadline - time.monotonic()
+        if remaining <= 0:
+            raise HandshakeTimeout("secret connection: handshake timed out")
+        sock = self._sock()
+        if sock is not None:
+            try:
+                sock.settimeout(remaining)
+            except OSError:
+                pass
+
+    def _restore_sock_timeout(self) -> None:
+        # put back whatever was armed before our per-read deadlines: the
+        # Switch's admission timeout must keep covering the NodeInfo
+        # handshake that follows (it clears it itself after admission);
+        # for a direct construction this restores None, so no stray
+        # timeout leaks onto the data path
+        sock = self._sock()
+        if sock is not None:
+            try:
+                sock.settimeout(self._prior_sock_timeout)
+            except OSError:
+                pass
 
     # -- framing -----------------------------------------------------------
 
     def _read_exact(self, n: int) -> bytes:
         buf = bytearray()
         while len(buf) < n:
-            chunk = self.stream.read(n - len(buf))
+            if self._deadline is not None:
+                self._bound_to_deadline()
+            try:
+                chunk = self.stream.read(n - len(buf))
+            except socket.timeout as exc:
+                raise HandshakeTimeout(
+                    "secret connection: handshake timed out"
+                ) from exc
             if not chunk:
+                # a SocketStream swallows OSError (incl. timeouts) into
+                # b"" — distinguish deadline expiry from a peer hangup
+                if self._deadline is not None and \
+                        time.monotonic() >= self._deadline:
+                    raise HandshakeTimeout(
+                        "secret connection: handshake timed out"
+                    )
                 raise ConnectionError("stream closed during secret handshake/read")
             buf += chunk
         return bytes(buf)
@@ -119,10 +275,15 @@ class SecretConnection:
         ct = self._read_exact(clen)
         try:
             pt = self._recv_aead.decrypt(self._nonce12(self._recv_nonce), ct, None)
-        except Exception as exc:
+        except InvalidTag as exc:
             # tampering / desync is unrecoverable: poison the connection
+            _counters()["auth_failures"].inc()
+            err = SecretConnectionError(
+                "secret connection: frame authentication failed"
+            )
+            self._poisoned = err
             self.stream.close()
-            raise ConnectionError("secret connection: frame authentication failed") from exc
+            raise err from exc
         self._recv_nonce += 1
         return pt
 
@@ -136,10 +297,19 @@ class SecretConnection:
                 self._write_frame(b"")
 
     def read(self, n: int) -> bytes:
+        """Up to n plaintext bytes; b"" on clean EOF (peer hangup).
+        Tampering is NOT EOF: an authentication failure raises
+        SecretConnectionError — here and on every subsequent read (the
+        connection is poisoned) — so the mconn recv routine drops the
+        peer for cause instead of reading a quiet close."""
         with self._rmtx:
+            if self._poisoned is not None:
+                raise self._poisoned
             if not self._recv_buf:
                 try:
                     self._recv_buf = self._read_msg()
+                except SecretConnectionError:
+                    raise
                 except ConnectionError:
                     return b""
             out, self._recv_buf = self._recv_buf[:n], self._recv_buf[n:]

@@ -26,6 +26,18 @@ RECONNECT_ATTEMPTS = 30
 RECONNECT_INTERVAL = 3.0
 
 
+def _reconnect_policy() -> tuple[int, float]:
+    """(attempts, interval_s), env-tunable so chaos harnesses can run
+    tight partition-heal cycles without monkeypatching module globals
+    (read per reconnect routine — the knobs apply to live switches)."""
+    from tendermint_tpu.libs.envknob import env_number
+
+    return (
+        int(env_number("TENDERMINT_P2P_RECONNECT_ATTEMPTS", RECONNECT_ATTEMPTS, cast=int)),
+        float(env_number("TENDERMINT_P2P_RECONNECT_INTERVAL_S", RECONNECT_INTERVAL)),
+    )
+
+
 class Reactor:
     """Interface (switch.go:20-28). Subclasses are BaseServices too."""
 
@@ -132,6 +144,16 @@ class Switch(BaseService):
 
     def add_listener(self, listener) -> None:
         self.listeners.append(listener)
+
+    def start_listener(self, listener) -> None:
+        """Add AND serve a listener on a running switch — the
+        listener-churn arm of the network chaos tier (on_start owns the
+        boot-time set; this is for listeners (re)created later)."""
+        self.listeners.append(listener)
+        threading.Thread(
+            target=self._listener_routine, args=(listener,), daemon=True,
+            name="switch.listener",
+        ).start()
 
     def _listener_routine(self, listener) -> None:
         while self.is_running():
@@ -307,7 +329,16 @@ class Switch(BaseService):
         try:
             self.dial_peer_with_address(addr, persistent=True)
         except Exception as exc:  # noqa: BLE001
-            self.logger.info("error dialing seed %s: %s", addr, exc)
+            # seeds are PERSISTENT peers: a transiently failed boot dial
+            # (slow handshake under load, listener not accepting yet)
+            # must retry like any dropped persistent peer — fire-once
+            # left a permanently degraded mesh (round-12 chaos-tier
+            # finding: a 4-node net missing one link can wedge consensus
+            # in a 2-2 height split)
+            self.logger.info(
+                "error dialing seed %s: %s; entering reconnect loop", addr, exc
+            )
+            self._reconnect_routine(str(addr))
 
     # -- removal / errors ---------------------------------------------------
 
@@ -315,10 +346,26 @@ class Switch(BaseService):
         """Release an inbound stream's IP-range count exactly once: the
         error path in _accept_peer and peer removal can race (a started
         peer may die while add_peer is still unwinding), and a double
-        decrement would steal counts from other live peers."""
+        decrement would steal counts from other live peers.
+
+        The marker lives on the RAW socket stream, which peer admission
+        WRAPS (fuzz wrapper, secret connection — each keeps its inner
+        stream as `.stream`): walk the chain to find it. Before round 12
+        this looked only at the outermost object, so every successfully
+        admitted auth_enc inbound peer leaked its count on removal — 16
+        churn cycles from one /24 (i.e. any loopback testnet) and the
+        node refused ALL inbound forever (the real-TCP chaos tier's
+        first catch)."""
         with self._mtx:
-            ip = getattr(stream, "counted_ip", "")
-            stream.counted_ip = ""
+            ip = ""
+            obj, hops = stream, 0
+            while obj is not None and hops < 4:
+                ip = getattr(obj, "counted_ip", "")
+                if ip:
+                    obj.counted_ip = ""
+                    break
+                obj = getattr(obj, "stream", None)
+                hops += 1
         if ip:
             self.ip_ranges.remove(ip)
 
@@ -359,10 +406,11 @@ class Switch(BaseService):
             self._reconnecting.add(addr_str)
         try:
             addr = NetAddress.from_string(addr_str)
-            for i in range(RECONNECT_ATTEMPTS):
+            attempts, interval = _reconnect_policy()
+            for i in range(attempts):
                 if not self.is_running():
                     return
-                time.sleep(RECONNECT_INTERVAL)
+                time.sleep(interval)
                 try:
                     self.dial_peer_with_address(addr, persistent=True)
                     return

@@ -84,6 +84,18 @@ def validate_block(state: State, block, batch_verifier=None) -> None:
         except CommitError as e:
             raise InvalidBlockError(str(e)) from e
 
+    # the evidence section is PROPOSER-CONTROLLED input: every piece must
+    # be a provable prior-height double-sign by a validator of this chain
+    # before any honest node prevotes the block (types/evidence.py)
+    from tendermint_tpu.types.evidence import EvidenceError
+
+    try:
+        block.evidence.validate(
+            state.chain_id, block.header.height, state.validators
+        )
+    except EvidenceError as e:
+        raise InvalidBlockError(f"invalid evidence: {e}") from e
+
 
 def exec_block_on_proxy_app(event_cache, proxy_app_conn, block) -> ABCIResponses:
     """BeginBlock -> streamed DeliverTx -> EndBlock

@@ -82,8 +82,11 @@ class DevChain:
         )
         return Commit(block_id, [self.pv.sign_vote(self.state.chain_id, vote)])
 
-    def commit_block(self, txs: list[bytes] | None = None) -> Block:
-        """Make, store, and apply the next block; returns it."""
+    def commit_block(self, txs: list[bytes] | None = None,
+                     evidence=None) -> Block:
+        """Make, store, and apply the next block; returns it. `evidence`
+        embeds an EvidenceData section (round 12) — the devchain is how
+        unit tests mint committed blocks that carry evidence."""
         height = self.state.last_block_height + 1
         last_commit = (
             empty_commit() if height == 1 else self._last_seen_commit
@@ -99,6 +102,7 @@ class DevChain:
             part_size=self.state.params().block_gossip.block_part_size_bytes,
             time_ns=self.state.last_block_time_ns + 1_000_000_000,
             part_hasher=self.hasher.part_leaf_hashes if self.hasher else None,
+            evidence=evidence,
         )
         seen_commit = self._sign_commit(block, parts.header())
         self.block_store.save_block(block, parts, seen_commit)

@@ -35,28 +35,33 @@ class Header:
     data_hash: bytes = b""
     validators_hash: bytes = b""
     app_hash: bytes = b""
+    evidence_hash: bytes = b""
 
     def hash(self) -> bytes:
         """Merkle-of-map; nil until validators_hash is set
-        (types/block.go:173-188)."""
+        (types/block.go:173-188). The Evidence key joins the map only
+        when the block actually carries evidence, so evidence-free
+        headers hash EXACTLY as they did before the section existed —
+        the scenario soaks' byte-identity assertions span the change."""
         if not self.validators_hash:
             return b""
         e = Encoder()
         self.last_block_id.encode(e)
         last_block_id_bytes = e.buf()
-        return simple_hash_from_map(
-            {
-                "ChainID": self.chain_id.encode(),
-                "Height": Encoder().write_varint(self.height).buf(),
-                "Time": Encoder().write_time_ns(self.time_ns).buf(),
-                "NumTxs": Encoder().write_varint(self.num_txs).buf(),
-                "LastBlockID": last_block_id_bytes,
-                "LastCommit": self.last_commit_hash,
-                "Data": self.data_hash,
-                "Validators": self.validators_hash,
-                "App": self.app_hash,
-            }
-        )
+        fields = {
+            "ChainID": self.chain_id.encode(),
+            "Height": Encoder().write_varint(self.height).buf(),
+            "Time": Encoder().write_time_ns(self.time_ns).buf(),
+            "NumTxs": Encoder().write_varint(self.num_txs).buf(),
+            "LastBlockID": last_block_id_bytes,
+            "LastCommit": self.last_commit_hash,
+            "Data": self.data_hash,
+            "Validators": self.validators_hash,
+            "App": self.app_hash,
+        }
+        if self.evidence_hash:
+            fields["Evidence"] = self.evidence_hash
+        return simple_hash_from_map(fields)
 
     def encode(self, e: Encoder) -> None:
         e.write_string(self.chain_id)
@@ -68,6 +73,7 @@ class Header:
         e.write_bytes(self.data_hash)
         e.write_bytes(self.validators_hash)
         e.write_bytes(self.app_hash)
+        e.write_bytes(self.evidence_hash)
 
     @classmethod
     def decode(cls, d: Decoder) -> "Header":
@@ -81,6 +87,7 @@ class Header:
             data_hash=d.read_bytes(),
             validators_hash=d.read_bytes(),
             app_hash=d.read_bytes(),
+            evidence_hash=d.read_bytes(),
         )
 
     def to_json(self):
@@ -94,6 +101,7 @@ class Header:
             "data_hash": self.data_hash.hex().upper(),
             "validators_hash": self.validators_hash.hex().upper(),
             "app_hash": self.app_hash.hex().upper(),
+            "evidence_hash": self.evidence_hash.hex().upper(),
         }
 
     @classmethod
@@ -111,6 +119,12 @@ class Header:
             data_hash=jv.hex_field(obj, "data_hash"),
             validators_hash=jv.hex_field(obj, "validators_hash"),
             app_hash=jv.hex_field(obj, "app_hash"),
+            # defensive input handling for an absent field — NOT a
+            # cross-version upgrade path (the binary codec is not
+            # backward readable either; docs/specification/
+            # block-structure.md round-12 format note)
+            evidence_hash=jv.hex_field(obj, "evidence_hash")
+            if "evidence_hash" in obj else b"",
         )
 
 
@@ -278,10 +292,14 @@ class Data:
 
 
 class Block:
-    def __init__(self, header: Header, data: Data, last_commit: Commit):
+    def __init__(self, header: Header, data: Data, last_commit: Commit,
+                 evidence=None):
+        from tendermint_tpu.types.evidence import EvidenceData
+
         self.header = header
         self.data = data
         self.last_commit = last_commit
+        self.evidence = evidence if evidence is not None else EvidenceData()
 
     @classmethod
     def make_block(
@@ -297,8 +315,18 @@ class Block:
         time_ns: int | None = None,
         part_hasher=None,
         part_tree_hasher=None,
+        evidence=None,
     ) -> tuple["Block", PartSet]:
-        """MakeBlock equivalent (types/block.go:26-44): block + its part set."""
+        """MakeBlock equivalent (types/block.go:26-44): block + its part set.
+        `evidence` is the proposer's drained pool (types/evidence.py
+        EvidenceData or a plain list); omitted = an empty section whose
+        header bytes hash identically to the pre-evidence format."""
+        from tendermint_tpu.types.evidence import EvidenceData
+
+        if evidence is None:
+            evidence = EvidenceData()
+        elif not isinstance(evidence, EvidenceData):
+            evidence = EvidenceData(list(evidence))
         header = Header(
             chain_id=chain_id,
             height=height,
@@ -308,7 +336,7 @@ class Block:
             validators_hash=val_hash,
             app_hash=app_hash,
         )
-        block = cls(header, Data(txs=list(txs)), commit)
+        block = cls(header, Data(txs=list(txs)), commit, evidence=evidence)
         block.fill_header()
         return block, block.make_part_set(
             part_size, hasher=part_hasher, tree_hasher=part_tree_hasher
@@ -319,6 +347,8 @@ class Block:
             self.header.last_commit_hash = self.last_commit.hash()
         if not self.header.data_hash:
             self.header.data_hash = self.data.hash()
+        if not self.header.evidence_hash:
+            self.header.evidence_hash = self.evidence.hash()
 
     def hash(self) -> bytes:
         if self.header is None or self.data is None or self.last_commit is None:
@@ -360,6 +390,8 @@ class Block:
                 return err
         if h.data_hash != self.data.hash():
             return "wrong data_hash"
+        if h.evidence_hash != self.evidence.hash():
+            return "wrong evidence_hash"
         if h.app_hash != app_hash:
             return f"wrong app_hash: {h.app_hash.hex()} != {app_hash.hex()}"
         return None
@@ -370,6 +402,7 @@ class Block:
         self.header.encode(e)
         self.data.encode(e)
         self.last_commit.encode(e)
+        self.evidence.encode(e)
 
     def to_bytes(self) -> bytes:
         e = Encoder()
@@ -378,7 +411,14 @@ class Block:
 
     @classmethod
     def decode(cls, d: Decoder) -> "Block":
-        return cls(Header.decode(d), Data.decode(d), Commit.decode(d))
+        from tendermint_tpu.types.evidence import EvidenceData
+
+        return cls(
+            Header.decode(d),
+            Data.decode(d),
+            Commit.decode(d),
+            evidence=EvidenceData.decode(d),
+        )
 
     @classmethod
     def from_bytes(cls, b: bytes) -> "Block":
@@ -393,17 +433,23 @@ class Block:
             "header": self.header.to_json(),
             "data": self.data.to_json(),
             "last_commit": self.last_commit.to_json(),
+            "evidence": self.evidence.to_json(),
         }
 
     @classmethod
     def from_json(cls, obj) -> "Block":
         from tendermint_tpu.codec import jsonval as jv
+        from tendermint_tpu.types.evidence import EvidenceData
 
         obj = jv.require_dict(obj)
         return cls(
             Header.from_json(jv.dict_field(obj, "header")),
             Data.from_json(jv.dict_field(obj, "data")),
             Commit.from_json(jv.dict_field(obj, "last_commit")),
+            evidence=(
+                EvidenceData.from_json(jv.dict_field(obj, "evidence"))
+                if "evidence" in obj else EvidenceData()
+            ),
         )
 
     def block_id(self, part_set: PartSet) -> BlockID:

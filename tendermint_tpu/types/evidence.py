@@ -8,15 +8,31 @@ types/vote_set.go:137-172). Here the detection site hands the pair to an
 EvidencePool so byzantine drills (and operators, via the `evidence` RPC)
 can assert that double-signing was SEEN — slashing/punishment remains
 application policy, exactly as in the reference.
+
+Round 12 extends the path end to end: evidence now COMMITS. Blocks
+carry an EvidenceData section (types/block.py) whose Merkle root rides
+the header as `evidence_hash`; the proposer drains the pool's pending
+set into each proposal, every validator re-validates the section
+cryptographically before prevoting (state/execution.validate_block),
+and finalize marks the pieces committed — so one node detecting a
+double-signer is enough for the whole network to end up with the proof
+ON CHAIN, which the real-TCP byzantine scenario asserts byte-identically
+across nodes (tests/test_netchaos.py).
 """
 
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
+from tendermint_tpu.codec.binary import Decoder, Encoder
 from tendermint_tpu.crypto.hashing import ripemd160
+from tendermint_tpu.crypto.keys import pub_key_from_json
 from tendermint_tpu.types.vote import Vote
+
+# bound per block: evidence is ~600 B a piece (two signed votes + key);
+# 64 keeps the worst-case section far below one 64 KB block part
+MAX_EVIDENCE_PER_BLOCK = 64
 
 
 class EvidenceError(Exception):
@@ -63,6 +79,10 @@ class DuplicateVoteEvidence:
             raise EvidenceError("votes are not for the same (val, H, R, type)")
         if a.block_id.key() == b.block_id.key():
             raise EvidenceError("votes agree — no conflict")
+        if b.block_id.key() < a.block_id.key():
+            # canonical order is part of validity: otherwise the same
+            # conflict hashes two ways and dedup double-counts it
+            raise EvidenceError("evidence votes not in canonical order")
         if self.pub_key.address() != a.validator_address:
             raise EvidenceError("pub_key does not match validator address")
         for v in (a, b):
@@ -76,6 +96,25 @@ class DuplicateVoteEvidence:
             self.vote_a.sign_bytes("") + b"/" + self.vote_b.sign_bytes("")
         )
 
+    # -- binary (block embedding) ------------------------------------------
+
+    def encode(self, e: Encoder) -> None:
+        e.write_bytes(self.pub_key.bytes_())
+        self.vote_a.encode(e)
+        self.vote_b.encode(e)
+
+    def to_bytes(self) -> bytes:
+        e = Encoder()
+        self.encode(e)
+        return e.buf()
+
+    @classmethod
+    def decode(cls, d: Decoder) -> "DuplicateVoteEvidence":
+        from tendermint_tpu.crypto.keys import pub_key_from_bytes
+
+        pub = pub_key_from_bytes(d.read_bytes())
+        return cls(pub, Vote.decode(d), Vote.decode(d))
+
     def to_json(self):
         return {
             "type": "duplicate_vote",
@@ -83,9 +122,23 @@ class DuplicateVoteEvidence:
             "round": self.vote_a.round_,
             "vote_type": self.vote_a.type_,
             "validator_address": self.address.hex().upper(),
+            "pub_key": self.pub_key.to_json(),
             "vote_a": self.vote_a.to_json(),
             "vote_b": self.vote_b.to_json(),
         }
+
+    @classmethod
+    def from_json(cls, obj) -> "DuplicateVoteEvidence":
+        from tendermint_tpu.codec import jsonval as jv
+
+        obj = jv.require_dict(obj)
+        if obj.get("type") != "duplicate_vote":
+            raise ValueError(f"unknown evidence type {obj.get('type')!r}")
+        return cls(
+            pub_key_from_json(obj.get("pub_key")),
+            Vote.from_json(jv.dict_field(obj, "vote_a")),
+            Vote.from_json(jv.dict_field(obj, "vote_b")),
+        )
 
 
 class EvidencePool:
@@ -96,6 +149,12 @@ class EvidencePool:
         self._max = max_size
         self._by_hash: dict[bytes, DuplicateVoteEvidence] = {}
         self._order: list[bytes] = []
+        # committed-hash memory is FIFO-bounded like the pool itself (a
+        # dict for insertion order): pruning the oldest is safe — its
+        # piece is deep in chain history, and a replayed copy would be
+        # rejected by block validation long before it mattered
+        self._committed: dict[bytes, None] = {}
+        self._committed_max = max(4 * max_size, 4096)
         self._mtx = threading.Lock()
 
     def add(self, ev: DuplicateVoteEvidence, chain_id: str) -> bool:
@@ -129,3 +188,129 @@ class EvidencePool:
     def size(self) -> int:
         with self._mtx:
             return len(self._order)
+
+    # -- block embedding (round 12) ----------------------------------------
+
+    def pending(self, limit: int = MAX_EVIDENCE_PER_BLOCK,
+                before_height: int | None = None) -> list:
+        """Validated evidence not yet seen in a committed block — what a
+        proposer drains into its next proposal. `before_height` is the
+        PROPOSAL height: a block may only carry strictly-older evidence
+        (EvidenceData.validate), so same-height detections wait one
+        height."""
+        with self._mtx:
+            out = []
+            for h in self._order:
+                if h in self._committed:
+                    continue
+                ev = self._by_hash[h]
+                if before_height is not None and ev.height >= before_height:
+                    continue
+                out.append(ev)
+                if len(out) >= limit:
+                    break
+            return out
+
+    def mark_committed(self, evidence: list) -> None:
+        """A block carrying `evidence` was committed: remember each piece
+        so it is never re-proposed, and adopt pieces this node had not
+        detected itself (they arrived cryptographically validated — the
+        block passed validate_block before apply), so every node's
+        `evidence` RPC converges on the on-chain set."""
+        with self._mtx:
+            for ev in evidence:
+                h = ev.hash()
+                self._committed[h] = None
+                while len(self._committed) > self._committed_max:
+                    self._committed.pop(next(iter(self._committed)))
+                if h not in self._by_hash:
+                    if len(self._order) >= self._max:
+                        # evict an already-committed entry first: a
+                        # pending (detected, not-yet-proposed) piece must
+                        # never be forgotten to remember one that is
+                        # already on chain
+                        victim_i = next(
+                            (i for i, old in enumerate(self._order)
+                             if old in self._committed),
+                            0,
+                        )
+                        old = self._order.pop(victim_i)
+                        self._by_hash.pop(old, None)
+                    self._by_hash[h] = ev
+                    self._order.append(h)
+
+    def committed_count(self) -> int:
+        with self._mtx:
+            return len(self._committed)
+
+
+@dataclass
+class EvidenceData:
+    """The block's evidence section (mirrors Data for txs): a bounded
+    list of DuplicateVoteEvidence, Merkle-rooted into the header as
+    `evidence_hash` (empty list = empty hash = a header byte-identical
+    to the pre-evidence format)."""
+
+    evidence: list = field(default_factory=list)
+    _hash: bytes | None = None
+
+    def hash(self) -> bytes:
+        from tendermint_tpu.merkle.simple import leaf_hash, simple_hash_from_hashes
+
+        if self._hash is None:
+            if not self.evidence:
+                self._hash = b""
+            else:
+                self._hash = simple_hash_from_hashes(
+                    [leaf_hash(ev.to_bytes()) for ev in self.evidence]
+                )
+        return self._hash
+
+    def validate(self, chain_id: str, block_height: int, validators) -> None:
+        """Raise EvidenceError unless every piece is a provable,
+        in-committee, prior-height double-sign and the section carries no
+        duplicates (the proposer controls this list — it is adversarial
+        input to every other validator)."""
+        if len(self.evidence) > MAX_EVIDENCE_PER_BLOCK:
+            raise EvidenceError(
+                f"too much evidence: {len(self.evidence)} > {MAX_EVIDENCE_PER_BLOCK}"
+            )
+        seen: set[bytes] = set()
+        for ev in self.evidence:
+            if not isinstance(ev, DuplicateVoteEvidence):
+                raise EvidenceError("unknown evidence kind in block")
+            h = ev.hash()
+            if h in seen:
+                raise EvidenceError("duplicate evidence in block")
+            seen.add(h)
+            if not 1 <= ev.height < block_height:
+                raise EvidenceError(
+                    f"evidence height {ev.height} outside [1, {block_height})"
+                )
+            if validators is not None and not validators.has_address(ev.address):
+                raise EvidenceError(
+                    f"evidence validator {ev.address.hex()[:12]} not in the set"
+                )
+            ev.validate(chain_id)
+
+    def encode(self, e: Encoder) -> None:
+        e.write_list(self.evidence, lambda enc, ev: ev.encode(enc))
+
+    @classmethod
+    def decode(cls, d: Decoder) -> "EvidenceData":
+        return cls(d.read_list(DuplicateVoteEvidence.decode))
+
+    def to_json(self):
+        return {"evidence": [ev.to_json() for ev in self.evidence]}
+
+    @classmethod
+    def from_json(cls, obj) -> "EvidenceData":
+        from tendermint_tpu.codec import jsonval as jv
+
+        obj = jv.require_dict(obj)
+        return cls(
+            [
+                DuplicateVoteEvidence.from_json(o)
+                for o in jv.list_field(obj, "evidence", MAX_EVIDENCE_PER_BLOCK)
+            ]
+        )

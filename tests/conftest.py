@@ -40,6 +40,41 @@ from tendermint_tpu.jitcache import enable as _enable_jit_cache  # noqa: E402
 _enable_jit_cache()
 
 
+# Round 12 closed the `cryptography` dependency hole: every transport/
+# key primitive is in-repo (crypto/x25519, crypto/chacha20poly1305, pure
+# secp256k1), so NO test may ever again skip — or fail collection —
+# because a crypto backend is missing. The only sanctioned mentions are
+# the explicitly-labeled parity-oracle skips (cross-checks that NEED the
+# optional package to have something to compare against).
+_ILLEGAL_CRYPTO_SKIPS: list = []
+
+
+def pytest_runtest_logreport(report):
+    if not report.skipped:
+        return
+    reason = (
+        report.longrepr[2]
+        if isinstance(report.longrepr, tuple)
+        else str(report.longrepr)
+    )
+    low = reason.lower()
+    if ("cryptography" in low or "libcrypto" in low) and \
+            "parity oracle" not in low and "oracle" not in low:
+        _ILLEGAL_CRYPTO_SKIPS.append((report.nodeid, reason))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _ILLEGAL_CRYPTO_SKIPS:
+        import pytest as _pytest
+
+        raise _pytest.UsageError(
+            "tests skipped for a missing crypto backend — the round-12 "
+            "in-repo transport contract forbids this (mark genuine "
+            "cross-check skips with 'parity oracle' in the reason): "
+            + "; ".join(f"{nid}: {r}" for nid, r in _ILLEGAL_CRYPTO_SKIPS)
+        )
+
+
 def pytest_collection_modifyitems(config, items):
     """Deselect slow-marked tests on whole-suite runs (keeps the default
     `pytest tests/` under a minute), but honor an explicit -m expression

@@ -325,17 +325,13 @@ class TestSecp256k1:
     def test_low_s_and_tamper_rejection(self):
         from tendermint_tpu.crypto import secp256k1
         from tendermint_tpu.crypto.keys import gen_priv_key_secp256k1
-        from cryptography.hazmat.primitives.asymmetric.utils import (
-            decode_dss_signature,
-            encode_dss_signature,
-        )
 
         pk = gen_priv_key_secp256k1(b"low-s")
         sig = pk.sign(b"msg")
-        r, s = decode_dss_signature(sig.raw)
+        r, s = secp256k1.decode_der(sig.raw)
         assert s <= secp256k1._N // 2
         # the high-s twin verifies under naive ECDSA but must be rejected
-        high = encode_dss_signature(r, secp256k1._N - s)
+        high = secp256k1.encode_der(r, secp256k1._N - s)
         assert not secp256k1.verify(pk.pub_key().raw, b"msg", high)
 
     def test_json_roundtrip_and_dispatch(self):

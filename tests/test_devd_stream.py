@@ -239,6 +239,13 @@ def test_malformed_stream_leaves_daemon_serving(sim_daemon):
         conn.sendall(struct.pack(">I", 2) + b"\x01\x02")
         bad._collect_stream(conn, _NopThread(), [], 1)
     bad.close()
+    # the daemon counts the abort on ITS side of the torn stream — poll
+    # briefly: under a loaded suite the error handling can land after
+    # the client's exception (the status read raced it)
+    deadline = time.monotonic() + 5.0
+    while client.status()["stream"]["errors"] < 1 and \
+            time.monotonic() < deadline:
+        time.sleep(0.05)
     rep = client.status()
     assert rep["stream"]["errors"] >= 1
     assert all(client.verify_stream(

@@ -218,6 +218,11 @@ class TestSigPreVerification:
                 release.wait(30)
                 return [True] * len(items)
 
+            def verify_batch_async(self, items):
+                # the real gateway contract (round-1 pipelined gate):
+                # enqueue now, block in the resolver
+                return lambda: self.verify_batch(items)
+
         batcher = SigBatcher(SlowVerifier(), parse_sig_tx,
                              max_batch=1, max_wait_s=0.001, max_backlog=2)
         app = SignedKVStoreApp(verify_in_app=False)

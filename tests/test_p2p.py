@@ -90,9 +90,17 @@ def test_secret_connection_tampering_detected():
     t.start()
     ca = SecretConnection(a, ka)
     t.join(5)
-    # corrupt a ciphertext frame on the raw stream underneath
+    # corrupt a ciphertext frame on the raw stream underneath: tampering
+    # must RAISE (round 12) — the old b"" return read as a graceful peer
+    # hangup, hiding an active attack as EOF
+    from tendermint_tpu.p2p.secret_connection import SecretConnectionError
+
     ca.stream.write(b"\x00\x20" + b"\x00" * 32)
-    assert out["conn"].read(10) == b""  # auth failure -> EOF (conn poisoned)
+    with pytest.raises(SecretConnectionError):
+        out["conn"].read(10)
+    # and the connection stays poisoned: every later read raises too
+    with pytest.raises(SecretConnectionError):
+        out["conn"].read(1)
     ca.close()
 
 
@@ -291,6 +299,36 @@ def test_switch_tcp_listener_end_to_end():
         assert wait_until(lambda: sw_a.peers.size() == 1)
         peer.send(0x05, b"over tcp")
         assert wait_until(lambda: ra.received and ra.received[0][1] == b"over tcp")
+    finally:
+        sw_a.stop()
+        sw_b.stop()
+
+
+def test_inbound_ip_range_count_released_on_peer_removal():
+    """Regression (round 12, caught by the real-TCP chaos tier): the
+    inbound IP-range count is taken on the RAW socket stream, which peer
+    admission wraps in a SecretConnection — removal must UNcount through
+    the wrapper chain, or 16 inbound churn cycles from one /24 (any
+    loopback testnet) permanently exhaust the accept budget."""
+    from tendermint_tpu.p2p.listener import Listener
+
+    sw_a, sw_b = Switch(), Switch()
+    sw_a.add_reactor("echo", EchoReactor())
+    sw_b.add_reactor("echo", EchoReactor())
+    lst = Listener("127.0.0.1:0")
+    sw_a.add_listener(lst)
+    sw_a.start()
+    sw_b.start()
+    try:
+        port = lst.internal_address().port
+        for _ in range(3):
+            sw_b.dial_peer_with_address(NetAddress("127.0.0.1", port))
+            assert wait_until(lambda: sw_a.peers.size() == 1)
+            assert sw_a.ip_ranges.count("127") == 1
+            sw_a.stop_peer_for_error(sw_a.peers.list()[0], "churn")
+            assert wait_until(lambda: sw_b.peers.size() == 0)
+            # the count must drop with the peer — this leaked pre-round-12
+            assert wait_until(lambda: sw_a.ip_ranges.count("127") == 0)
     finally:
         sw_a.stop()
         sw_b.stop()
