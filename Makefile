@@ -24,7 +24,7 @@ test:
 # hold (dots no worse than the seed) — plus the chip-free hash-stream
 # smoke (the two asserted BENCH_r07 rows: streamed hash offload >= 1.3x
 # single-shot on the sim transport, flat host builder >= 1.5x recursive).
-tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke metrics-smoke net-chaos-smoke
+tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Chip-free bench smoke: every BASELINE config on the pinned CPU backend,
@@ -66,6 +66,15 @@ wal-torture-smoke:
 statesync-smoke:
 	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_STATESYNC_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_statesync.py
 
+# State-tree smoke, chip-free (~20 s): bench_statetree.py's reduced pass —
+# authenticated-tree build + incremental-commit-vs-rebuild floor, proof
+# correctness rows (membership/absence verify, tamper/wrong-root refused),
+# and a full->delta snapshot round trip with an injected corrupt chunk
+# REJECTED (the full matrix lives in tests/test_statetree.py +
+# tests/test_statesync_delta.py). Runs as part of `make tier1`.
+statetree-smoke:
+	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_STATETREE_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_statetree.py
+
 # Network chaos smoke, chip-free (~40 s): bench_netchaos.py's reduced
 # pass — a 4-node REAL-TCP testnet (in-repo SecretConnection on every
 # link, ops/netfaults proxies in the middle) commits through one
@@ -97,4 +106,4 @@ test_slow:
 native:
 	$(MAKE) -C native
 
-.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke metrics-smoke net-chaos-smoke
+.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke

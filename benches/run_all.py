@@ -39,6 +39,13 @@ Configs (BASELINE.md):
                   and committed-tx/s recorded, halt-under-partition and
                   byte-identical convergence asserted (writes
                   BENCH_r12.json; chip-free)
+ 13 statetree    — authenticated app-state commitment: incremental
+                  commit vs full tree rebuild, proof correctness rows,
+                  delta-vs-full snapshot bytes (delta asserted <= 0.5x
+                  full at the larger state size), streamed vs
+                  single-shot node hashing on the sim transport (writes
+                  BENCH_r13.json; chip-free rows asserted, the
+                  live-daemon row auto-appends on a tunnel window)
 
 Each bench is its own process (the TPU is exclusive per process).
 Usage: python benches/run_all.py [--skip testnet,...]
@@ -67,6 +74,7 @@ BENCHES = {
     "10_telemetry": [sys.executable, "benches/bench_telemetry.py"],
     "11_rpc_load": [sys.executable, "benches/bench_rpc_load.py"],
     "12_netchaos": [sys.executable, "benches/bench_netchaos.py"],
+    "13_statetree": [sys.executable, "benches/bench_statetree.py"],
 }
 
 

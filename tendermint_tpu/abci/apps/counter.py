@@ -75,6 +75,10 @@ class CounterApp(Application):
         return ResponseCommit(code=CODE_OK, data=struct.pack(">Q", self.tx_count))
 
     def query(self, data: bytes, path: str = "", height: int = 0, prove: bool = False) -> ResponseQuery:
+        if prove:
+            from tendermint_tpu.abci.types import proofs_unsupported_response
+
+            return proofs_unsupported_response(self, data)
         if path == "hash" or data == b"hash":
             return ResponseQuery(code=CODE_OK, value=str(self.tx_count).encode())
         if path == "tx" or data == b"tx":

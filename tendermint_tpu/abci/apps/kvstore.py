@@ -2,8 +2,17 @@
 behind the 4-node testnet north star and most consensus tests
 (consensus/common_test.go:26-27).
 
-Txs are "key=value" (or raw bytes stored as key=key). The app hash is the
-Merkle root over sorted kv pairs, so all correct nodes agree on state.
+Txs are "key=value" (or raw bytes stored as key=key). Round 13: the app
+hash is the root of an AUTHENTICATED state tree (statetree.VersionedTree
+— a canonical merkleized treap, docs/state-tree.md) instead of a full
+simple_hash_from_map rebuild per commit: commits recompute only the
+O(changed * log n) dirty nodes (batched through the gateway hash plane
+when wired), `query(prove=True)` answers with a real membership/absence
+proof a light client verifies against a header's app_hash, and the
+versioned roots power delta snapshots (statesync/producer.py). The
+plain `state` dict stays as the serialization/iteration mirror; the
+tree is the commitment.
+
 The persistent variant survives restarts (handshake/replay tests) and
 accepts validator-set change txs: "val:<pubkey_hex>/<power>" — the
 reference's persistent_dummy behavior.
@@ -27,9 +36,14 @@ from tendermint_tpu.abci.types import (
     ResponseInfo,
     ResponseQuery,
 )
-from tendermint_tpu.merkle.simple import simple_hash_from_map
+from tendermint_tpu.statetree import VersionedTree
+from tendermint_tpu.statetree.tree import TreeError
 
 VAL_TX_PREFIX = b"val:"
+# round 13: "rm:<key>" deletes a key (beyond the reference dummy, which
+# never deletes — an authenticated tree without delete coverage would
+# leave the absence-proof/delta-delete planes untested end to end)
+DEL_TX_PREFIX = b"rm:"
 
 
 class KVStoreApp(Application):
@@ -37,6 +51,11 @@ class KVStoreApp(Application):
         self.state: dict[str, bytes] = {}
         self.height = 0
         self.app_hash = b""
+        # the authenticated commitment over the state map: one immutable
+        # root per committed height. node/node.py (and DevChain) inject
+        # the gateway Hasher post-construction so dirty-node recompute
+        # batches onto the device plane.
+        self.tree = VersionedTree()
 
     def info(self) -> ResponseInfo:
         return ResponseInfo(
@@ -49,6 +68,11 @@ class KVStoreApp(Application):
         return ResponseCheckTx(code=CODE_OK)
 
     def deliver_tx(self, tx: bytes) -> ResponseDeliverTx:
+        if tx.startswith(DEL_TX_PREFIX):
+            k = tx[len(DEL_TX_PREFIX):]
+            self.state.pop(k.decode("latin-1"), None)
+            self.tree.delete(k)
+            return ResponseDeliverTx(code=CODE_OK)
         if b"=" in tx:
             k, v = tx.split(b"=", 1)
         else:
@@ -56,21 +80,46 @@ class KVStoreApp(Application):
         # latin-1 is a lossless byte<->str bijection: distinct byte keys
         # stay distinct (the reference dummy app keys on raw bytes)
         self.state[k.decode("latin-1")] = v
+        self.tree.set(k, v)
         return ResponseDeliverTx(code=CODE_OK)
 
     def commit(self) -> ResponseCommit:
         self.height += 1
-        self.app_hash = (
-            simple_hash_from_map(self.state) if self.state else b""
-        )
+        self.app_hash = self.tree.commit(self.height)
         return ResponseCommit(code=CODE_OK, data=self.app_hash)
 
     def query(self, data: bytes, path: str = "", height: int = 0, prove: bool = False) -> ResponseQuery:
         key = data.decode("latin-1")
-        value = self.state.get(key)
-        if value is None:
-            return ResponseQuery(code=CODE_OK, key=data, log="does not exist")
-        return ResponseQuery(code=CODE_OK, key=data, value=value, log="exists")
+        if not prove:
+            value = self.state.get(key)
+            if value is None:
+                return ResponseQuery(code=CODE_OK, key=data, log="does not exist")
+            return ResponseQuery(code=CODE_OK, key=data, value=value, log="exists")
+        # proof-backed read: prove against a COMMITTED root (the proof's
+        # height binds to header (height+1).app_hash on the light side)
+        version = int(height) if height else self.height
+        if version < 1:
+            return ResponseQuery(
+                code=CODE_UNAUTHORIZED, key=data,
+                log="no committed state to prove against",
+            )
+        try:
+            proof = self.tree.prove(data, version)
+        except TreeError as exc:
+            return ResponseQuery(
+                code=CODE_UNAUTHORIZED, key=data, height=version,
+                log=f"cannot prove at height {version}: {exc}",
+            )
+        proof_bytes = json.dumps(proof.to_json(), sort_keys=True).encode()
+        if proof.value is None:
+            return ResponseQuery(
+                code=CODE_OK, key=data, proof=proof_bytes, height=version,
+                log="does not exist",
+            )
+        return ResponseQuery(
+            code=CODE_OK, key=data, value=proof.value, proof=proof_bytes,
+            height=version, log="exists",
+        )
 
     # -- state-sync hooks --------------------------------------------------
 
@@ -102,10 +151,16 @@ class KVStoreApp(Application):
         state = {k: bytes.fromhex(v) for k, v in obj["state"].items()}
         if not isinstance(new_height, int) or isinstance(new_height, bool) or new_height < 1:
             raise ValueError(f"bad snapshot height {new_height!r}")
-        # the app hash is a pure function of the state map: recompute it
-        # rather than trust the snapshot's claim — a payload whose hash
-        # and state disagree must refuse here, before anything mutates
-        recomputed = simple_hash_from_map(state) if state else b""
+        # the app hash is a pure function of the state map (the tree's
+        # shape is canonical in the key set): recompute it rather than
+        # trust the snapshot's claim — a payload whose hash and state
+        # disagree must refuse here, before anything mutates
+        tree = VersionedTree.from_entries(
+            {k.encode("latin-1"): v for k, v in state.items()},
+            new_height,
+            hasher=self.tree.hasher, keep_recent=self.tree.keep_recent,
+        )
+        recomputed = tree.root_hash()
         if recomputed != claimed_hash:
             raise ValueError("snapshot app_hash does not match its state")
         if height is not None and new_height != height:
@@ -117,6 +172,46 @@ class KVStoreApp(Application):
         self.height = new_height
         self.app_hash = claimed_hash
         self.state = state
+        self.tree = tree
+
+    def restore_delta(
+        self,
+        upserts: dict[bytes, bytes],
+        deletes: list[bytes],
+        height: int,
+        app_hash: bytes,
+        aux: dict | None = None,
+    ) -> None:
+        """Advance a restored app from its current height to `height` by
+        applying a verified delta. The recomputed tree root MUST equal
+        the light-verified `app_hash`; on mismatch the tree rolls back
+        to its base and nothing is applied or persisted (the delta-
+        restore contract, docs/state-tree.md)."""
+        base = self.height
+        if base < 1:
+            raise ValueError("delta restore needs a restored base state")
+        if not isinstance(height, int) or height <= base:
+            raise ValueError(
+                f"stale delta: app at height {base}, delta targets {height}"
+            )
+        self.tree.rollback_to(base)  # drop any stray staging first
+        for k, v in sorted(upserts.items()):
+            self.tree.set(k, v)
+        for k in deletes:
+            self.tree.delete(k)
+        root = self.tree.commit(height)
+        if root != app_hash:
+            self.tree.rollback_to(base)
+            raise ValueError(
+                "delta does not reproduce the verified app hash at "
+                f"height {height}"
+            )
+        for k, v in upserts.items():
+            self.state[k.decode("latin-1")] = v
+        for k in deletes:
+            self.state.pop(k.decode("latin-1"), None)
+        self.height = height
+        self.app_hash = root
 
 
 class PersistentKVStoreApp(KVStoreApp):
@@ -143,6 +238,21 @@ class PersistentKVStoreApp(KVStoreApp):
         self.app_hash = bytes.fromhex(obj["app_hash"])
         self.state = {k: bytes.fromhex(v) for k, v in obj["state"].items()}
         self.validators = obj.get("validators", {})
+        # rebuild the commitment tree at the persisted height; the
+        # canonical shape guarantees the rebuilt root IS the persisted
+        # app hash — a mismatch means the home predates the state tree
+        # (or rotted) and continuing would diverge at the next commit
+        if self.height > 0:
+            self.tree = VersionedTree.from_entries(
+                {k.encode("latin-1"): v for k, v in self.state.items()},
+                self.height,
+                hasher=self.tree.hasher, keep_recent=self.tree.keep_recent,
+            )
+            if self.tree.root_hash() != self.app_hash:
+                raise ValueError(
+                    f"{self.db_path}: persisted app_hash does not match the "
+                    "state tree root (pre-state-tree home?)"
+                )
 
     def _save(self) -> None:
         tmp = self.db_path + ".tmp"
@@ -219,13 +329,15 @@ class PersistentKVStoreApp(KVStoreApp):
         obj["validators"] = self.validators
         return json.dumps(obj, sort_keys=True).encode()
 
-    def restore(
-        self, data: bytes, height: int | None = None, app_hash: bytes | None = None
-    ) -> None:
-        obj = json.loads(data)
-        if not isinstance(obj, dict):
-            raise ValueError("snapshot app state must be an object")
-        validators = obj.get("validators", {})
+    def snapshot_aux(self) -> dict | None:
+        """App-private sidecar state a DELTA snapshot must carry beyond
+        the tree diff (the registry is not part of the kv commitment).
+        The restorer cross-checks it against the header-verified
+        validator set before restore_delta applies it."""
+        return {"validators": dict(self.validators)}
+
+    @staticmethod
+    def _check_validators_obj(validators) -> None:
         if not isinstance(validators, dict):
             raise ValueError("snapshot validators must be an object")
         for k, power in validators.items():
@@ -235,6 +347,35 @@ class PersistentKVStoreApp(KVStoreApp):
                 bytes.fromhex(k)
             except (TypeError, ValueError):
                 raise ValueError("bad validator pubkey in snapshot")
+
+    def restore(
+        self, data: bytes, height: int | None = None, app_hash: bytes | None = None
+    ) -> None:
+        obj = json.loads(data)
+        if not isinstance(obj, dict):
+            raise ValueError("snapshot app state must be an object")
+        validators = obj.get("validators", {})
+        self._check_validators_obj(validators)
         super().restore(data, height=height, app_hash=app_hash)
         self.validators = validators
+        self._save()
+
+    def restore_delta(
+        self,
+        upserts: dict[bytes, bytes],
+        deletes: list[bytes],
+        height: int,
+        app_hash: bytes,
+        aux: dict | None = None,
+    ) -> None:
+        validators = None
+        if aux is not None:
+            if not isinstance(aux, dict):
+                raise ValueError("bad delta aux")
+            validators = aux.get("validators")
+            if validators is not None:
+                self._check_validators_obj(validators)
+        super().restore_delta(upserts, deletes, height, app_hash, aux=aux)
+        if validators is not None:
+            self.validators = validators
         self._save()

@@ -13,6 +13,22 @@ from dataclasses import dataclass, field
 CODE_OK = 0
 CODE_BAD_NONCE = 4  # counter-app style ordering violation
 CODE_UNAUTHORIZED = 3
+CODE_UNSUPPORTED = 5  # query feature the app cannot serve (e.g. prove=True)
+
+
+def proofs_unsupported_response(app, key: bytes) -> "ResponseQuery":
+    """The CLEAR `prove=True`-against-a-non-proving-app refusal (round
+    13): apps without an authenticated state tree must answer with this
+    instead of silently omitting the proof field — a light client that
+    trusted the bare value would be reading unverified state."""
+    return ResponseQuery(
+        code=CODE_UNSUPPORTED,
+        key=key,
+        log=(
+            f"proofs unsupported: {type(app).__name__} does not maintain "
+            "an authenticated state tree"
+        ),
+    )
 
 
 @dataclass
@@ -197,6 +213,8 @@ class Application:
         return ""
 
     def query(self, data: bytes, path: str = "", height: int = 0, prove: bool = False) -> ResponseQuery:
+        if prove:
+            return proofs_unsupported_response(self, data)
         return ResponseQuery()
 
     def check_tx(self, tx: bytes) -> ResponseCheckTx:

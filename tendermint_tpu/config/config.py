@@ -179,6 +179,10 @@ class StateSyncConfig:
     snapshot_interval: int = 0
     snapshot_keep_recent: int = 2
     chunk_size: int = 65536
+    # every K-th snapshot is FULL; the ones between are deltas against
+    # the previous snapshot (round 13, state-tree apps only; 1 = always
+    # full). keep_recent is clamped to cover the chain.
+    snapshot_full_every: int = 4
 
     def snapshot_dir(self) -> str:
         return _root_join(self.root_dir, "data/snapshots")

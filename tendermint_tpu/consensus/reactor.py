@@ -188,7 +188,15 @@ class PeerState:
             )
 
     def pick_vote_to_send(self, vote_set) -> object | None:
-        """A random vote the peer needs from `vote_set` (reactor.go:899-933)."""
+        """A random vote the peer needs from `vote_set` (reactor.go:899-933).
+
+        Does NOT mark the peer as having it — the caller marks via
+        set_has_vote only AFTER peer.send succeeds (reactor.go's
+        PickSendVote order). Marking at pick time meant a vote whose
+        send failed on a full channel queue (exactly the burst-load
+        moment) was skipped for that peer FOREVER — no other mechanism
+        resends it, and a 2-2 height split could wedge the whole net
+        (the netchaos smoke's stall signature)."""
         if vote_set is None or vote_set.size() == 0:
             return None
         with self._mtx:
@@ -203,10 +211,7 @@ class PeerState:
             index, ok = needed.pick_random()
             if not ok:
                 return None
-            vote = vote_set.get_by_index(index)
-            if vote is not None:
-                ps_bits.set_index(index, True)
-            return vote
+            return vote_set.get_by_index(index)
 
     # -- step transitions --------------------------------------------------
 
@@ -644,8 +649,16 @@ class ConsensusReactor(Reactor, BaseService):
                 continue
             stop.wait(PEER_GOSSIP_SLEEP)
 
-    def _send_vote(self, peer, vote) -> bool:
-        return peer.send(VOTE_CHANNEL, _enc(msgs.VoteMessage(vote)))
+    def _send_vote(self, peer, ps: PeerState, vote) -> bool:
+        """Send one vote and, ONLY on success, mark the peer as having
+        it (the vote carries its own coordinates). A failed send leaves
+        the bit clear so the gossip loop retries it later."""
+        if peer.send(VOTE_CHANNEL, _enc(msgs.VoteMessage(vote))):
+            ps.set_has_vote(
+                vote.height, vote.round_, vote.type_, vote.validator_index
+            )
+            return True
+        return False
 
     def _pick_and_send_vote(self, peer, ps: PeerState, rs, prs: PeerRoundState) -> bool:
         """One needed vote, if any (reactor.go:609-645 gossipVotesForHeight
@@ -658,27 +671,42 @@ class ConsensusReactor(Reactor, BaseService):
                 pol = rs.votes.prevotes(prs.proposal_pol_round)
                 vote = ps.pick_vote_to_send(pol) if pol else None
                 if vote is not None:
-                    return self._send_vote(peer, vote)
+                    return self._send_vote(peer, ps, vote)
             if prs.step <= RoundStep.PREVOTE_WAIT and prs.round_ != -1 and \
                prs.round_ <= rs.round_:
                 vote = ps.pick_vote_to_send(rs.votes.prevotes(prs.round_))
                 if vote is not None:
-                    return self._send_vote(peer, vote)
+                    return self._send_vote(peer, ps, vote)
             if prs.step <= RoundStep.PRECOMMIT_WAIT and prs.round_ != -1 and \
                prs.round_ <= rs.round_:
                 vote = ps.pick_vote_to_send(rs.votes.precommits(prs.round_))
                 if vote is not None:
-                    return self._send_vote(peer, vote)
+                    return self._send_vote(peer, ps, vote)
             if prs.proposal_pol_round != -1:
                 pol = rs.votes.prevotes(prs.proposal_pol_round)
                 vote = ps.pick_vote_to_send(pol) if pol else None
                 if vote is not None:
-                    return self._send_vote(peer, vote)
-        # peer is at our last height: send from our last commit
+                    return self._send_vote(peer, ps, vote)
+        # peer is at our last height: send from our last commit. The
+        # peer's CURRENT round usually raced past the commit round (it
+        # entered a timeout round precisely because the commit votes
+        # didn't reach it), so its prevote/precommit arrays track the
+        # wrong round and _get_vote_bit_array would find NOTHING —
+        # ensure the catchup-commit tracking array at the commit's round
+        # first, exactly like the >= +2 stored-commit branch below. This
+        # hole wedged 2-2 height splits permanently: the two ahead nodes
+        # couldn't advance (no quorum at the new height), so the +2
+        # branch never engaged, and the laggards never saw the commit.
         if rs.height == prs.height + 1 and rs.last_commit is not None:
+            if rs.last_validators is not None:
+                ps.ensure_catchup_commit_round(
+                    prs.height, rs.last_commit.round_,
+                    rs.last_validators.size(),
+                )
+                prs = ps.get_round_state()
             vote = ps.pick_vote_to_send(rs.last_commit)
             if vote is not None:
-                return self._send_vote(peer, vote)
+                return self._send_vote(peer, ps, vote)
         # peer is far behind: catch up with the stored seen-commit
         if rs.height >= prs.height + 2 and prs.height > 0:
             store = getattr(self.con_s, "block_store", None)
@@ -690,11 +718,13 @@ class ConsensusReactor(Reactor, BaseService):
                     )
                     vote = self._pick_commit_vote_to_send(ps, prs, commit)
                     if vote is not None:
-                        return self._send_vote(peer, vote)
+                        return self._send_vote(peer, ps, vote)
         return False
 
     def _pick_commit_vote_to_send(self, ps: PeerState, prs: PeerRoundState, commit):
-        """Catch-up votes come from a Commit, not a VoteSet."""
+        """Catch-up votes come from a Commit, not a VoteSet. Like
+        pick_vote_to_send, this does NOT mark — _send_vote marks only
+        after the send actually succeeds."""
         with ps._mtx:
             ba = ps._get_vote_bit_array(prs.height, commit.round_(), VOTE_TYPE_PRECOMMIT)
             if ba is None:
@@ -709,7 +739,6 @@ class ConsensusReactor(Reactor, BaseService):
             index, ok = needed.pick_random()
             if not ok:
                 return None
-            ba.set_index(index, True)
             return commit.precommits[index]
 
     # -- query_maj23 (reactor.go:647-739) ----------------------------------

@@ -183,6 +183,17 @@ class Node(BaseService):
         # verifies per-tx on CPU, mempool/mempool.go:166-205) ------------
         sig_batcher = None
         local_app = getattr(client_creator, "app", None)
+        # round 13: apps with an authenticated state tree route their
+        # commit-time dirty-node hashing through the gateway hash plane
+        # (streamed devd when a daemon serves, CPU behind the breaker)
+        app_tree = getattr(local_app, "tree", None)
+        if app_tree is not None and hasattr(app_tree, "hasher"):
+            app_tree.hasher = self.hasher
+        # kept for telemetry (statetree_* gauges, scrape-only). The app
+        # is what's held, not the tree instance: a full-snapshot restore
+        # REBINDS app.tree to a fresh VersionedTree, and gauges pinned
+        # to the old instance would freeze forever
+        self.app_state_tree_app = local_app if app_tree is not None else None
         tx_parser = getattr(local_app, "tx_sig_parser", None)
         if tx_parser is not None:
             from tendermint_tpu.mempool.mempool import SigBatcher
@@ -222,6 +233,7 @@ class Node(BaseService):
                     interval=sc.snapshot_interval,
                     keep_recent=sc.snapshot_keep_recent,
                     chunk_size=sc.chunk_size,
+                    full_every=sc.snapshot_full_every,
                 )
             else:
                 logger.warning(

@@ -17,7 +17,10 @@ Canonical plane prefixes (full catalog: docs/observability.md):
     mempool_*          pool depth + sig-gate accounting
     p2p_*              switch peer counts
     fastsync_*         BlockchainReactor progress + stage seconds
-    statesync_*        reactor serving/restore + producer cadence
+    statesync_*        reactor serving/restore + producer cadence (incl.
+                       the round-13 delta counters)
+    statetree_*        authenticated app-state tree commit/hash shape
+                       (scrape-only; present when the app carries one)
     gateway_verify_*   Verifier counters (+ stream/breaker/faults on devd)
     gateway_hash_*     Hasher counters (+ stream/breaker/faults on devd)
     gateway_breaker_*  the shared circuit breaker, every route (scrape-only)
@@ -138,6 +141,18 @@ def build_registry(node) -> telemetry.Registry:
         return out
 
     reg.register_producer("statesync", statesync)
+
+    # authenticated state tree (round 13): commit/hashing shape of the
+    # app's commitment tree. Scrape-only — the legacy flat RPC key set
+    # stays frozen; apps without a tree simply have no producer here.
+    # Read app.tree per collect: a snapshot restore rebinds the tree
+    # instance, and a producer pinned to the old one would freeze
+    if node.app_state_tree_app is not None:
+        reg.register_producer(
+            "statetree",
+            lambda: node.app_state_tree_app.tree.stats(),
+            legacy=False,
+        )
 
     # device plane: tpu_sigs moving is how an operator confirms the
     # device path is live; stream_*/breaker_*/faults_* fold in on the

@@ -304,7 +304,8 @@ def tx(ctx, hash, prove: bool = False) -> dict:
 
 def abci_query(ctx, data=b"", path: str = "", height: int = 0, prove: bool = False) -> dict:
     res = ctx.proxy_app_query.query_sync(
-        data=_unhex(data) if data else b"", path=path, height=int(height), prove=prove
+        data=_unhex(data) if data else b"", path=path, height=int(height),
+        prove=bool(prove),
     )
     return {
         "response": {
@@ -312,6 +313,11 @@ def abci_query(ctx, data=b"", path: str = "", height: int = 0, prove: bool = Fal
             "index": getattr(res, "index", 0),
             "key": _hex(getattr(res, "key", b"") or b""),
             "value": _hex(res.value or b""),
+            # round 13: the app's state-tree proof (hex of the JSON
+            # TreeProof — merkle/statetree_proof.py) and the height it
+            # proves at; rpc/light.verified_query checks it against the
+            # light-verified header (height+1)'s app_hash
+            "proof": _hex(getattr(res, "proof", b"") or b""),
             "log": res.log,
             "height": getattr(res, "height", 0),
         }

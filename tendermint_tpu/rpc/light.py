@@ -221,6 +221,76 @@ class LightClient:
 
     # -- data verification --------------------------------------------------
 
+    def verified_query(self, key: bytes, path: str = "", height: int = 0) -> dict:
+        """A light-client VERIFIED state read (round 13): `abci_query`
+        with prove=True, the returned state-tree proof checked against
+        the app hash carried by the light-verified header at
+        (proof height + 1) — header H+1 commits to the app state block H
+        produced. `height` pins the proven version (0 = the app's
+        latest; note a proof at the chain HEAD verifies only once the
+        next block commits — pass head-1 for an immediately verifiable
+        read). Returns {"key", "value", "height", "absent", "proof"};
+        `value` is None (and `absent` True) for a verified absence.
+        Raises LightClientError on any failure: a missing proof, a
+        proofs-unsupported app, a proof that does not verify, or a
+        response value contradicting the proven one."""
+        import json as _json
+
+        from tendermint_tpu.merkle.statetree_proof import TreeProof
+
+        res = self.client.abci_query(
+            data=key.hex(), path=path, height=int(height), prove=True
+        )
+        resp = res.get("response") if isinstance(res, dict) else None
+        if not isinstance(resp, dict):
+            raise LightClientError("malformed abci_query response")
+        code = resp.get("code", 0)
+        if code != 0:
+            raise LightClientError(
+                f"query refused (code {code}): {resp.get('log', '')}"
+            )
+        proof_hex = resp.get("proof") or ""
+        if not isinstance(proof_hex, str) or not proof_hex:
+            raise LightClientError("node returned no state proof")
+        h = resp.get("height")
+        if not isinstance(h, int) or isinstance(h, bool) or h < 1:
+            raise LightClientError("bad proof height in query response")
+        try:
+            proof = TreeProof.from_json(_json.loads(bytes.fromhex(proof_hex)))
+        except ValueError as exc:
+            raise LightClientError(f"malformed state proof: {exc}")
+        if proof.key != key:
+            raise LightClientError("proof is for a different key")
+        # the root that commits height-h app state is header (h+1)'s
+        # app_hash; walk trust there if we aren't yet
+        if self.height < h + 1:
+            self.advance(h + 1)
+        if self.height != h + 1 or self._trusted_header is None:
+            raise LightClientError(
+                f"no verified header at {h + 1} (trust is at {self.height}); "
+                "re-query for a fresher proof"
+            )
+        app_hash = self._trusted_header.app_hash
+        if not proof.verify(app_hash):
+            raise LightClientError(
+                f"state proof failed verification against header {h + 1}"
+            )
+        # the response's bare value must BE the proven one — otherwise a
+        # node could prove one value while returning another
+        resp_value = bytes.fromhex(resp.get("value") or "")
+        if proof.is_membership:
+            if resp_value != proof.value:
+                raise LightClientError("response value does not match proven value")
+        elif resp_value:
+            raise LightClientError("response carries a value the proof says is absent")
+        return {
+            "key": key,
+            "value": proof.value,
+            "height": h,
+            "absent": not proof.is_membership,
+            "proof": proof,
+        }
+
     def verify_tx(self, tx_hash: bytes, header: Header) -> dict:
         """Fetch a tx with proof and check inclusion against a VERIFIED
         header's data_hash (types/tx.py TxProof)."""

@@ -61,12 +61,32 @@ class DevChain:
         self.hasher = hasher
         self.verifier = verifier
         self._last_seen_commit: Commit | None = None
+        # state-tree-backed apps (round 13) batch commit hashing through
+        # the same gateway the part plane uses, when one is wired
+        app_tree = getattr(app, "tree", None)
+        if hasher is not None and app_tree is not None:
+            app_tree.hasher = hasher
 
         from tendermint_tpu.abci.client import LocalClient
         from tendermint_tpu.proxy.app_conn import AppConnConsensus
         import threading
 
         self._proxy = AppConnConsensus(LocalClient(app, threading.RLock()))
+
+        # mirror the real node's genesis handshake: a fresh chain seeds
+        # the app's InitChain with the genesis validator set (the
+        # persistent kvstore's registry starts in sync with consensus —
+        # the delta-snapshot aux cross-check depends on that)
+        if (
+            self.state.last_block_height == 0
+            and app.info().last_block_height == 0
+        ):
+            from tendermint_tpu.abci.types import ABCIValidator
+
+            app.init_chain([
+                ABCIValidator(v.pub_key.to_json(), v.power)
+                for v in self.genesis_doc.validators
+            ])
 
     # -- block production --------------------------------------------------
 
@@ -165,6 +185,23 @@ class DevChainRPC:
 
     def status(self):
         return {"latest_block_height": self.chain.block_store.height()}
+
+    def abci_query(self, data="", path="", height=0, prove=False):
+        """The rpc/core abci_query shape, served straight off the app —
+        what LightClient.verified_query drives in tests/benches."""
+        res = self.chain.app.query(
+            bytes.fromhex(data) if data else b"", path, int(height), bool(prove)
+        )
+        return {
+            "response": {
+                "code": res.code,
+                "key": res.key.hex().upper(),
+                "value": (res.value or b"").hex().upper(),
+                "proof": (res.proof or b"").hex().upper(),
+                "height": res.height,
+                "log": res.log,
+            }
+        }
 
 
 def build_kvstore_chain(n_blocks: int, txs_per_block: int = 2, **kw):

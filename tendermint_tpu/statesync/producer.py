@@ -8,29 +8,49 @@ observe exactly height H. The in-process apps serialize in microseconds
 to low milliseconds at test scales; a deployment whose app state is
 huge raises snapshot_interval, it does not move the hook.
 
-Payload (format 1, canonical JSON, sort_keys — byte-identical across
-replicas at the same height):
+Round 13 (format 2, docs/state-tree.md):
+
+- The node-local SEEN commit moved OUT of the digested payload into the
+  manifest sidecar, so replica payloads — and manifest ROOTS — are
+  byte-identical even when replicas saw different precommit subsets
+  (deterministic snapshot roots, the ROADMAP item PR 12's real-TCP nets
+  opened).
+- Apps backed by the authenticated state tree emit DELTA snapshots
+  between full ones (`full_every` controls the cadence): chunk 0 is the
+  host section (state/validators_info/block H), chunks 1.. carry the
+  changed entries SINCE the previous snapshot, each entry shipping with
+  its membership (upsert) or absence (delete) proof against the NEW
+  app hash — a restoring node verifies every chunk against consensus
+  before anything applies, and resumes a crashed chain trustlessly.
+  Any precondition miss (no tree, pruned base version, base snapshot
+  gone, chain length at full_every) falls back to a full snapshot.
+
+Full payload (format 2, canonical JSON, sort_keys — byte-identical
+across replicas at the same height):
 
     {
-      "format": 1, "chain_id": ..., "height": H,
+      "format": 2, "kind": "full", "chain_id": ..., "height": H,
       "app_state": hex(app.snapshot()),
       "state": State.to_json() AFTER applying H,
       "validators_info": {height: saveValidatorsInfo record, ...},
-      "block": {"meta": ..., "seen_commit": ..., "parts": [...]}
+      "block": {"meta": ..., "parts": [...]}      # NO seen commit here
     }
 
-The block section carries height H itself (meta + parts + seen commit)
-so a restored node can serve /block and /commit at its base height and
-seed a BlockStore whose head is real, not a phantom watermark.
+The block section carries height H itself (meta + parts; the seen
+commit rides the manifest) so a restored node can serve /block and
+/commit at its base height and seed a BlockStore whose head is real,
+not a phantom watermark.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import time
 
 from tendermint_tpu.libs.envknob import env_number
 from tendermint_tpu.statesync.snapshot import (
+    KIND_DELTA,
     MAX_CHUNK_BYTES,
     Manifest,
     SnapshotStore,
@@ -40,6 +60,7 @@ from tendermint_tpu.statesync.snapshot import (
 logger = logging.getLogger("statesync.producer")
 
 DEFAULT_CHUNK_SIZE = 64 * 1024
+DEFAULT_FULL_EVERY = 4
 
 
 def validators_info_records(state) -> dict:
@@ -68,10 +89,14 @@ def validators_info_records(state) -> dict:
     return records
 
 
-def build_payload(state, app_state: bytes, block_store) -> dict:
-    """The JSON payload object for a snapshot at state.last_block_height.
-    Raises SnapshotError-ish ValueError when the block store cannot serve
-    the height (e.g. it was just pruned past it)."""
+def host_sections(state, block_store) -> tuple[dict, dict]:
+    """(sections, seen_commit_json) for a snapshot at
+    state.last_block_height: the embedded state, validator-history
+    records, and block H (meta + parts). The seen commit is returned
+    SEPARATELY — format 2 carries it in the manifest, outside the
+    digested bytes, so replica roots don't diverge on per-node precommit
+    subsets. Raises ValueError when the block store cannot serve the
+    height (e.g. it was just pruned past it)."""
     h = state.last_block_height
     meta = block_store.load_block_meta(h)
     seen = block_store.load_seen_commit(h)
@@ -83,24 +108,30 @@ def build_payload(state, app_state: bytes, block_store) -> dict:
         if part is None:
             raise ValueError(f"missing part {i} of block {h}")
         parts.append(part.to_json())
-    return {
-        "format": 1,
-        "chain_id": state.chain_id,
-        "height": h,
-        "app_state": app_state.hex(),
+    sections = {
         "state": state.to_json(),
         "validators_info": validators_info_records(state),
-        "block": {
-            "meta": meta.to_json(),
-            "seen_commit": seen.to_json(),
-            "parts": parts,
-        },
+        "block": {"meta": meta.to_json(), "parts": parts},
     }
+    return sections, seen.to_json()
+
+
+def build_payload(state, app_state: bytes, block_store) -> tuple[dict, dict]:
+    """(full-snapshot payload object, seen_commit_json) for a snapshot
+    at state.last_block_height."""
+    sections, seen_json = host_sections(state, block_store)
+    obj = {
+        "format": 2,
+        "kind": "full",
+        "chain_id": state.chain_id,
+        "height": state.last_block_height,
+        "app_state": app_state.hex(),
+        **sections,
+    }
+    return obj, seen_json
 
 
 def encode_payload(obj: dict) -> bytes:
-    import json
-
     return json.dumps(obj, sort_keys=True).encode()
 
 
@@ -114,12 +145,45 @@ class SnapshotProducer:
         interval: int = 0,
         keep_recent: int = 2,
         chunk_size: int | None = None,
+        full_every: int | None = None,
     ):
         self.store = store
         self.app = app
         self.block_store = block_store
         self.hasher = hasher
         self.interval = interval
+        if full_every is None:
+            full_every = int(
+                env_number(
+                    "TENDERMINT_STATESYNC_FULL_EVERY", DEFAULT_FULL_EVERY,
+                    cast=int,
+                )
+            )
+        self.full_every = max(int(full_every), 1)
+        from tendermint_tpu.statesync.snapshot import MAX_DELTA_CHAIN
+
+        if self.full_every > MAX_DELTA_CHAIN:
+            # every restorer hard-rejects chains past MAX_DELTA_CHAIN;
+            # producing longer ones would make the freshest snapshots
+            # unrestorable by construction
+            logger.warning(
+                "snapshot_full_every %d > restorable chain bound %d; clamping",
+                self.full_every, MAX_DELTA_CHAIN,
+            )
+            self.full_every = MAX_DELTA_CHAIN
+        # delta snapshots need the app's authenticated state tree (diff
+        # + proofs); apps without one always produce full snapshots
+        tree = getattr(app, "tree", None)
+        self.tree = tree if hasattr(tree, "diff") else None
+        if self.tree is not None and self.full_every > 1 and interval > 0:
+            # the delta base is `interval` heights back: the tree must
+            # retain at least that many versions or every delta falls
+            # back to full on a pruned base
+            self.tree.keep_recent = max(self.tree.keep_recent, interval + 2)
+        if self.full_every > 1:
+            # a delta chain is only servable while its full base (and
+            # every intermediate delta) survives retention
+            keep_recent = max(keep_recent, self.full_every + 1)
         self.keep_recent = keep_recent
         if chunk_size is None:
             chunk_size = int(
@@ -145,8 +209,11 @@ class SnapshotProducer:
         # gauges (statesync_* in the metrics RPC)
         self.snapshots_taken = 0
         self.snapshot_failures = 0
+        self.deltas_taken = 0
+        self.delta_fallbacks = 0
         self.last_snapshot_height = 0
         self.last_snapshot_seconds = 0.0
+        self.last_snapshot_bytes = 0
 
     def _chunk_digests(self, chunks: list[bytes]) -> list[bytes]:
         """Per-chunk RIPEMD-160 through the hashing gateway when one is
@@ -172,35 +239,184 @@ class SnapshotProducer:
             logger.exception("snapshot at height %d failed", h)
             return None
 
+    # -- delta production ----------------------------------------------------
+
+    def _delta_base(self, h: int) -> Manifest | None:
+        """The previous snapshot's manifest, iff a delta on top of it is
+        allowed and possible: the app has a tree retaining both
+        versions whose committed roots line up, and the chain of
+        consecutive deltas stays under full_every."""
+        if self.tree is None or self.full_every <= 1:
+            return None
+        heights = [x for x in self.store.heights() if x < h]
+        if not heights:
+            return None
+        base = self.store.load_manifest(heights[-1])
+        if base is None:
+            return None
+        # consecutive deltas ending at the base; a chain of
+        # full_every - 1 deltas means this one must be full
+        chain = 0
+        walk = base
+        while walk is not None and walk.kind == KIND_DELTA and chain < self.full_every:
+            chain += 1
+            walk = self.store.load_manifest(walk.base_height)
+        if walk is None or chain >= self.full_every - 1:
+            return None
+        if not (self.tree.has_version(base.height) and self.tree.has_version(h)):
+            return None
+        try:
+            if self.tree.root_hash(base.height) != base.app_hash:
+                # the stored base predates this app instance (restart
+                # rebuilt the tree with only the current version)
+                return None
+        except Exception:  # noqa: BLE001 — any doubt means full
+            return None
+        return base
+
+    def _build_delta_chunks(
+        self, state, base: Manifest
+    ) -> tuple[list[bytes], dict] | None:
+        """(delta chunk list, seen_commit_json) — host section first,
+        then proof-carrying entry groups — or None when the diff is
+        unavailable (pruned journal -> fall back to full)."""
+        from tendermint_tpu.statetree.tree import TreeError
+
+        h = state.last_block_height
+        try:
+            upserts, deletes = self.tree.diff(base.height, h)
+        except TreeError as exc:
+            logger.info("delta diff %d..%d unavailable (%s)", base.height, h, exc)
+            return None
+        sections, seen_json = host_sections(state, self.block_store)
+        aux = None
+        snapshot_aux = getattr(self.app, "snapshot_aux", None)
+        if snapshot_aux is not None:
+            aux = snapshot_aux()
+        host = {
+            "format": 2,
+            "kind": "delta",
+            "section": "host",
+            "chain_id": state.chain_id,
+            "height": h,
+            "base_height": base.height,
+            "app_aux": aux,
+            **sections,
+        }
+        chunks = [encode_payload(host)]
+        # entry groups: each entry ships with its proof against the NEW
+        # root. Proof STEPS dedupe into a per-chunk table (the upper
+        # tree levels are shared by every path in the chunk — inlining
+        # them per entry made small deltas LARGER than full snapshots);
+        # an entry's proof is its bottom-up list of step indices.
+        group: dict = {"section": "delta", "steps": [], "sets": [], "dels": []}
+        step_index: dict[str, int] = {}
+        group_bytes = 64
+
+        def flush():
+            nonlocal group, step_index, group_bytes
+            if group["sets"] or group["dels"]:
+                chunks.append(encode_payload(group))
+            group = {"section": "delta", "steps": [], "sets": [], "dels": []}
+            step_index = {}
+            group_bytes = 64
+
+        def proof_refs(key) -> list[int]:
+            nonlocal group_bytes
+            refs = []
+            for step in self.tree.prove(key, h).steps:
+                sj = step.to_json()
+                sk = "|".join(sj)
+                idx = step_index.get(sk)
+                if idx is None:
+                    idx = len(group["steps"])
+                    group["steps"].append(sj)
+                    step_index[sk] = idx
+                    group_bytes += len(sk) + 16
+                refs.append(idx)
+            return refs
+
+        for key in sorted(upserts):
+            entry = [key.hex().upper(), upserts[key].hex().upper(), proof_refs(key)]
+            group["sets"].append(entry)
+            group_bytes += len(entry[0]) + len(entry[1]) + 6 * len(entry[2])
+            if group_bytes >= self.chunk_size:
+                flush()
+        for key in deletes:
+            entry = [key.hex().upper(), proof_refs(key)]
+            group["dels"].append(entry)
+            group_bytes += len(entry[0]) + 6 * len(entry[1])
+            if group_bytes >= self.chunk_size:
+                flush()
+        flush()
+        if any(len(c) > MAX_CHUNK_BYTES for c in chunks):
+            # a single oversized entry (or host section) cannot ride the
+            # wire; a full snapshot chunks by size and always can
+            logger.warning("delta chunk exceeds wire ceiling; going full")
+            return None
+        return chunks, seen_json
+
+    # -- the whole path ------------------------------------------------------
+
     def snapshot(self, state) -> int:
-        """Export a snapshot at state.last_block_height. Returns the
+        """Export a snapshot at state.last_block_height (delta against
+        the previous one when possible, full otherwise). Returns the
         height. Raises on apps without snapshot support or a block store
         that cannot serve the height."""
         t0 = time.perf_counter()
         h = state.last_block_height
-        app_state = self.app.snapshot()
-        if app_state is None:
-            raise ValueError(f"{type(self.app).__name__} does not support snapshots")
-        payload = encode_payload(build_payload(state, app_state, self.block_store))
-        chunks = chunk_payload(payload, self.chunk_size)
-        manifest = Manifest(
-            height=h,
-            chain_id=state.chain_id,
-            chunk_size=self.chunk_size,
-            total_bytes=len(payload),
-            chunk_digests=self._chunk_digests(chunks),
-            header_hash=state.last_block_id.hash,
-            app_hash=state.app_hash,
-        )
+        base = self._delta_base(h)
+        built = None
+        if base is not None:
+            built = self._build_delta_chunks(state, base)
+            if built is None:
+                self.delta_fallbacks += 1
+        if built is not None:
+            chunks, seen_json = built
+            manifest = Manifest(
+                height=h,
+                chain_id=state.chain_id,
+                chunk_size=self.chunk_size,
+                total_bytes=sum(len(c) for c in chunks),
+                chunk_digests=self._chunk_digests(chunks),
+                header_hash=state.last_block_id.hash,
+                app_hash=state.app_hash,
+                kind=KIND_DELTA,
+                base_height=base.height,
+                seen_commit=seen_json,
+            )
+            self.deltas_taken += 1
+            kind = "delta"
+        else:
+            app_state = self.app.snapshot()
+            if app_state is None:
+                raise ValueError(
+                    f"{type(self.app).__name__} does not support snapshots"
+                )
+            obj, seen_json = build_payload(state, app_state, self.block_store)
+            payload = encode_payload(obj)
+            chunks = chunk_payload(payload, self.chunk_size)
+            manifest = Manifest(
+                height=h,
+                chain_id=state.chain_id,
+                chunk_size=self.chunk_size,
+                total_bytes=len(payload),
+                chunk_digests=self._chunk_digests(chunks),
+                header_hash=state.last_block_id.hash,
+                app_hash=state.app_hash,
+                seen_commit=seen_json,
+            )
+            kind = "full"
         self.store.save(manifest, chunks)
         self.store.prune(self.keep_recent)
         self.snapshots_taken += 1
         self.last_snapshot_height = h
+        self.last_snapshot_bytes = manifest.total_bytes
         self.last_snapshot_seconds = round(time.perf_counter() - t0, 4)
         logger.info(
-            "snapshot at height %d: %d chunk(s), %d bytes, root %s (%.1f ms)",
-            h, manifest.chunks, len(payload), manifest.root.hex()[:12],
-            self.last_snapshot_seconds * 1000,
+            "%s snapshot at height %d: %d chunk(s), %d bytes, root %s (%.1f ms)",
+            kind, h, manifest.chunks, manifest.total_bytes,
+            manifest.root.hex()[:12], self.last_snapshot_seconds * 1000,
         )
         return h
 
@@ -214,6 +430,9 @@ class SnapshotProducer:
             "interval": self.interval,
             "snapshots_taken": self.snapshots_taken,
             "snapshot_failures": self.snapshot_failures,
+            "deltas_taken": self.deltas_taken,
+            "delta_fallbacks": self.delta_fallbacks,
             "last_snapshot_height": self.last_snapshot_height,
             "last_snapshot_seconds": self.last_snapshot_seconds,
+            "last_snapshot_bytes": self.last_snapshot_bytes,
         }

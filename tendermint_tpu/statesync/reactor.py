@@ -48,7 +48,9 @@ from tendermint_tpu.statesync.restore import (
     verify_chunk_batch,
 )
 from tendermint_tpu.statesync.snapshot import (
+    KIND_DELTA,
     MAX_CHUNK_BYTES,
+    MAX_DELTA_CHAIN,
     Manifest,
     SnapshotError,
     frame_chunk,
@@ -323,6 +325,13 @@ class StateSyncReactor(Reactor, BaseService):
                 peers.append(peer)
         return peers
 
+    def _serving_peers(self, height: int, also_ask: int | None = None) -> list:
+        peers = self._peers_for(height)
+        if also_ask is not None and also_ask != height:
+            have = {p.id() for p in peers}
+            peers += [p for p in self._peers_for(also_ask) if p.id() not in have]
+        return peers
+
     def _ban_peer(self, peer_id: str, reason: str) -> None:
         self.peers_banned += 1
         with self._cv:
@@ -434,13 +443,16 @@ class StateSyncReactor(Reactor, BaseService):
                 return None
         return None
 
-    def _fetch_manifest(self, height: int) -> Manifest:
+    def _fetch_manifest(self, height: int, also_ask: int | None = None) -> Manifest:
         """Fetch AND light-verify a manifest for `height`, one offering
         peer at a time. A manifest that contradicts the verified chain
         (ManifestBindingError) proves its SERVER lied: that peer is
         banned and the next offerer tried — the height is only given up
-        on when the light walk itself fails or no peer serves."""
-        for peer in self._peers_for(height):
+        on when the light walk itself fails or no peer serves.
+        `also_ask` adds the offerers of ANOTHER height (a delta's base
+        may not be separately offered, but whoever serves the delta
+        holds its whole chain)."""
+        for peer in self._serving_peers(height, also_ask):
             with self._cv:
                 self._manifest_inbox.pop(height, None)
                 self._manifest_expect = (height, peer.id())
@@ -513,13 +525,60 @@ class StateSyncReactor(Reactor, BaseService):
     def _restore_height(self, height: int):
         # _fetch_manifest binds the manifest to the light-verified header
         # chain BEFORE anything downloads: a forged manifest costs us two
-        # RPC round-trips (and its server a ban), not a chunk download
+        # RPC round-trips (and its server a ban), not a chunk download.
+        # Delta manifests (round 13) pull in their base chain — fetched
+        # TARGET-FIRST (the walk to height+1 caches every lower header,
+        # so the bases bind off the cache), restored base-first.
         manifest = self._fetch_manifest(height)
+        chain = [manifest]
+        while chain[0].kind == KIND_DELTA:
+            if len(chain) > MAX_DELTA_CHAIN:
+                raise SnapshotRejected(
+                    f"snapshot {height}: delta chain exceeds {MAX_DELTA_CHAIN}"
+                )
+            base = self._fetch_manifest(chain[0].base_height, also_ask=height)
+            chain.insert(0, base)
         logger.debug(
-            "manifest %d bound to verified headers (%d chunk(s)); downloading",
-            height, manifest.chunks,
+            "snapshot %d bound (%d-link chain, %d chunk(s) at the head); "
+            "downloading", height, len(chain), manifest.chunks,
         )
 
+        # links the app already holds (a crashed earlier run persisted
+        # the app per link) skip straight past download; any divergence
+        # a skip could hide dies at the next delta's base/root checks.
+        # Skips only apply when the app sits EXACTLY on a chain height —
+        # an app at an unaligned height must hit the base restore's
+        # "needs a fresh app" gate, not silently skip the base and die
+        # with a misleading stale-delta error
+        app_h = self.restorer.app.info().last_block_height
+        resumable = app_h in {m.height for m in chain}
+        state = None
+        for k, m in enumerate(chain):
+            last = k == len(chain) - 1
+            if not last and resumable and app_h >= m.height:
+                logger.info(
+                    "resuming: skipping chain link %d (app at %d)",
+                    m.height, app_h,
+                )
+                continue
+            ordered = self._download_chunks(m, also_ask=height)
+            try:
+                state = self.restorer.restore_step(m, ordered, seed=last)
+            except SnapshotRejected:
+                raise
+            except RestoreError as exc:
+                # everything restore_step() touches is local and fully
+                # downloaded: a failure here is CONTENT, not weather —
+                # blacklist the TARGET height
+                raise SnapshotRejected(str(exc))
+        for m in chain:
+            shutil.rmtree(self._scratch_dir(m.height), ignore_errors=True)
+        return state
+
+    def _download_chunks(self, manifest: Manifest, also_ask: int | None = None):
+        """Windowed, digest-verified, scratch-resumable download of one
+        manifest's chunks. Returns them in order; raises RestoreError
+        when peers can't serve within the retry budget."""
         chunks = self._load_scratch(manifest)
         missing = [i for i in range(manifest.chunks) if i not in chunks]
         attempts: dict[int, int] = {}
@@ -527,7 +586,7 @@ class StateSyncReactor(Reactor, BaseService):
             window, missing = (
                 missing[: self.chunk_window], missing[self.chunk_window:],
             )
-            got = self._fetch_window(manifest, window, attempts)
+            got = self._fetch_window(manifest, window, attempts, also_ask=also_ask)
             retry = [i for i in window if i not in got]
             chunks.update(got)
             missing.extend(retry)
@@ -539,27 +598,18 @@ class StateSyncReactor(Reactor, BaseService):
                     )
         if missing:
             raise RestoreError("reactor stopped mid-download")
-        ordered = [chunks[i] for i in range(manifest.chunks)]
-        try:
-            state = self.restorer.restore(manifest, ordered)
-        except SnapshotRejected:
-            raise
-        except RestoreError as exc:
-            # everything restore() touches is local and fully downloaded:
-            # a failure here is CONTENT, not weather — blacklist it
-            raise SnapshotRejected(str(exc))
-        shutil.rmtree(self._scratch_dir(height), ignore_errors=True)
-        return state
+        return [chunks[i] for i in range(manifest.chunks)]
 
     def _fetch_window(
-        self, manifest: Manifest, window: list[int], attempts: dict[int, int]
+        self, manifest: Manifest, window: list[int], attempts: dict[int, int],
+        also_ask: int | None = None,
     ) -> dict[int, bytes]:
         """Request `window` chunks spread over the offering peers, wait,
         then digest-verify the arrivals in ONE gateway batch. Returns the
         verified chunks; a mismatching chunk bans its serving peer and is
         left for the caller to retry."""
         height = manifest.height
-        peers = self._peers_for(height)
+        peers = self._serving_peers(height, also_ask)
         if not peers:
             raise RestoreError(f"no peers left offering snapshot {height}")
         with self._cv:

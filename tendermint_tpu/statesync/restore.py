@@ -31,7 +31,12 @@ import json
 import logging
 import time
 
-from tendermint_tpu.statesync.snapshot import Manifest, chunk_digest
+from tendermint_tpu.statesync.snapshot import (
+    KIND_DELTA,
+    KIND_FULL,
+    Manifest,
+    chunk_digest,
+)
 
 logger = logging.getLogger("statesync.restore")
 
@@ -113,6 +118,9 @@ class Restorer:
         self.chunk_digest_failures = 0
         self.restore_seconds = 0.0
         self.restored_height = 0
+        self.deltas_applied = 0
+        self.delta_entries_applied = 0
+        self.delta_proof_failures = 0
 
     # -- verification ------------------------------------------------------
 
@@ -208,13 +216,20 @@ class Restorer:
             raise RestoreError(f"snapshot payload is not valid JSON: {exc}")
         if not isinstance(obj, dict) or obj.get("format") != manifest.format:
             raise RestoreError("snapshot payload format mismatch")
+        if manifest.format >= 2 and obj.get("kind") != manifest.kind:
+            raise RestoreError("snapshot payload kind mismatch")
         if obj.get("height") != manifest.height or obj.get("chain_id") != manifest.chain_id:
             raise RestoreError("snapshot payload height/chain mismatch")
         return obj
 
-    def _verify_payload(self, manifest: Manifest, obj: dict, header_h, header_h1):
-        """Cross-check every payload claim against the verified headers.
-        Returns (state, meta, parts, seen_commit, app_state_bytes)."""
+    def _verify_host(self, manifest: Manifest, obj: dict, header_h, header_h1):
+        """Cross-check every host-section claim (embedded state, block H
+        meta/parts, seen commit, validator history) against the verified
+        headers. The seen commit comes from the PAYLOAD for format-1
+        manifests and from the MANIFEST sidecar for format 2 (round 13:
+        splitting it out of the digested bytes is what makes replica
+        snapshot roots deterministic — it is re-verified here either
+        way). Returns (state, meta, parts, seen_commit, validators_info)."""
         from tendermint_tpu.state.state import State
         from tendermint_tpu.types import PartSet
         from tendermint_tpu.types.block import Commit
@@ -228,9 +243,13 @@ class Restorer:
                 self.state_db, self.genesis_doc, obj["state"]
             )
             meta = BlockMeta.from_json(obj["block"]["meta"])
-            seen_commit = Commit.from_json(obj["block"]["seen_commit"])
+            if manifest.format >= 2:
+                if manifest.seen_commit is None:
+                    raise ValueError("format-2 manifest carries no seen commit")
+                seen_commit = Commit.from_json(manifest.seen_commit)
+            else:
+                seen_commit = Commit.from_json(obj["block"]["seen_commit"])
             parts_json = obj["block"]["parts"]
-            app_state = bytes.fromhex(obj["app_state"])
             validators_info = obj["validators_info"]
             if not isinstance(parts_json, list) or not isinstance(validators_info, dict):
                 raise ValueError("bad parts/validators_info")
@@ -336,24 +355,37 @@ class Restorer:
         except CommitError as exc:
             raise RestoreError(f"seen commit verification failed: {exc}")
         parts = [ps.get_part(i) for i in range(ps.total)]
-        return state, meta, parts, seen_commit, app_state, validators_info
+        return state, meta, parts, seen_commit, validators_info
+
+    def _seed(self, state, meta, parts, seen_commit, validators_info) -> None:
+        self.block_store.seed_snapshot(meta, parts, seen_commit)
+        state.seed_restored(validators_info)
 
     # -- the whole path ----------------------------------------------------
 
-    def restore(self, manifest: Manifest, chunks: list[bytes]):
+    def restore(self, manifest: Manifest, chunks: list[bytes], seed: bool = True):
         """Verify everything, apply the app state, seed state DB + block
         store. Returns the restored State. Raises RestoreError; on any
         failure nothing was applied — all host-side verification
         precedes the first mutation, and the app's restore contract
         (abci/types.py) requires it to validate the payload against the
-        verified (height, app_hash) before mutating in turn."""
+        verified (height, app_hash) before mutating in turn. `seed=False`
+        applies the app only (a delta chain seeds store/state from its
+        FINAL link — restore_chain)."""
+        if manifest.kind != KIND_FULL:
+            raise RestoreError("restore() takes a full snapshot; deltas go "
+                               "through restore_delta()")
         t0 = time.perf_counter()
         header_h, header_h1 = self.verify_manifest(manifest)
         self.verify_chunks(manifest, chunks)
         obj = self._parse_payload(manifest, b"".join(chunks))
-        state, meta, parts, seen_commit, app_state, validators_info = (
-            self._verify_payload(manifest, obj, header_h, header_h1)
+        state, meta, parts, seen_commit, validators_info = (
+            self._verify_host(manifest, obj, header_h, header_h1)
         )
+        try:
+            app_state = bytes.fromhex(obj["app_state"])
+        except (KeyError, TypeError, ValueError) as exc:
+            raise RestoreError(f"malformed snapshot payload: {exc}")
 
         # -- apply: app first, then block store, then state — the state
         # key is what a restarting node loads, so it lands only over a
@@ -397,8 +429,8 @@ class Restorer:
         if info.last_block_app_hash != state.app_hash:
             raise RestoreError("restored app hash does not match verified state")
 
-        self.block_store.seed_snapshot(meta, parts, seen_commit)
-        state.seed_restored(validators_info)
+        if seed:
+            self._seed(state, meta, parts, seen_commit, validators_info)
 
         self.restored_height = manifest.height
         self.restore_seconds = round(time.perf_counter() - t0, 4)
@@ -409,10 +441,256 @@ class Restorer:
         )
         return state
 
+    # -- delta restore (round 13, docs/state-tree.md) ----------------------
+
+    def _decode_delta_entries(self, manifest: Manifest, chunks: list[bytes]):
+        """Parse + PROVE every entry chunk against the manifest's light-
+        bound app hash. Each upsert carries a membership proof for its
+        (key, value); each delete an absence proof — so a chunk is
+        verified against CONSENSUS the moment it's complete, not after
+        the whole snapshot assembles (the trustless-resume property).
+        Completeness (no omitted/extra change) is enforced later by the
+        app recomputing the tree root. Returns (upserts, deletes)."""
+        from tendermint_tpu.merkle.statetree_proof import (
+            MAX_PROOF_STEPS,
+            ProofStep,
+            TreeProof,
+        )
+
+        upserts: dict[bytes, bytes] = {}
+        deletes: list[bytes] = []
+        seen_keys: set[bytes] = set()
+
+        for ci, raw in enumerate(chunks[1:], start=1):
+            try:
+                grp = json.loads(raw)
+            except ValueError as exc:
+                raise RestoreError(f"delta chunk {ci} is not valid JSON: {exc}")
+            if not isinstance(grp, dict) or grp.get("section") != "delta":
+                raise RestoreError(f"delta chunk {ci} malformed")
+            sets, dels = grp.get("sets"), grp.get("dels")
+            raw_steps = grp.get("steps")
+            if (
+                not isinstance(sets, list) or not isinstance(dels, list)
+                or not isinstance(raw_steps, list)
+                or len(raw_steps) > (1 << 16)
+            ):
+                raise RestoreError(f"delta chunk {ci} malformed")
+            try:
+                steps = [ProofStep.from_json(s) for s in raw_steps]
+            except ValueError as exc:
+                raise RestoreError(f"malformed delta proof step: {exc}")
+
+            def decode_proof(key, value, refs):
+                # a proof is a bottom-up list of indices into the
+                # chunk's shared step table (upper-tree steps dedupe
+                # across every entry in the chunk)
+                if (
+                    not isinstance(refs, list)
+                    or len(refs) > MAX_PROOF_STEPS
+                    or any(
+                        not isinstance(i, int) or isinstance(i, bool)
+                        or not 0 <= i < len(steps)
+                        for i in refs
+                    )
+                ):
+                    raise RestoreError("malformed delta proof refs")
+                return TreeProof(key, value, [steps[i] for i in refs])
+
+            for entry in sets:
+                if not isinstance(entry, list) or len(entry) != 3:
+                    raise RestoreError("malformed delta upsert entry")
+                try:
+                    key, value = bytes.fromhex(entry[0]), bytes.fromhex(entry[1])
+                except (TypeError, ValueError):
+                    raise RestoreError("malformed delta upsert entry")
+                proof = decode_proof(key, value, entry[2])
+                if not proof.verify(manifest.app_hash):
+                    self.delta_proof_failures += 1
+                    raise RestoreError(
+                        f"delta upsert proof failed against the verified "
+                        f"app hash (chunk {ci})"
+                    )
+                if key in seen_keys:
+                    raise RestoreError("duplicate key across delta chunks")
+                seen_keys.add(key)
+                upserts[key] = value
+            for entry in dels:
+                if not isinstance(entry, list) or len(entry) != 2:
+                    raise RestoreError("malformed delta delete entry")
+                try:
+                    key = bytes.fromhex(entry[0])
+                except (TypeError, ValueError):
+                    raise RestoreError("malformed delta delete entry")
+                proof = decode_proof(key, None, entry[1])
+                if not proof.verify(manifest.app_hash):
+                    self.delta_proof_failures += 1
+                    raise RestoreError(
+                        f"delta absence proof failed against the verified "
+                        f"app hash (chunk {ci})"
+                    )
+                if key in seen_keys:
+                    raise RestoreError("duplicate key across delta chunks")
+                seen_keys.add(key)
+                deletes.append(key)
+        return upserts, deletes
+
+    def _check_aux(self, aux, state) -> None:
+        """The delta host section's app-private sidecar (e.g. the
+        persistent kvstore's validator registry) is NOT covered by the
+        tree root — cross-check it against the header-verified validator
+        set before the app may apply it."""
+        if aux is None:
+            return
+        if not isinstance(aux, dict):
+            raise RestoreError("malformed delta app_aux")
+        validators = aux.get("validators")
+        if validators is None:
+            return
+        if not isinstance(validators, dict):
+            raise RestoreError("malformed delta app_aux validators")
+        try:
+            claimed = {
+                str(k).upper(): p for k, p in validators.items()
+            }
+        except (TypeError, ValueError):
+            raise RestoreError("malformed delta app_aux validators")
+        verified = {
+            v.pub_key.raw.hex().upper(): v.voting_power
+            for v in state.validators.validators
+        }
+        if claimed != verified:
+            raise RestoreError(
+                "delta app_aux validator registry does not match the "
+                "header-verified set"
+            )
+
+    def restore_delta(self, manifest: Manifest, chunks: list[bytes],
+                      seed: bool = True):
+        """Advance an already-restored app from manifest.base_height to
+        manifest.height by a verified delta. Every entry chunk proves
+        its content against the light-bound app hash BEFORE the app
+        applies anything, and the app's restore_delta contract re-derives
+        the tree root and refuses (rolled back, nothing persisted) on any
+        mismatch — an omitted or smuggled change cannot survive."""
+        if manifest.kind != KIND_DELTA:
+            raise RestoreError("restore_delta() takes a delta snapshot")
+        t0 = time.perf_counter()
+        header_h, header_h1 = self.verify_manifest(manifest)
+        self.verify_chunks(manifest, chunks)
+        if not chunks or sum(len(c) for c in chunks) != manifest.total_bytes:
+            raise RestoreError("delta chunk bytes do not match the manifest")
+        try:
+            host = json.loads(chunks[0])
+        except ValueError as exc:
+            raise RestoreError(f"delta host section is not valid JSON: {exc}")
+        if (
+            not isinstance(host, dict)
+            or host.get("format") != manifest.format
+            or host.get("kind") != "delta"
+            or host.get("section") != "host"
+        ):
+            raise RestoreError("delta host section malformed")
+        if (
+            host.get("height") != manifest.height
+            or host.get("chain_id") != manifest.chain_id
+            or host.get("base_height") != manifest.base_height
+        ):
+            raise RestoreError("delta host section contradicts the manifest")
+        state, meta, parts, seen_commit, validators_info = (
+            self._verify_host(manifest, host, header_h, header_h1)
+        )
+        upserts, deletes = self._decode_delta_entries(manifest, chunks)
+        aux = host.get("app_aux")
+        self._check_aux(aux, state)
+
+        info = self.app.info()
+        if (
+            info.last_block_height == manifest.height
+            and info.last_block_app_hash == state.app_hash
+        ):
+            # crash-window / chain-resume: this delta already applied
+            # and persisted; re-seeding the rest is idempotent
+            logger.info(
+                "app already at verified delta height %d; resuming",
+                manifest.height,
+            )
+        elif info.last_block_height != manifest.base_height:
+            raise RestoreError(
+                f"stale delta: app at height {info.last_block_height}, "
+                f"delta bases on {manifest.base_height}"
+            )
+        else:
+            apply = getattr(self.app, "restore_delta", None)
+            if apply is None:
+                raise RestoreError(
+                    f"{type(self.app).__name__} cannot apply delta snapshots"
+                )
+            try:
+                apply(upserts, deletes, manifest.height, state.app_hash, aux=aux)
+            except (KeyError, TypeError, ValueError) as exc:
+                raise RestoreError(f"app refused the delta: {exc}")
+        info = self.app.info()
+        if (
+            info.last_block_height != manifest.height
+            or info.last_block_app_hash != state.app_hash
+        ):
+            raise RestoreError("delta apply did not land on the verified state")
+
+        if seed:
+            self._seed(state, meta, parts, seen_commit, validators_info)
+
+        self.deltas_applied += 1
+        self.delta_entries_applied += len(upserts) + len(deletes)
+        self.restored_height = manifest.height
+        self.restore_seconds = round(time.perf_counter() - t0, 4)
+        logger.info(
+            "applied delta %d -> %d: %d upsert(s), %d delete(s) (%.0f ms)",
+            manifest.base_height, manifest.height, len(upserts), len(deletes),
+            self.restore_seconds * 1000,
+        )
+        return state
+
+    def restore_step(self, manifest: Manifest, chunks: list[bytes],
+                     seed: bool = True):
+        """One link of a snapshot chain: full or delta by manifest kind."""
+        if manifest.kind == KIND_DELTA:
+            return self.restore_delta(manifest, chunks, seed=seed)
+        return self.restore(manifest, chunks, seed=seed)
+
+    def restore_chain(self, items: list[tuple[Manifest, list[bytes]]]):
+        """Restore a full-then-deltas chain (ascending heights, each
+        delta basing on the previous link). Only the FINAL link seeds the
+        block store and state DB — intermediate links advance the app
+        only. Links the app already passed (a crashed earlier run — the
+        app persists per link) are skipped; any divergence a skip could
+        hide is caught by the next delta's base check and root equality."""
+        if not items:
+            raise RestoreError("empty snapshot chain")
+        for (prev, _), (cur, _) in zip(items, items[1:]):
+            if cur.kind != KIND_DELTA or cur.base_height != prev.height:
+                raise RestoreError("snapshot chain links do not connect")
+        app_h = self.app.info().last_block_height
+        resumable = app_h in {m.height for m, _ in items}
+        state = None
+        for i, (manifest, chunks) in enumerate(items):
+            last = i == len(items) - 1
+            if not last and resumable and app_h >= manifest.height:
+                logger.info(
+                    "skipping chain link %d (app already at %d)",
+                    manifest.height, app_h,
+                )
+                continue
+            state = self.restore_step(manifest, chunks, seed=last)
+        return state
+
     def stats(self) -> dict:
         return {
             "chunks_verified": self.chunks_verified,
             "chunk_digest_failures": self.chunk_digest_failures,
             "restored_height": self.restored_height,
             "restore_seconds": self.restore_seconds,
+            "deltas_applied": self.deltas_applied,
+            "delta_entries_applied": self.delta_entries_applied,
+            "delta_proof_failures": self.delta_proof_failures,
         }

@@ -35,7 +35,12 @@ from tendermint_tpu.merkle.simple import FlatTree
 
 logger = logging.getLogger("statesync.snapshot")
 
-FORMAT = 1
+FORMAT = 2
+KIND_FULL = "full"
+KIND_DELTA = "delta"
+# a delta chain longer than this is garbage (the producer's full_every
+# knob clamps far below); bounds the reactor's base-manifest recursion
+MAX_DELTA_CHAIN = 32
 CHUNK_MAGIC = b"TMSNAP1\n"
 _FRAME = struct.Struct(">II")  # crc32c(payload), len(payload)
 MANIFEST_FILE = "manifest.json"
@@ -69,7 +74,18 @@ def chunk_digests_root(digests: list[bytes]) -> bytes:
 class Manifest:
     """The snapshot's table of contents. `chunk_digests[i]` is the raw
     ripemd160 of chunk i's payload (the Part.Hash convention — NOT
-    length-prefixed), `root` their simple-Merkle root."""
+    length-prefixed), `root` their simple-Merkle root.
+
+    Format 2 (round 13): the node-local SEEN commit is carried HERE, as
+    a sidecar the digested payload never includes — replica payloads
+    (and so manifest roots) are byte-identical even when replicas saw
+    different precommit subsets (the ROADMAP determinism item; the
+    commit is re-verified at restore exactly as before, any +2/3 seen
+    commit passes). Format 2 also adds `kind`: "full" manifests chunk
+    one canonical payload by fixed size; "delta" manifests carry one
+    host chunk plus self-verifying changed-entry chunks against
+    `base_height`'s snapshot (docs/state-tree.md). Format 1 manifests
+    (pre-round-13 homes) still decode and restore."""
 
     def __init__(
         self,
@@ -81,6 +97,9 @@ class Manifest:
         header_hash: bytes,
         app_hash: bytes,
         format_: int = FORMAT,
+        kind: str = KIND_FULL,
+        base_height: int = 0,
+        seen_commit: dict | None = None,
     ):
         self.format = format_
         self.height = height
@@ -90,6 +109,9 @@ class Manifest:
         self.chunk_digests = chunk_digests
         self.header_hash = header_hash
         self.app_hash = app_hash
+        self.kind = kind
+        self.base_height = base_height
+        self.seen_commit = seen_commit  # JSON form (types.block.Commit)
         self.root = chunk_digests_root(chunk_digests)
 
     @property
@@ -97,7 +119,7 @@ class Manifest:
         return len(self.chunk_digests)
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "format": self.format,
             "height": self.height,
             "chain_id": self.chain_id,
@@ -109,11 +131,18 @@ class Manifest:
             "header_hash": self.header_hash.hex().upper(),
             "app_hash": self.app_hash.hex().upper(),
         }
+        if self.format >= 2:
+            out["kind"] = self.kind
+            if self.kind == KIND_DELTA:
+                out["base_height"] = self.base_height
+            if self.seen_commit is not None:
+                out["seen_commit"] = self.seen_commit
+        return out
 
     def lite(self) -> dict:
         """The discovery form gossiped in snapshots_response / served by
         the RPC route — enough to pick a snapshot, not to verify one."""
-        return {
+        out = {
             "format": self.format,
             "height": self.height,
             "chain_id": self.chain_id,
@@ -121,7 +150,11 @@ class Manifest:
             "total_bytes": self.total_bytes,
             "root": self.root.hex().upper(),
             "header_hash": self.header_hash.hex().upper(),
+            "kind": self.kind,
         }
+        if self.kind == KIND_DELTA:
+            out["base_height"] = self.base_height
+        return out
 
     @classmethod
     def from_json(cls, obj) -> "Manifest":
@@ -147,6 +180,22 @@ class Manifest:
             not isinstance(d, str) or len(d) != 40 for d in raw
         ):
             raise ValueError("bad manifest chunk_digests")
+        kind = obj.get("kind", KIND_FULL) if fmt >= 2 else KIND_FULL
+        if kind not in (KIND_FULL, KIND_DELTA):
+            raise ValueError(f"bad manifest kind {kind!r}")
+        base_height = 0
+        if kind == KIND_DELTA:
+            base_height = jv.int_field(obj, "base_height", 1, jv.MAX_HEIGHT)
+            if base_height >= height:
+                raise ValueError("delta base_height must be below height")
+        seen_commit = None
+        if fmt >= 2 and "seen_commit" in obj:
+            seen_commit = obj["seen_commit"]
+            # validate NOW (it arrives over p2p); keep the JSON form —
+            # restore re-parses and signature-verifies it
+            from tendermint_tpu.types.block import Commit
+
+            Commit.from_json(jv.dict_field(obj, "seen_commit"))
         m = cls(
             height=height,
             chain_id=chain_id,
@@ -156,10 +205,16 @@ class Manifest:
             header_hash=jv.hex_field(obj, "header_hash", max_bytes=20),
             app_hash=jv.hex_field(obj, "app_hash", max_bytes=64),
             format_=fmt,
+            kind=kind,
+            base_height=base_height,
+            seen_commit=seen_commit,
         )
-        # total_bytes must agree with the chunk count: exactly the last
-        # chunk may run short (chunk_payload's fixed-size split, min 1)
-        if not (
+        # full snapshots: total_bytes must agree with the chunk count —
+        # exactly the last chunk may run short (chunk_payload's
+        # fixed-size split, min 1). Delta chunks are semantic units
+        # (host section + entry groups), not fixed-size slices; each is
+        # still bounded by MAX_CHUNK_BYTES at every decode site.
+        if m.kind == KIND_FULL and not (
             (m.chunks - 1) * m.chunk_size
             < max(m.total_bytes, 1)
             <= m.chunks * m.chunk_size

@@ -1,0 +1,15 @@
+"""Authenticated app-state tree (round 13, docs/state-tree.md).
+
+`VersionedTree` is the canonical app-state commitment: a persistent
+(copy-on-write) merkleized treap over byte keys with O(log n) expected
+insert/update/delete, one immutable root per committed height, and
+membership/absence proofs whose pure verifier lives in
+merkle/statetree_proof.py (light clients import only that). Dirty-node
+recompute at commit batches through the ops/gateway.Hasher plane — the
+same streamed devd `hash_stream` route the part-set tree rides.
+"""
+
+from tendermint_tpu.merkle.statetree_proof import TreeProof
+from tendermint_tpu.statetree.tree import VersionedTree
+
+__all__ = ["TreeProof", "VersionedTree"]

@@ -208,6 +208,22 @@ def test_statesync_node_joins_mid_chaos(tmp_path):
                 joiner.block_store.load_block(hh).header.app_hash
                 == net.nodes[0].block_store.load_block(hh).header.app_hash
             ), hh
+        # round 13, deterministic snapshot roots: every snapshot height
+        # shared across replicas must carry the SAME manifest root —
+        # the seen commit (which legitimately differs per node, 3-of-4
+        # vs 4-of-4 precommits) now rides the manifest sidecar, outside
+        # the digested payload. Pre-r13 this diverged at height 5.
+        height_sets = [set(n.snapshot_store.heights()) for n in net.nodes[:4]]
+        common = set.intersection(*height_sets)
+        assert common, f"no shared snapshot heights: {height_sets}"
+        for sh in common:
+            roots = {
+                n.snapshot_store.load_manifest(sh).root for n in net.nodes[:4]
+            }
+            assert len(roots) == 1, (
+                f"snapshot roots diverged at height {sh}: "
+                f"{[r.hex()[:12] for r in roots]}"
+            )
     finally:
         net.stop()
 

@@ -310,11 +310,12 @@ def test_prometheus_exposition_endpoint(node):
             assert PROM_LINE.match(line), line
     assert len(families) >= 40, f"only {len(families)} families"
     assert set(families) <= helps, "family missing its HELP line"
-    # one family per plane the acceptance bar names
+    # one family per plane the acceptance bar names (statetree_*: the
+    # kvstore app carries the round-13 authenticated tree, scrape-only)
     for fam in ("consensus_height", "wal_format", "gateway_verify_tpu_sigs",
                 "gateway_hash_tpu_leaves", "gateway_breaker_state",
                 "mempool_size", "statesync_snapshots", "fastsync_active",
-                "p2p_peers_outbound"):
+                "p2p_peers_outbound", "statetree_size", "statetree_commits"):
         assert fam in families, fam
         assert families[fam] == "gauge"
     # the latency-distribution instruments render as real histograms

@@ -597,3 +597,109 @@ def test_fastsync_flag_clears_on_switchover():
     assert bc.fast_sync is True
     bc._pool_routine()  # caught up immediately -> switchover path
     assert bc.fast_sync is False
+
+
+def test_vote_gossip_marks_peer_only_on_successful_send():
+    """pick_vote_to_send must NOT mark the peer as having the vote —
+    the mark lands in _send_vote only AFTER peer.send succeeds
+    (reactor.go PickSendVote's order). Marking at pick time meant a
+    vote whose send failed on a full channel queue (exactly the
+    burst-load moment) was skipped for that peer forever; with no other
+    resend mechanism a 2-2 height split could wedge the whole net — the
+    netchaos smoke's stall signature."""
+    from tendermint_tpu.consensus.reactor import ConsensusReactor, PeerState
+    from tendermint_tpu.libs.bitarray import BitArray
+    from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT
+
+    class _Vote:
+        height, round_, type_, validator_index = 5, 0, VOTE_TYPE_PRECOMMIT, 1
+
+        def to_json(self):
+            return {"height": self.height}
+
+    class _VoteSet:
+        height, round_, type_ = 5, 0, VOTE_TYPE_PRECOMMIT
+
+        def size(self):
+            return 4
+
+        def bit_array(self):
+            ba = BitArray(4)
+            ba.set_index(1, True)
+            return ba
+
+        def get_by_index(self, index):
+            assert index == 1
+            return _Vote()
+
+    class _Peer:
+        def __init__(self, ok):
+            self.ok = ok
+            self.sent = 0
+
+        def send(self, ch, raw):
+            self.sent += 1
+            return self.ok
+
+    ps = PeerState(peer=None)
+    ps.prs.height, ps.prs.round_ = 5, 0
+    ps.ensure_vote_bit_arrays(5, 4)
+    vs = _VoteSet()
+
+    # pick alone must not mark: the same vote stays pickable
+    assert ps.pick_vote_to_send(vs) is not None
+    assert ps.pick_vote_to_send(vs) is not None
+
+    # failed send: bit stays clear, the vote is retried later
+    failing = _Peer(ok=False)
+    assert not ConsensusReactor._send_vote(None, failing, ps, _Vote())
+    assert failing.sent == 1
+    assert ps.pick_vote_to_send(vs) is not None, (
+        "a failed send must leave the vote pickable"
+    )
+
+    # successful send: marked, never picked again
+    assert ConsensusReactor._send_vote(None, _Peer(ok=True), ps, _Vote())
+    assert ps.pick_vote_to_send(vs) is None
+
+
+def test_last_commit_gossip_reaches_peer_in_a_later_round():
+    """The 2-2 wedge mechanism (netchaos stall): a laggard one height
+    behind whose ROUND raced past the commit round (it timed out
+    waiting for exactly these votes) had no tracking bit array — the
+    last-commit gossip branch silently sent nothing, and with the ahead
+    nodes unable to advance (no quorum), the >= +2 stored-commit
+    catchup never engaged. The gossip branch now ensures the
+    catchup-commit array at the commit's round first."""
+    from tendermint_tpu.consensus.reactor import PeerState
+    from tendermint_tpu.libs.bitarray import BitArray
+    from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT
+
+    class _LastCommit:
+        height, round_, type_ = 5, 0, VOTE_TYPE_PRECOMMIT
+
+        def size(self):
+            return 4
+
+        def bit_array(self):
+            ba = BitArray(4)
+            for i in range(3):
+                ba.set_index(i, True)
+            return ba
+
+        def get_by_index(self, index):
+            return ("vote", index)
+
+    ps = PeerState(peer=None)
+    ps.prs.height, ps.prs.round_ = 5, 2  # raced past commit round 0
+    ps.ensure_vote_bit_arrays(5, 4)     # tracks round 2, not round 0
+    # the hole: without a catchup array at round 0, nothing is pickable
+    assert ps.pick_vote_to_send(_LastCommit()) is None
+    # the fix: the height+1 gossip branch ensures the catchup round
+    ps.ensure_catchup_commit_round(5, 0, 4)
+    assert ps.pick_vote_to_send(_LastCommit()) is not None
+    # and marking via set_has_vote lands in the SAME tracking array
+    ps.set_has_vote(5, 0, VOTE_TYPE_PRECOMMIT, 0)
+    ps.set_has_vote(5, 0, VOTE_TYPE_PRECOMMIT, 1)
+    ps.set_has_vote(5, 0, VOTE_TYPE_PRECOMMIT, 2)
+    assert ps.pick_vote_to_send(_LastCommit()) is None

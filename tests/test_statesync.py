@@ -205,7 +205,7 @@ class TestManifest:
         lite = self._manifest().lite()
         assert set(lite) == {
             "format", "height", "chain_id", "chunks", "total_bytes",
-            "root", "header_hash",
+            "root", "header_hash", "kind",
         }
 
 
@@ -286,9 +286,11 @@ class TestProducer:
     def test_retention(self):
         chain = build_kvstore_chain(2)
         store = SnapshotStore(tempfile.mkdtemp(prefix="snapstore-"))
+        # full_every=1: all-full snapshots, so retention isn't clamped
+        # up to protect a delta chain (that case: test_statesync_delta)
         producer = SnapshotProducer(
             store, chain.app, chain.block_store, interval=2,
-            keep_recent=2, chunk_size=4096,
+            keep_recent=2, chunk_size=4096, full_every=1,
         )
         for _ in range(3):
             assert producer.maybe_snapshot(chain.state) is not None
@@ -439,16 +441,30 @@ class TestRestore:
             restorer.restore(*_rechunk(manifest, obj))
 
     def test_tampered_seen_commit_rejected(self):
+        # format 2: the seen commit rides the MANIFEST sidecar (outside
+        # the digested payload — deterministic roots); it must still be
+        # signature-verified against the height-H validator set
         chain, store, _p, height = snapshot_chain()
         manifest, chunks = load_snapshot(store, height)
-        obj = json.loads(b"".join(chunks))
-        tag, sig_hex = obj["block"]["seen_commit"]["precommits"][0]["signature"]
+        mobj = json.loads(json.dumps(manifest.to_json()))
+        tag, sig_hex = mobj["seen_commit"]["precommits"][0]["signature"]
         sig = bytearray(bytes.fromhex(sig_hex))
         sig[0] ^= 0x01
-        obj["block"]["seen_commit"]["precommits"][0]["signature"] = [tag, sig.hex().upper()]
+        mobj["seen_commit"]["precommits"][0]["signature"] = [tag, sig.hex().upper()]
+        tampered = Manifest.from_json(mobj)
         restorer, *_ = fresh_restorer(chain)
         with pytest.raises(RestoreError, match="commit"):
-            restorer.restore(*_rechunk(manifest, obj))
+            restorer.restore(tampered, chunks)
+
+    def test_format2_manifest_without_seen_commit_refused(self):
+        chain, store, _p, height = snapshot_chain()
+        manifest, chunks = load_snapshot(store, height)
+        mobj = manifest.to_json()
+        mobj.pop("seen_commit")
+        stripped = Manifest.from_json(mobj)
+        restorer, *_ = fresh_restorer(chain)
+        with pytest.raises(RestoreError, match="seen commit"):
+            restorer.restore(stripped, chunks)
 
     def test_total_bytes_mismatch_rejected(self):
         chain, store, _p, height = snapshot_chain()
@@ -631,6 +647,8 @@ def _rechunk(manifest: Manifest, obj: dict):
         chunk_size=manifest.chunk_size, total_bytes=len(payload),
         chunk_digests=[chunk_digest(c) for c in chunks],
         header_hash=manifest.header_hash, app_hash=manifest.app_hash,
+        format_=manifest.format, kind=manifest.kind,
+        base_height=manifest.base_height, seen_commit=manifest.seen_commit,
     )
     return m, chunks
 

@@ -1,0 +1,618 @@
+"""Authenticated state tree tests (tendermint_tpu/statetree/, round 13,
+docs/state-tree.md).
+
+The load-bearing property is CANONICAL SHAPE: the tree's root must be a
+pure function of its key/value set, independent of the operation history
+that produced it — replay-from-genesis, restore-from-sorted-map, and
+delta-chain application must all land on byte-identical roots. The
+oracle here is a direct recursive statement of that definition (root =
+max-priority key; partition; recurse), in the same spirit as
+merkle/simple.py's recursive parity oracle for the flat builder.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+from tendermint_tpu.merkle.statetree_proof import (
+    EMPTY_HASH,
+    TreeProof,
+    key_priority,
+    node_hash,
+    value_hash,
+)
+from tendermint_tpu.statetree import VersionedTree
+from tendermint_tpu.statetree.tree import TreeError
+
+
+# -- the recursive oracle -----------------------------------------------------
+
+
+def oracle_root(entries: dict[bytes, bytes]) -> bytes:
+    """The canonical treap root, straight from the definition."""
+    def build(keys: list[bytes]) -> bytes:
+        if not keys:
+            return EMPTY_HASH
+        root_key = max(keys, key=key_priority)
+        left = build([k for k in keys if k < root_key])
+        right = build([k for k in keys if k > root_key])
+        return node_hash(root_key, value_hash(entries[root_key]), left, right)
+
+    return build(list(entries))
+
+
+def _entries(n: int, seed: int = 0) -> dict[bytes, bytes]:
+    rng = random.Random(seed)
+    out = {}
+    while len(out) < n:
+        k = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 12)))
+        out[k] = b"v:" + k + bytes([rng.randrange(256)])
+    return out
+
+
+def _tree_from(entries: dict, version: int = 1, **kw) -> VersionedTree:
+    t = VersionedTree(**kw)
+    for k, v in entries.items():
+        t.set(k, v)
+    t.commit(version)
+    return t
+
+
+# -- canonical shape ----------------------------------------------------------
+
+
+class TestCanonicalShape:
+    def test_oracle_parity_1_to_300_keys(self):
+        """Root parity against the recursive oracle at every size 1..300
+        (stepped above 64 for runtime), via incremental inserts in a
+        shuffled order."""
+        rng = random.Random(7)
+        sizes = list(range(1, 65)) + list(range(65, 301, 7))
+        for n in sizes:
+            entries = _entries(n, seed=n)
+            keys = list(entries)
+            rng.shuffle(keys)
+            t = VersionedTree()
+            for k in keys:
+                t.set(k, entries[k])
+            assert t.commit(1) == oracle_root(entries), f"n={n}"
+
+    def test_insertion_order_independent(self):
+        entries = _entries(120, seed=3)
+        roots = set()
+        for seed in range(4):
+            keys = list(entries)
+            random.Random(seed).shuffle(keys)
+            t = VersionedTree()
+            for k in keys:
+                t.set(k, entries[k])
+            roots.add(t.commit(1))
+        assert len(roots) == 1
+
+    def test_bulk_load_matches_incremental(self):
+        entries = _entries(200, seed=9)
+        inc = _tree_from(entries)
+        bulk = VersionedTree.from_entries(entries, version=1)
+        assert bulk.root_hash() == inc.root_hash() == oracle_root(entries)
+        assert bulk.entries() == sorted(entries.items())
+        assert bulk.size == len(entries)
+
+    def test_delete_reaches_the_smaller_sets_root(self):
+        """Deleting keys must land exactly on the canonical root of the
+        remaining set — shape history must not leak into the hash."""
+        entries = _entries(80, seed=5)
+        t = _tree_from(entries)
+        gone = sorted(entries)[::3]
+        survivors = {k: v for k, v in entries.items() if k not in set(gone)}
+        for k in gone:
+            assert t.delete(k)
+        assert t.commit(2) == oracle_root(survivors)
+        assert t.size == len(survivors)
+        # and the older version is untouched (persistence)
+        assert t.root_hash(1) == oracle_root(entries)
+        assert t.get(gone[0], version=1) == entries[gone[0]]
+        assert t.get(gone[0], version=2) is None
+
+    def test_update_changes_only_value_binding(self):
+        entries = _entries(50, seed=11)
+        t = _tree_from(entries)
+        k = sorted(entries)[25]
+        t.set(k, b"updated")
+        changed = {**entries, k: b"updated"}
+        assert t.commit(2) == oracle_root(changed)
+
+    def test_empty_tree_and_single_key(self):
+        t = VersionedTree()
+        assert t.commit(1) == EMPTY_HASH
+        t.set(b"a", b"1")
+        root = t.commit(2)
+        assert root == oracle_root({b"a": b"1"})
+        assert t.delete(b"a")
+        assert t.commit(3) == EMPTY_HASH
+
+    def test_delete_absent_is_a_noop(self):
+        entries = _entries(20, seed=1)
+        t = _tree_from(entries)
+        assert not t.delete(b"\xff" * 20)
+        assert t.commit(2) == t.root_hash(1)
+
+
+# -- proofs -------------------------------------------------------------------
+
+
+class TestProofs:
+    def test_membership_and_absence_round_trip_1_to_300(self):
+        """Golden-vector sweep: at every size, every present key proves
+        membership and a fistful of absent keys prove absence — through
+        a JSON round trip, against the oracle root."""
+        for n in [1, 2, 3, 5, 9, 17, 33, 64, 127, 300]:
+            entries = _entries(n, seed=100 + n)
+            t = _tree_from(entries)
+            root = t.root_hash()
+            assert root == oracle_root(entries)
+            keys = sorted(entries)
+            probe = keys if n <= 33 else keys[:: max(1, n // 16)]
+            for k in probe:
+                p = TreeProof.from_json(
+                    json.loads(json.dumps(t.prove(k).to_json()))
+                )
+                assert p.is_membership and p.value == entries[k]
+                assert p.verify(root), (n, k)
+            for absent in (b"", b"\x00", b"\xff" * 16, keys[0] + b"\x00"):
+                if absent in entries:
+                    continue
+                p = TreeProof.from_json(
+                    json.loads(json.dumps(t.prove(absent).to_json()))
+                )
+                assert not p.is_membership
+                assert p.verify(root), (n, absent)
+
+    def test_proof_binds_value(self):
+        entries = _entries(40, seed=2)
+        t = _tree_from(entries)
+        root = t.root_hash()
+        k = sorted(entries)[7]
+        p = t.prove(k)
+        assert p.verify(root)
+        forged = TreeProof(k, b"forged-value", p.steps)
+        assert not forged.verify(root)
+
+    def test_proof_for_wrong_root_fails(self):
+        a = _tree_from(_entries(30, seed=4))
+        b = _tree_from(_entries(30, seed=6))
+        k = sorted(_entries(30, seed=4))[0]
+        assert a.prove(k).verify(a.root_hash())
+        assert not a.prove(k).verify(b.root_hash())
+
+    def test_absence_proof_cannot_claim_present_key(self):
+        entries = _entries(40, seed=8)
+        t = _tree_from(entries)
+        root = t.root_hash()
+        k = sorted(entries)[3]
+        # strip the value off a membership proof: the terminal step's
+        # key equals the query, which the absence rule rejects
+        p = t.prove(k)
+        assert not TreeProof(k, None, p.steps).verify(root)
+
+    def test_membership_proof_cannot_claim_absent_key(self):
+        entries = _entries(40, seed=12)
+        t = _tree_from(entries)
+        root = t.root_hash()
+        absent = b"\xfe" * 9
+        assert absent not in entries
+        p = t.prove(absent)
+        assert p.value is None and p.verify(root)
+        assert not TreeProof(absent, b"anything", p.steps).verify(root)
+
+    def test_tampered_steps_fail(self):
+        entries = _entries(64, seed=13)
+        t = _tree_from(entries)
+        root = t.root_hash()
+        k = sorted(entries)[31]
+        base = t.prove(k)
+        assert len(base.steps) >= 2
+        # drop a step / swap two steps / flip a child hash bit
+        assert not TreeProof(k, entries[k], base.steps[1:]).verify(root)
+        swapped = [base.steps[1], base.steps[0]] + base.steps[2:]
+        assert not TreeProof(k, entries[k], swapped).verify(root)
+        obj = base.to_json()
+        top = obj["steps"][-1]
+        for slot in (2, 3):
+            if top[slot]:
+                bad = json.loads(json.dumps(obj))
+                flipped = bytearray(bytes.fromhex(bad["steps"][-1][slot]))
+                flipped[0] ^= 0x01
+                bad["steps"][-1][slot] = flipped.hex().upper()
+                assert not TreeProof.from_json(bad).verify(root)
+                break
+
+    def test_decode_hardening(self):
+        good = _tree_from(_entries(5, seed=5)).prove(b"zz").to_json()
+        for mutate in (
+            lambda o: o.update(key=7),
+            lambda o: o.update(steps="zz"),
+            lambda o: o.update(steps=[["zz"]]),
+            lambda o: o.update(steps=[["00", "11" * 20, "", ""]] * 600),
+            lambda o: o.update(value=["no"]),
+        ):
+            obj = json.loads(json.dumps(good))
+            mutate(obj)
+            with pytest.raises(ValueError):
+                TreeProof.from_json(obj)
+
+    def test_empty_tree_absence(self):
+        t = VersionedTree()
+        t.commit(1)
+        p = t.prove(b"anything")
+        assert p.verify(EMPTY_HASH)
+        assert not p.verify(b"\x11" * 20)
+        assert not TreeProof(b"k", b"v", []).verify(EMPTY_HASH)
+
+
+# -- versions, diff, journal --------------------------------------------------
+
+
+class TestVersions:
+    def test_diff_exact(self):
+        t = VersionedTree()
+        t.set(b"a", b"1")
+        t.set(b"b", b"2")
+        t.set(b"c", b"3")
+        t.commit(10)
+        t.set(b"b", b"2x")       # update
+        t.set(b"d", b"4")        # insert
+        t.delete(b"a")           # delete
+        t.set(b"c", b"3")        # touched but unchanged -> not in diff
+        t.commit(20)
+        ups, dels = t.diff(10, 20)
+        assert ups == {b"b": b"2x", b"d": b"4"}
+        assert dels == [b"a"]
+
+    def test_diff_folds_multiple_commits(self):
+        t = VersionedTree()
+        t.set(b"a", b"1")
+        t.commit(1)
+        t.set(b"x", b"1")
+        t.commit(2)
+        t.delete(b"x")
+        t.set(b"y", b"2")
+        t.commit(3)
+        ups, dels = t.diff(1, 3)
+        assert ups == {b"y": b"2"}  # x set then deleted: absent from both
+        assert dels == []
+
+    def test_diff_applied_to_base_reproduces_target(self):
+        entries = _entries(90, seed=21)
+        t = _tree_from(entries, version=1)
+        rng = random.Random(22)
+        cur = dict(entries)
+        for v in (2, 3, 4):
+            for k in rng.sample(sorted(cur), 10):
+                if rng.random() < 0.3:
+                    t.delete(k)
+                    cur.pop(k)
+                else:
+                    t.set(k, b"v%d" % v + k)
+                    cur[k] = b"v%d" % v + k
+            nk = b"new-%d" % v
+            t.set(nk, b"n")
+            cur[nk] = b"n"
+            t.commit(v)
+        ups, dels = t.diff(1, 4)
+        replay = dict(entries)
+        for k in dels:
+            replay.pop(k)
+        replay.update(ups)
+        assert replay == cur
+        assert VersionedTree.from_entries(replay, 1).root_hash() == t.root_hash(4)
+
+    def test_diff_pruned_raises(self):
+        t = VersionedTree(keep_recent=2)
+        for v in (1, 2, 3, 4):
+            t.set(b"k%d" % v, b"v")
+            t.commit(v)
+        assert t.versions() == [3, 4]
+        with pytest.raises(TreeError):
+            t.diff(1, 4)
+        ups, _dels = t.diff(3, 4)
+        assert ups == {b"k4": b"v"}
+
+    def test_commit_version_must_increase(self):
+        t = VersionedTree()
+        t.commit(5)
+        with pytest.raises(TreeError):
+            t.commit(5)
+        with pytest.raises(TreeError):
+            t.commit(4)
+
+    def test_rollback_to(self):
+        entries = _entries(30, seed=30)
+        t = _tree_from(entries, version=1)
+        root1 = t.root_hash(1)
+        t.set(b"zz", b"staged")          # uncommitted staging
+        t.rollback_to()
+        assert t.get(b"zz") is None
+        t.set(b"zz", b"v2")
+        t.commit(2)
+        t.rollback_to(1)                 # drop committed version 2
+        assert t.versions() == [1]
+        assert t.root_hash() == root1 and t.get(b"zz") is None
+        assert t.size == len(entries)
+        # and the tree keeps working after a rollback
+        t.set(b"zz", b"v3")
+        assert t.commit(3) == oracle_root({**entries, b"zz": b"v3"})
+
+    def test_retention_prunes_oldest(self):
+        t = VersionedTree(keep_recent=3)
+        for v in range(1, 8):
+            t.set(b"k%d" % v, b"v")
+            t.commit(v)
+        assert t.versions() == [5, 6, 7]
+        with pytest.raises(TreeError):
+            t.root_hash(2)
+
+
+# -- batched hashing ----------------------------------------------------------
+
+
+class _CountingHasher:
+    """Duck-types the one Hasher method the tree uses; CPU digests so
+    parity with the unhashed path is byte-exact."""
+
+    def __init__(self):
+        self.batches = 0
+        self.items = 0
+
+    def part_leaf_hashes(self, chunks):
+        from tendermint_tpu.crypto.hashing import ripemd160
+
+        self.batches += 1
+        self.items += len(chunks)
+        return [ripemd160(c) for c in chunks]
+
+
+class TestBatchedHashing:
+    def test_gateway_batches_match_cpu(self):
+        entries = _entries(400, seed=40)
+        h = _CountingHasher()
+        t = VersionedTree.from_entries(entries, version=1, hasher=h)
+        assert t.root_hash() == oracle_root(entries)
+        assert h.batches >= 1 and h.items >= 400
+        assert t.stats()["gateway_nodes"] == h.items
+
+    def test_incremental_commit_batches_waves(self):
+        entries = _entries(600, seed=41)
+        h = _CountingHasher()
+        t = VersionedTree.from_entries(entries, version=1, hasher=h)
+        h.batches = h.items = 0
+        for i in range(40):
+            t.set(b"upd-%03d" % i, b"x")
+        t.commit(2)
+        # a 40-key update dirties O(changed * log n) nodes; the wave
+        # batching must stay far below one call per node
+        assert t.stats()["last_commit_nodes"] > 40
+        assert h.batches <= 40, "wave batching degenerated to per-node calls"
+        assert t.root_hash() == oracle_root(
+            {**entries, **{b"upd-%03d" % i: b"x" for i in range(40)}}
+        )
+
+
+# -- app / RPC / light-client integration -------------------------------------
+
+
+class TestAppIntegration:
+    def test_kvstore_app_hash_is_tree_root(self):
+        from tendermint_tpu.abci.apps.kvstore import KVStoreApp
+
+        app = KVStoreApp()
+        app.deliver_tx(b"a=1")
+        app.deliver_tx(b"b=2")
+        res = app.commit()
+        assert res.data == app.app_hash == oracle_root({b"a": b"1", b"b": b"2"})
+        app.deliver_tx(b"a=9")
+        app.commit()
+        assert app.app_hash == oracle_root({b"a": b"9", b"b": b"2"})
+        assert app.tree.root_hash(1) == oracle_root({b"a": b"1", b"b": b"2"})
+
+    def test_kvstore_query_proofs(self):
+        from tendermint_tpu.abci.apps.kvstore import KVStoreApp
+
+        app = KVStoreApp()
+        app.deliver_tx(b"a=1")
+        app.commit()
+        res = app.query(b"a", prove=True)
+        assert res.code == 0 and res.value == b"1" and res.height == 1
+        p = TreeProof.from_json(json.loads(res.proof))
+        assert p.verify(app.app_hash) and p.value == b"1"
+        absent = app.query(b"nope", prove=True)
+        assert absent.code == 0 and absent.value == b""
+        pa = TreeProof.from_json(json.loads(absent.proof))
+        assert pa.value is None and pa.verify(app.app_hash)
+        # a fresh app has no committed root to prove against
+        fresh = KVStoreApp()
+        assert fresh.query(b"a", prove=True).code != 0
+
+    def test_counter_prove_clear_unsupported_error(self):
+        from tendermint_tpu.abci.apps.counter import CounterApp
+        from tendermint_tpu.abci.types import CODE_UNSUPPORTED, Application
+
+        for app in (CounterApp(), Application()):
+            res = app.query(b"hash", prove=True)
+            assert res.code == CODE_UNSUPPORTED
+            assert "proofs unsupported" in res.log
+            assert res.proof == b""
+            # and the non-proving path still serves
+            assert app.query(b"hash").code == 0
+
+    def test_persistent_app_reload_rebuilds_tree(self, tmp_path):
+        from tendermint_tpu.abci.apps.kvstore import PersistentKVStoreApp
+
+        app = PersistentKVStoreApp(str(tmp_path))
+        app.deliver_tx(b"x=1")
+        app.commit()
+        app.deliver_tx(b"y=2")
+        app.commit()
+        reloaded = PersistentKVStoreApp(str(tmp_path))
+        assert reloaded.app_hash == app.app_hash
+        assert reloaded.height == 2
+        p = TreeProof.from_json(json.loads(reloaded.query(b"x", prove=True).proof))
+        assert p.verify(reloaded.app_hash)
+
+    def test_restore_delta_contract(self):
+        from tendermint_tpu.abci.apps.kvstore import KVStoreApp
+
+        src = KVStoreApp()
+        for h in range(1, 4):
+            src.deliver_tx(b"k%d=v%d" % (h, h))
+            if h == 2:
+                src.deliver_tx(b"k1=updated")
+            src.commit()
+        # restore a replica at height 2, then delta it to height 3
+        replica = KVStoreApp()
+        snap2 = json.dumps({
+            "height": 2,
+            "app_hash": src.tree.root_hash(2).hex(),
+            "state": {"k1": b"updated".hex(), "k2": b"v2".hex()},
+        }, sort_keys=True).encode()
+        replica.restore(snap2, height=2, app_hash=src.tree.root_hash(2))
+        ups, dels = src.tree.diff(2, 3)
+        replica.restore_delta(ups, dels, 3, src.app_hash)
+        assert replica.app_hash == src.app_hash and replica.height == 3
+        assert replica.state == src.state
+
+    def test_restore_delta_refuses_wrong_hash_with_nothing_applied(self):
+        from tendermint_tpu.abci.apps.kvstore import KVStoreApp
+
+        app = KVStoreApp()
+        snap = json.dumps({
+            "height": 1, "app_hash": oracle_root({b"a": b"1"}).hex(),
+            "state": {"a": b"1".hex()},
+        }, sort_keys=True).encode()
+        app.restore(snap, height=1, app_hash=oracle_root({b"a": b"1"}))
+        before = (app.height, app.app_hash, dict(app.state))
+        with pytest.raises(ValueError, match="verified app hash"):
+            app.restore_delta({b"b": b"2"}, [], 2, b"\xee" * 20)
+        assert (app.height, app.app_hash, app.state) == before
+        assert app.tree.versions() == [1]
+        with pytest.raises(ValueError, match="stale delta"):
+            app.restore_delta({b"b": b"2"}, [], 1, oracle_root({b"a": b"1"}))
+        with pytest.raises(ValueError, match="restored base"):
+            KVStoreApp().restore_delta({b"b": b"2"}, [], 2, b"\x11" * 20)
+
+
+class TestVerifiedQuery:
+    def _chain(self, n=6):
+        from tendermint_tpu.rpc.light import LightClient
+        from tendermint_tpu.statesync.devchain import build_kvstore_chain
+
+        chain = build_kvstore_chain(n)
+        lc = LightClient(
+            chain.rpc_stub(), chain.genesis_doc.chain_id,
+            chain.state.load_validators(1), trusted_height=0,
+        )
+        return chain, lc
+
+    def test_membership_and_absence(self):
+        chain, lc = self._chain()
+        head = chain.block_store.height()
+        res = lc.verified_query(b"k5-0", height=head - 1)
+        assert res["value"] == b"v5" and not res["absent"]
+        assert res["height"] == head - 1
+        gone = lc.verified_query(b"never-written", height=head - 1)
+        assert gone["absent"] and gone["value"] is None
+
+    def test_head_proof_needs_next_header(self):
+        from tendermint_tpu.rpc.light import LightClientError
+
+        chain, lc = self._chain()
+        head = chain.block_store.height()
+        with pytest.raises(LightClientError, match="header"):
+            lc.verified_query(b"k5-0", height=head)
+        chain.build(1)  # header head+1 now exists
+        res = lc.verified_query(b"k5-0", height=head)
+        assert res["value"] == b"v5"
+
+    def test_lying_node_detected(self):
+        from tendermint_tpu.rpc.light import LightClientError
+
+        chain, lc = self._chain()
+        head = chain.block_store.height()
+        real = chain.rpc_stub()
+
+        class Liar:
+            def __getattr__(self, name):
+                return getattr(real, name)
+
+            def abci_query(self, **kw):
+                out = real.abci_query(**kw)
+                out["response"]["value"] = b"forged".hex().upper()
+                return out
+
+        lc.client = Liar()
+        with pytest.raises(LightClientError, match="value"):
+            lc.verified_query(b"k5-0", height=head - 1)
+
+    def test_forged_proof_detected(self):
+        from tendermint_tpu.rpc.light import LightClientError
+
+        chain, lc = self._chain()
+        head = chain.block_store.height()
+        real = chain.rpc_stub()
+
+        class ProofForger:
+            def __getattr__(self, name):
+                return getattr(real, name)
+
+            def abci_query(self, **kw):
+                out = real.abci_query(**kw)
+                raw = json.loads(bytes.fromhex(out["response"]["proof"]))
+                step = raw["steps"][-1]
+                flip = bytearray(bytes.fromhex(step[1]))
+                flip[0] ^= 0x01
+                step[1] = flip.hex().upper()
+                out["response"]["proof"] = (
+                    json.dumps(raw).encode().hex().upper()
+                )
+                return out
+
+        lc.client = ProofForger()
+        with pytest.raises(LightClientError, match="proof"):
+            lc.verified_query(b"k5-0", height=head - 1)
+
+    def test_unsupported_app_refused_loudly(self):
+        from tendermint_tpu.abci.apps.counter import CounterApp
+        from tendermint_tpu.rpc.light import LightClient, LightClientError
+        from tendermint_tpu.statesync.devchain import DevChain
+
+        chain = DevChain(CounterApp())
+        chain.build(3)
+        lc = LightClient(
+            chain.rpc_stub(), chain.genesis_doc.chain_id,
+            chain.state.load_validators(1), trusted_height=0,
+        )
+        with pytest.raises(LightClientError, match="proofs unsupported"):
+            lc.verified_query(b"hash", height=2)
+
+
+# -- sizes & stats ------------------------------------------------------------
+
+
+class TestBookkeeping:
+    def test_size_and_entries(self):
+        entries = _entries(70, seed=50)
+        t = _tree_from(entries)
+        assert t.size == 70
+        assert t.entries() == sorted(entries.items())
+        assert t.get(sorted(entries)[0]) == entries[sorted(entries)[0]]
+
+    def test_stats_shape(self):
+        t = _tree_from(_entries(10, seed=51))
+        s = t.stats()
+        for key in ("size", "commits", "nodes_created", "hashed_nodes",
+                    "hash_waves", "gateway_nodes", "proofs",
+                    "versions_retained", "latest_version"):
+            assert key in s
+        assert s["size"] == 10 and s["commits"] == 1
