@@ -24,7 +24,7 @@ test:
 # hold (dots no worse than the seed) — plus the chip-free hash-stream
 # smoke (the two asserted BENCH_r07 rows: streamed hash offload >= 1.3x
 # single-shot on the sim transport, flat host builder >= 1.5x recursive).
-tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke
+tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke pipeline-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Chip-free bench smoke: every BASELINE config on the pinned CPU backend,
@@ -85,6 +85,18 @@ statetree-smoke:
 net-chaos-smoke:
 	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_NETCHAOS_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_netchaos.py
 
+# Pipeline smoke, chip-free (~10 s): bench_pipeline.py's reduced pass —
+# a real single-validator durable chain committing the same deterministic
+# signed workload on the seed execution plane vs the round-14 pipelined
+# plane: per-height byte-identity (block hash / part-set root / app hash
+# / txs) asserted across runs, the committed-tx/s floor asserted, and
+# the sharded kvstore fold's VersionedTree root asserted byte-identical
+# to serial apply. Runs as part of `make tier1` (the full matrix lives
+# in tests/test_pipeline.py + the pipeline crash tiers in
+# tests/test_wal_torture.py).
+pipeline-smoke:
+	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_PIPELINE_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_pipeline.py
+
 # Telemetry smoke, chip-free (~20 s): bench_telemetry.py's reduced pass —
 # boot a node, scrape GET /metrics (valid 0.0.4 text, >= 40 families
 # spanning every plane), pull one consensus_trace (segments sum to the
@@ -106,4 +118,4 @@ test_slow:
 native:
 	$(MAKE) -C native
 
-.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke
+.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke pipeline-smoke

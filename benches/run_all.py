@@ -39,6 +39,14 @@ Configs (BASELINE.md):
                   and committed-tx/s recorded, halt-under-partition and
                   byte-identical convergence asserted (writes
                   BENCH_r12.json; chip-free)
+ 14 pipeline     — execution plane: committed-tx/s at saturating signed
+                  mempool load on a durable single-validator chain, seed
+                  plane (inline finalize + per-tx DeliverTx dispatch +
+                  per-tx pure-python sig verify) vs the round-14 plane
+                  (staged pipelined finalize + grouped dispatch + one
+                  gateway sig batch per block + sharded kv fold); byte-
+                  identity of all chains asserted (writes BENCH_r14.json;
+                  chip-free)
  13 statetree    — authenticated app-state commitment: incremental
                   commit vs full tree rebuild, proof correctness rows,
                   delta-vs-full snapshot bytes (delta asserted <= 0.5x
@@ -75,6 +83,7 @@ BENCHES = {
     "11_rpc_load": [sys.executable, "benches/bench_rpc_load.py"],
     "12_netchaos": [sys.executable, "benches/bench_netchaos.py"],
     "13_statetree": [sys.executable, "benches/bench_statetree.py"],
+    "14_pipeline": [sys.executable, "benches/bench_pipeline.py"],
 }
 
 

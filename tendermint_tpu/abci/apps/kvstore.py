@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 
 from tendermint_tpu.abci.types import (
     ABCIValidator,
@@ -36,6 +37,7 @@ from tendermint_tpu.abci.types import (
     ResponseInfo,
     ResponseQuery,
 )
+from tendermint_tpu.libs.envknob import env_number
 from tendermint_tpu.statetree import VersionedTree
 from tendermint_tpu.statetree.tree import TreeError
 
@@ -44,6 +46,20 @@ VAL_TX_PREFIX = b"val:"
 # never deletes — an authenticated tree without delete coverage would
 # leave the absence-proof/delta-delete planes untested end to end)
 DEL_TX_PREFIX = b"rm:"
+
+# round 14 (docs/execution-pipeline.md): keyspace-sharded parallel apply.
+# TENDERMINT_KVSTORE_SHARDS=N (>1) routes whole-block DeliverTx batches
+# through deliver_txs(): keys shard by their canonical key_priority
+# prefix, N workers fold each shard's ops IN TX ORDER to a final per-key
+# op, priorities batch through the gateway's RIPEMD plane, and ONE
+# deterministic merge (sorted key order) mutates state + tree — the
+# canonical-treap shape is a pure function of the final key set, so the
+# commit root is byte-identical to the serial per-tx apply (asserted in
+# tests/test_pipeline.py and benches/bench_pipeline.py). Default 0 =
+# the serial loop.
+SHARDS_DEFAULT = int(env_number("TENDERMINT_KVSTORE_SHARDS", 0, cast=int))
+SHARD_MIN_TXS = max(2, int(env_number("TENDERMINT_KVSTORE_SHARD_MIN", 32,
+                                      cast=int)))
 
 
 class KVStoreApp(Application):
@@ -56,6 +72,12 @@ class KVStoreApp(Application):
         # the gateway Hasher post-construction so dirty-node recompute
         # batches onto the device plane.
         self.tree = VersionedTree()
+        # round 14: sharded parallel apply shape (see module docstring);
+        # assignable per instance for benches/tests
+        self.shards = SHARDS_DEFAULT
+        self.shard_min_txs = SHARD_MIN_TXS
+        self.sharded_batches = 0  # deliver_txs batches that took the
+        #                           parallel path (observability/tests)
 
     def info(self) -> ResponseInfo:
         return ResponseInfo(
@@ -82,6 +104,118 @@ class KVStoreApp(Application):
         self.state[k.decode("latin-1")] = v
         self.tree.set(k, v)
         return ResponseDeliverTx(code=CODE_OK)
+
+    # -- sharded parallel apply (round 14) --------------------------------
+
+    def _shardable_op(self, tx: bytes):
+        """("set", key, value) | ("del", key, None) for a pure key-value
+        tx, or None for a tx the sharded fold cannot commute (those apply
+        via deliver_tx, in tx order, during the merge)."""
+        if tx.startswith(DEL_TX_PREFIX):
+            return ("del", tx[len(DEL_TX_PREFIX):], None)
+        if b"=" in tx:
+            k, v = tx.split(b"=", 1)
+            return ("set", k, v)
+        return ("set", tx, tx)
+
+    def _batch_priorities(self, keys: list[bytes]) -> dict[bytes, bytes]:
+        """Canonical key_priority for every key in ONE batched RIPEMD
+        pass (gateway plane when the tree carries a hasher: native x16 /
+        streamed devd) instead of one hashlib call per key — the
+        measured win of the sharded path at wide blocks.
+
+        Trade-off, accepted: shard ROUTING needs a priority for every
+        touched key (the shard-by-key_priority-prefix contract), while
+        the serial path only hashes keys NEW to the tree — on an
+        update-heavy block without a gateway hasher this batch does more
+        raw hashing than serial; with one wired it still wins on the
+        batched dispatch."""
+        from tendermint_tpu.merkle.statetree_proof import _PRIO_PREFIX
+
+        preimages = [_PRIO_PREFIX + k for k in keys]
+        hasher = getattr(self.tree, "hasher", None)
+        if hasher is not None and len(preimages) >= 16:
+            digests = hasher.part_leaf_hashes(preimages)
+        else:
+            from tendermint_tpu.crypto.hashing import ripemd160
+
+            digests = [ripemd160(p) for p in preimages]
+        return dict(zip(keys, digests))
+
+    def deliver_txs(self, txs: list[bytes],
+                    deliver_one=None) -> list[ResponseDeliverTx]:
+        """Whole-block DeliverTx (state/execution.py routes here through
+        AppConnConsensus.deliver_txs_async when the app offers it).
+        Serial loop below the shard floor; above it, the keyspace-sharded
+        parallel fold + deterministic merge described in the module
+        docstring. Final state, responses, AND the committed tree root
+        are byte-identical to the serial per-tx path.
+
+        `deliver_one` overrides the per-tx fallback/non-shardable path —
+        a subclass that pre-processes the batch (signedkv strips verified
+        envelopes) passes the PLAIN kv apply so its own deliver_tx's
+        per-tx preprocessing is not re-entered on the stripped bytes."""
+        deliver_one = deliver_one if deliver_one is not None else self.deliver_tx
+        n = int(self.shards)
+        if n <= 1 or len(txs) < self.shard_min_txs:
+            return [deliver_one(tx) for tx in txs]
+        self.sharded_batches += 1
+        plan = [self._shardable_op(tx) for tx in txs]
+        keys = sorted({op[1] for op in plan if op is not None})
+        prios = self._batch_priorities(keys)
+        shard_of = {k: prios[k][0] % n for k in keys}
+        buckets: list[list] = [[] for _ in range(n)]
+        for op in plan:
+            if op is not None:
+                buckets[shard_of[op[1]]].append(op)
+        # parallel fold: each worker reduces its shard's ops — kept in
+        # global tx order, and a key lives in exactly one shard, so
+        # per-key order (the only order that matters in a kv store) is
+        # the serial one
+        folded: list[dict | None] = [None] * n
+        def fold(si: int) -> None:
+            final: dict = {}
+            for kind, k, v in buckets[si]:
+                final[k] = (kind, v)
+            folded[si] = final
+        workers = [
+            threading.Thread(target=fold, args=(si,), name=f"kv.shard{si}")
+            for si in range(1, n)
+        ]
+        for w in workers:
+            w.start()
+        fold(0)
+        for w in workers:
+            w.join()
+
+        from tendermint_tpu.state.fail import pipeline_point
+
+        pipeline_point("mid_parallel_apply")
+
+        # responses in tx order; non-shardable txs (validator txs in the
+        # persistent variant) apply HERE, in tx order — they touch state
+        # disjoint from the kv fold, so the interleave is immaterial
+        responses = []
+        for tx, op in zip(txs, plan):
+            if op is None:
+                responses.append(deliver_one(tx))
+            else:
+                responses.append(ResponseDeliverTx(code=CODE_OK))
+        # deterministic merge: one mutation per final key, sorted key
+        # order (the treap shape is a function of the key SET; the order
+        # only has to be deterministic)
+        merged: dict = {}
+        for final in folded:
+            merged.update(final)  # shard key ranges are disjoint
+        for k in sorted(merged):
+            kind, v = merged[k]
+            if kind == "del":
+                self.state.pop(k.decode("latin-1"), None)
+                self.tree.delete(k)
+            else:
+                self.state[k.decode("latin-1")] = v
+                self.tree.set(k, v, prio=prios[k])
+        return responses
 
     def commit(self) -> ResponseCommit:
         self.height += 1
@@ -294,6 +428,14 @@ class PersistentKVStoreApp(KVStoreApp):
             return pubkey_hex.upper(), int(power_s)
         except (ValueError, IndexError):
             return None
+
+    def _shardable_op(self, tx: bytes):
+        # validator txs mutate the registry + val_diffs (order-sensitive
+        # among themselves): excluded from the kv fold, applied in tx
+        # order during the merge via deliver_tx
+        if tx.startswith(VAL_TX_PREFIX):
+            return None
+        return super()._shardable_op(tx)
 
     def deliver_tx(self, tx: bytes) -> ResponseDeliverTx:
         if tx.startswith(VAL_TX_PREFIX):

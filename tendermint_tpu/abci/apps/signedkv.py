@@ -50,6 +50,9 @@ class SignedKVStoreApp(KVStoreApp):
         super().__init__()
         self.verify_in_app = verify_in_app
         self.check_tx_calls = 0  # observable by tests/benches
+        # round 14: the whole-block DeliverTx batch verifies through
+        # this gateway Verifier (None = the process default)
+        self.deliver_verifier = None
 
     def _verify(self, tx: bytes) -> bool:
         item = parse_sig_tx(tx)
@@ -72,3 +75,39 @@ class SignedKVStoreApp(KVStoreApp):
         if not self._verify(tx):
             return ResponseDeliverTx(code=CODE_UNAUTHORIZED, log="invalid signature")
         return super().deliver_tx(tx[SIG_TX_OVERHEAD:])
+
+    def deliver_txs(self, txs: list[bytes]) -> list[ResponseDeliverTx]:
+        """Whole-block DeliverTx (round 14): the block's signatures
+        verify in ONE gateway batch (the numpy/device kernel — off the
+        per-tx pure-Python path, and GIL-releasing so a pipelined apply
+        genuinely overlaps the next height's consensus work), then the
+        surviving payloads ride the kvstore fold (sharded when armed).
+        Verdicts and responses are identical to the per-tx loop."""
+        if len(txs) < 2:
+            return [self.deliver_tx(tx) for tx in txs]
+        from tendermint_tpu.ops import gateway
+
+        verifier = self.deliver_verifier or gateway.default_verifier()
+        items = [parse_sig_tx(tx) for tx in txs]
+        idx = [i for i, it in enumerate(items) if it is not None]
+        verdicts = verifier.verify_batch([items[i] for i in idx]) if idx else []
+        ok = {i: bool(v) for i, v in zip(idx, verdicts)}
+        responses: list[ResponseDeliverTx | None] = [None] * len(txs)
+        payloads = []
+        for i, tx in enumerate(txs):
+            if ok.get(i):
+                payloads.append(tx[SIG_TX_OVERHEAD:])
+            else:
+                responses[i] = ResponseDeliverTx(
+                    code=CODE_UNAUTHORIZED, log="invalid signature"
+                )
+        # the payloads are already verified + stripped: the fold's per-tx
+        # fallback must apply them as PLAIN kv bytes, not re-enter this
+        # class's signed deliver_tx (which would reject them all)
+        payload_res = iter(super().deliver_txs(
+            payloads, deliver_one=lambda t: KVStoreApp.deliver_tx(self, t)
+        ))
+        for i in range(len(txs)):
+            if responses[i] is None:
+                responses[i] = next(payload_res)
+        return responses

@@ -136,6 +136,14 @@ class ABCIClient(BaseService):
     def deliver_tx_async(self, tx: bytes) -> ReqRes:
         raise NotImplementedError
 
+    def deliver_txs_async(self, txs: list[bytes]) -> list[ReqRes]:
+        """Grouped DeliverTx dispatch (round 14) — the execution
+        pipeline hands the whole block's txs at once so a batch-capable
+        app (the kvstore sharded apply) sees them together and a local
+        client pays ONE lock round trip. Default is the per-tx loop,
+        which for the socket client is already pipelined in order."""
+        return [self.deliver_tx_async(tx) for tx in txs]
+
     def flush_async(self) -> ReqRes:
         raise NotImplementedError
 
@@ -231,6 +239,26 @@ class LocalClient(ABCIClient):
         rr = ReqRes("deliver_tx")
         rr.complete(self.deliver_tx_sync(tx))
         return rr
+
+    def deliver_txs_async(self, txs: list[bytes]) -> list[ReqRes]:
+        # one app-lock round trip for the whole block; an app exposing
+        # deliver_txs (kvstore sharded apply, round 14) gets the batch
+        # wholesale, others run the same serial loop under the lock.
+        # Notifications keep per-tx order, after the lock drops — same
+        # ordering sequential deliver_tx_sync calls produce.
+        with self._app_mtx:
+            batch = getattr(self.app, "deliver_txs", None)
+            if batch is not None:
+                reses = batch(list(txs))
+            else:
+                reses = [self.app.deliver_tx(tx) for tx in txs]
+        out = []
+        for tx, res in zip(txs, reses):
+            self._notify("deliver_tx", tx, res)
+            rr = ReqRes("deliver_tx")
+            rr.complete(res)
+            out.append(rr)
+        return out
 
     def flush_async(self) -> ReqRes:
         rr = ReqRes("flush")

@@ -136,6 +136,12 @@ class ConsensusConfig:
     create_empty_blocks: bool = True
     create_empty_blocks_interval: float = 0.0
 
+    # pipelined execution plane (round 14, docs/execution-pipeline.md):
+    # defer apply(H) + snapshot hook + events to the ordered executor
+    # while consensus advances to H+1; False restores the fully serial
+    # finalize_commit (benches/bench_pipeline.py measures the gap)
+    pipeline_apply: bool = True
+
     peer_gossip_sleep_duration: float = 0.100
     peer_query_maj23_sleep_duration: float = 2.0
 

@@ -11,6 +11,18 @@ Two tiers, run in order on node start (SURVEY.md §3.5):
    #ENDHEIGHT marker back through the consensus state machine; the
    priv-validator's double-sign guard makes re-signing idempotent
    (consensus/replay.go:98-148).
+
+Pipelined execution (round 14, docs/execution-pipeline.md): replay is
+SERIAL by contract — cs.replay_mode forces the inline finalize path, so
+the WAL's single-thread total order is reproduced exactly. The pipeline
+also widens the legal crash images this module must absorb: the WAL
+``#ENDHEIGHT: H`` marker is written BEFORE the deferred apply of H runs,
+so a crash leaves store=H, state=H-1, app=H-1 with the marker (and even
+H+1 messages) on disk. That is the handshake's store==state+1 /
+app==state case — `_apply_final_block` replays block H against the real
+app — and catchup then resumes from the surviving marker as ever; no new
+machinery, proven end to end by tests/test_wal_torture.py's
+pipeline-stage crash cycles.
 """
 
 from __future__ import annotations

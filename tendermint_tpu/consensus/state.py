@@ -14,19 +14,41 @@ VerifyCommit through `verifier.commit_batch_verifier()` (wide batch → TPU
 kernel), both from ops.gateway. Accept/reject semantics are identical to
 the reference's sequential loops.
 
+Pipelined execution (round 14, docs/execution-pipeline.md): with
+``config.pipeline_apply`` (default on), finalize_commit stages the
+height: stage 1 — validate, save the block, write the WAL ``#ENDHEIGHT``
+marker — stays synchronous on this routine; stage 2 — ``sm.apply_block``
++ app Commit + snapshot hook + event flush — runs on a single ordered
+executor thread (consensus/pipeline.py) while this routine advances to
+H+1 over a PROVISIONAL next state (the no-valset-diff transform of
+``set_block_and_validators``; its ``app_hash`` is still H−1's, which is
+exactly what header H claims). The first H+1 step that actually needs
+the applied state — entering propose, verifying a received proposal,
+adding an H+1 vote — calls ``_join_apply()``, which blocks on the
+deferred apply, swaps in the applied state, and (in the rare case a
+valset diff landed) reconciles ``rs.validators``/``rs.votes`` before any
+H+1 vote was verified (every vote path joins FIRST, so the provisional
+set is never consulted for crypto). Replay and the FAIL_TEST_INDEX crash
+model force the serial path — their determinism is single-thread by
+construction (state/fail.py).
+
 Test seams, as in the reference (consensus/state.go:222-226): the
 decide_proposal / do_prevote / set_proposal methods are assignable, and
-the ticker is injectable (MockTicker fires only NewHeight).
+the ticker is injectable (MockTicker fires only NewHeight). Round 14
+adds ``propose_time_source`` (height -> time_ns) so benches can pin
+block times for cross-run byte-identity.
 """
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
 from dataclasses import dataclass
 
 from tendermint_tpu.consensus import messages as msgs
+from tendermint_tpu.consensus import pipeline as cpipeline
 from tendermint_tpu.consensus import trace as ctrace
 from tendermint_tpu.consensus.height_vote_set import HeightVoteSet
 from tendermint_tpu.consensus.round_state import RoundState, RoundStep
@@ -114,6 +136,24 @@ class ConsensusState(BaseService):
         self.trace = ctrace.TraceRecorder(
             device_probe=self._trace_device_probe
         )
+
+        # pipelined execution plane (round 14): stage-2 (apply) rides an
+        # ordered executor; the consensus thread holds at most ONE
+        # pending apply (for rs.height - 1) and joins it at the first
+        # H+1 step needing the applied state
+        self.pipeline_apply = bool(getattr(config, "pipeline_apply", True))
+        self._apply_executor: cpipeline.ApplyExecutor | None = None
+        self._pending_apply: cpipeline.DeferredApply | None = None
+        self._state_provisional = False  # self.state awaits the join
+        self._apply_poisoned: BaseException | None = None
+        self.pipeline_applies = 0      # heights committed via stage 2
+        self.pipeline_serial_commits = 0
+        self.pipeline_valset_reconciles = 0
+        self.pipeline_join_wait_last = 0.0
+        self.pipeline_overlap_last = 0.0
+        # test/bench seam: height -> block time_ns for deterministic
+        # cross-run block bytes (None = wall clock, the default)
+        self.propose_time_source = None
 
         # duplicate-vote evidence (beyond reference: state.go:1438-1447
         # punts with a TODO; we record validated pairs — types/evidence)
@@ -268,6 +308,21 @@ class ConsensusState(BaseService):
         self._inputs.put(("quit", None))
         if self._thread:
             self._thread.join(timeout=5)
+        # drain the deferred apply so state/app land on a consistent
+        # height for the restart handshake; a wedged app is abandoned
+        # (bounded wait — shutdown never blocks on a stuck apply, the
+        # executor thread is a daemon)
+        pending = self._pending_apply
+        if pending is not None:
+            if not pending.wait(timeout=10):
+                self.logger.warning(
+                    "deferred apply of %d still running at stop; abandoning",
+                    pending.height,
+                )
+            self._pending_apply = None
+        if self._apply_executor is not None:
+            self._apply_executor.stop(timeout=2)
+            self._apply_executor = None
         if self.wal is not None:
             self.wal.stop()
 
@@ -508,7 +563,13 @@ class ConsensusState(BaseService):
         per-vote verify inside VoteSet.add_vote becomes a cache pop.
         Purely an accelerator — skipped votes (wrong height, unknown
         validator, already in the set) just verify on CPU as before, and
-        WAL replay feeds votes one at a time so it never primes."""
+        WAL replay feeds votes one at a time so it never primes.
+
+        Pipeline note: priming deliberately does NOT join a pending
+        deferred apply — against a provisional validator set it would at
+        worst prime cache entries nobody pops (wasted work on the rare
+        valset-change height, never a wrong verdict: add_vote joins
+        before any verify consults the set)."""
         if len(votes) < 2:
             return
         rs = self.rs
@@ -607,6 +668,11 @@ class ConsensusState(BaseService):
             return
         self.logger.info("enter_new_round(%d/%d)", height, round_)
 
+        if round_ != 0:
+            # later rounds copy + re-accum the validator set: that must
+            # be the APPLIED set, not the provisional one
+            self._join_apply("new_round")
+
         validators = rs.validators
         if rs.round_ < round_:
             validators = validators.copy()
@@ -647,6 +713,7 @@ class ConsensusState(BaseService):
         "proves" the app results (consensus/state.go:806-816)."""
         if height == 1:
             return True
+        self._join_apply("need_proof_block")  # reads the applied app_hash
         last_block_meta = self.block_store.load_block_meta(height - 1)
         if last_block_meta is None:
             return False
@@ -690,6 +757,10 @@ class ConsensusState(BaseService):
         ):
             return
         self.logger.info("enter_propose(%d/%d)", height, round_)
+        # propose is THE join point of the deferred-app-hash contract:
+        # everything from here on (our proposal's header, proposer
+        # selection, proposal/vote verification) reads the applied state
+        self._join_apply("propose")
 
         def defer_():
             rs.round_ = round_
@@ -749,6 +820,11 @@ class ConsensusState(BaseService):
         """consensus/state.go:959-985: reap mempool, build block+parts.
         PartSet leaf hashing routes through the TPU hasher."""
         rs = self.rs
+        # the header needs the applied app_hash, and the reap must run
+        # AFTER the deferred apply's mempool.update(H) — joining first
+        # covers both (the mempool-lock-scope invariant,
+        # docs/execution-pipeline.md)
+        self._join_apply("create_proposal")
         if rs.height == 1:
             commit = empty_commit()
         elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
@@ -758,6 +834,15 @@ class ConsensusState(BaseService):
             return None, None
         txs = self.mempool.reap(self.config.max_block_size_txs)
         t0 = time.perf_counter()
+        # submitted-early future: the tx root starts hashing on the hash
+        # plane NOW, overlapping commit/evidence/header assembly below;
+        # Data.hash() joins it inside make_block (gateway in-flight table)
+        submit_tx_root = getattr(self.part_hasher, "submit_tx_root", None)
+        if submit_tx_root is not None and len(txs) >= 2:
+            submit_tx_root([bytes(t) for t in txs])
+        time_ns = None
+        if self.propose_time_source is not None:
+            time_ns = self.propose_time_source(rs.height)
         try:
             return Block.make_block(
                 height=rs.height,
@@ -768,11 +853,17 @@ class ConsensusState(BaseService):
                 val_hash=self.state.validators.hash(),
                 app_hash=self.state.app_hash,
                 part_size=self.state.params().block_gossip.block_part_size_bytes,
+                time_ns=time_ns,
                 part_hasher=self.part_hasher.part_leaf_hashes,
                 # proposal part sets: leaf digests + the whole proof tree in
                 # one offload pass when the hash plane serves (devd
-                # hash_stream tree frame); None -> the flat host builder
+                # hash_stream tree frame); None -> the flat host builder.
+                # Round 14: submitted as a future so the device round trip
+                # overlaps Part construction (types/part_set.py)
                 part_tree_hasher=self.part_hasher.part_set_tree,
+                part_tree_submitter=getattr(
+                    self.part_hasher, "submit_part_set_tree", None
+                ),
                 # drain detected-but-uncommitted double-signs into the
                 # proposal: one detecting node puts the proof ON CHAIN
                 # for everyone (types/evidence.py round 12; a block may
@@ -807,6 +898,7 @@ class ConsensusState(BaseService):
     def default_do_prevote(self, height: int, round_: int) -> None:
         """consensus/state.go:1019-1057."""
         rs = self.rs
+        self._join_apply("prevote")  # validate_block reads self.state
         if rs.locked_block is not None:
             self.logger.info("prevote: locked block")
             self.sign_add_vote(VOTE_TYPE_PREVOTE, rs.locked_block.hash(), rs.locked_block_parts.header())
@@ -997,10 +1089,19 @@ class ConsensusState(BaseService):
 
     def finalize_commit(self, height: int) -> None:
         """Save the block, write the WAL marker, apply via the execution
-        pipeline, move to the next height (consensus/state.go:1258-1355)."""
+        pipeline, move to the next height (consensus/state.go:1258-1355).
+
+        Round 14: stage 1 (validate + block save + #ENDHEIGHT) is always
+        synchronous here; stage 2 (apply + snapshot hook + events) is
+        deferred to the apply executor when the pipeline is enabled, and
+        joined by the first H+1 step that needs the applied state."""
         rs = self.rs
         if rs.height != height or rs.step != RoundStep.COMMIT:
             return
+        # a pending apply here means H-1's stage 2 is still in flight
+        # while H fully committed — impossible via the vote path (every
+        # H-vote joins first), but replay/test seams can call directly
+        self._join_apply("finalize")
         block_id = rs.votes.precommits(rs.commit_round).two_thirds_majority()
         block, block_parts = rs.proposal_block, rs.proposal_block_parts
         if block_id is None or not block.hashes_to(block_id.hash):
@@ -1013,8 +1114,9 @@ class ConsensusState(BaseService):
             height, block.hash().hex()[:12], block.header.num_txs,
         )
         # trace: the commit-wait segment ends here; the finalize
-        # sub-phases (save -> apply -> snapshot hook -> events) partition
-        # the rest of the height's wall time
+        # sub-phases (save -> apply -> snapshot hook -> events, or
+        # save -> submit when pipelined) partition the rest of the
+        # height's wall time
         self.trace.mark("block_save")
 
         fail_point()
@@ -1032,47 +1134,52 @@ class ConsensusState(BaseService):
 
         fail_point()
 
-        self.trace.mark("apply")
         state_copy = self.state.copy()
         event_cache = EventCache(self.evsw) if self.evsw is not None else _NullCache()
-        sm.apply_block(
-            state_copy,
-            event_cache,
-            self.proxy_app_conn,
-            block,
-            block_parts.header(),
-            self.mempool,
-            batch_verifier=self.verifier.commit_batch_verifier(),
-        )
 
-        fail_point()
-
-        # the committed block's evidence section is now chain history:
-        # never re-propose it, and adopt pieces other nodes detected
-        # (validated above in validate_block)
-        if block.evidence.evidence:
-            self.evidence_pool.mark_committed(block.evidence.evidence)
-
-        self.trace.mark("snapshot_hook")
-        if self.post_apply_hook is not None and not self.replay_mode:
-            # snapshot production rides here: state_copy is the post-H
-            # state and the app just committed H — best-effort, a
-            # producer failure must never wedge consensus
-            try:
-                self.post_apply_hook(state_copy, block)
-            except Exception:  # noqa: BLE001
-                self.logger.exception("post-apply hook failed at %d", height)
-
-        # events: NewBlock/NewBlockHeader + cached tx events, post-commit
-        self.trace.mark("events")
-        if self.evsw is not None:
-            self.evsw.fire_event(tev.EVENT_NEW_BLOCK, tev.EventDataNewBlock(block))
-            self.evsw.fire_event(
-                tev.EVENT_NEW_BLOCK_HEADER, tev.EventDataNewBlockHeader(block.header)
+        if self._pipeline_enabled():
+            # the committed block's evidence section is chain history the
+            # moment the marker lands: never re-propose it (independent
+            # of the apply; validated above in validate_block)
+            if block.evidence.evidence:
+                self.evidence_pool.mark_committed(block.evidence.evidence)
+            # provisional state FIRST: the submit hands state_copy to the
+            # executor, whose apply_block mutates it — copying after the
+            # submit would race set_block_and_validators (a torn copy
+            # double-rotates accum: same valset hash, wrong proposer)
+            next_state = self._provisional_next_state(
+                state_copy, block, block_parts
             )
-        event_cache.flush()
+            self._submit_deferred_apply(
+                height, state_copy, event_cache, block, block_parts
+            )
+        else:
+            self.pipeline_serial_commits += 1
+            self.trace.mark("apply")
+            sm.apply_block(
+                state_copy,
+                event_cache,
+                self.proxy_app_conn,
+                block,
+                block_parts.header(),
+                self.mempool,
+                batch_verifier=self.verifier.commit_batch_verifier(),
+            )
 
-        fail_point()
+            fail_point()
+
+            # the committed block's evidence section is now chain history:
+            # never re-propose it, and adopt pieces other nodes detected
+            # (validated above in validate_block)
+            if block.evidence.evidence:
+                self.evidence_pool.mark_committed(block.evidence.evidence)
+
+            self._post_apply_tail(
+                state_copy, block, event_cache, height, mark_trace=True
+            )
+
+            fail_point()
+            next_state = state_copy
 
         now = time.monotonic()
         self.height_seconds_last = now - self._height_started
@@ -1080,16 +1187,189 @@ class ConsensusState(BaseService):
             self.height_seconds_max, self.height_seconds_last
         )
         self._height_started = now
+        cpipeline.pipeline_hists()["height"].observe(self.height_seconds_last)
         # seal this height's trace on the SAME clock reading the gauge
         # used (segments must sum to height_seconds_last), then start
         # the next height's
         self.trace.finish(height, self.height_seconds_last, now=now)
         self.trace.begin(height + 1, now=now)
 
-        self.update_to_state(state_copy)
+        self.update_to_state(next_state)
+        self._state_provisional = self._pending_apply is not None
         self.done_height.set()
         self.done_height.clear()
         self.schedule_round_0(self.rs)
+
+    # -- the pipelined execution plane (round 14) -------------------------
+
+    def _pipeline_enabled(self) -> bool:
+        """Stage-2 deferral policy. Replay is serial by contract (the WAL
+        is a single-thread total order), and the legacy FAIL_TEST_INDEX
+        crash model counts fail_point() hits on ONE thread — arming it
+        forces the serial path so the i-th hit stays deterministic
+        (state/fail.py; the pipeline's own crash boundaries are the named
+        pipeline_point() tier)."""
+        return (
+            self.pipeline_apply
+            and not self.replay_mode
+            and os.environ.get("FAIL_TEST_INDEX") is None
+        )
+
+    def _submit_deferred_apply(
+        self, height: int, state_copy, event_cache, block, block_parts
+    ) -> None:
+        """Stage 2: apply + app Commit + snapshot hook + events, on the
+        ordered executor. The block save and WAL marker already landed —
+        a crash before the apply completes is the store==state+1 image
+        the restart handshake replays (docs/execution-pipeline.md)."""
+        if self._apply_executor is None:
+            self._apply_executor = cpipeline.ApplyExecutor()
+        parts_header = block_parts.header()
+        batch_verifier = self.verifier.commit_batch_verifier()
+        pending = cpipeline.DeferredApply(height)
+
+        def run():
+            from tendermint_tpu.state.fail import pipeline_point
+
+            pipeline_point("pre_apply")
+            t0 = time.monotonic()
+            sm.apply_block(
+                state_copy,
+                event_cache,
+                self.proxy_app_conn,
+                block,
+                parts_header,
+                self.mempool,
+                batch_verifier=batch_verifier,
+            )
+            pipeline_point("post_apply")
+            apply_s = time.monotonic() - t0
+            # resolve the join NOW: the consensus thread only needs the
+            # applied state. The snapshot hook + event flush below run as
+            # the executor's tail — off the critical path entirely (the
+            # next height's apply queues behind them on this worker, so
+            # the app-quiesce guarantee still holds; the snapshot hook
+            # observes the app exactly at H because the next DeliverTx
+            # can only come from the next queued apply)
+            pending._finish(value=(state_copy, apply_s))
+            # apply(H) ran under consensus of H+1: attribute it there
+            self.trace.note_overlap(height + 1, "overlap_apply_s", apply_s)
+            t1 = time.monotonic()
+            self._post_apply_tail(
+                state_copy, block, event_cache, height, mark_trace=False
+            )
+            self.trace.note_overlap(
+                height + 1, "overlap_hook_s", time.monotonic() - t1
+            )
+            return state_copy, apply_s
+
+        self._pending_apply = self._apply_executor.submit(pending, run)
+        self.pipeline_applies += 1
+
+    def _post_apply_tail(self, state_copy, block, event_cache, height: int,
+                         mark_trace: bool) -> None:
+        """The post-apply work both finalize modes share: snapshot hook
+        (best-effort — a producer failure must never wedge consensus)
+        then NewBlock/NewBlockHeader + the cached tx events, post-commit.
+        Serial mode runs it inline with trace segment marks; pipelined
+        mode runs it as the executor's tail (EventSwitch is
+        lock-protected; subscribers already handle cross-thread fires
+        from the reactors)."""
+        if mark_trace:
+            self.trace.mark("snapshot_hook")
+        if self.post_apply_hook is not None and not self.replay_mode:
+            # snapshot production rides here: state_copy is the post-H
+            # state and the app just committed H
+            try:
+                self.post_apply_hook(state_copy, block)
+            except Exception:  # noqa: BLE001
+                self.logger.exception("post-apply hook failed at %d", height)
+        if mark_trace:
+            self.trace.mark("events")
+        if self.evsw is not None:
+            self.evsw.fire_event(tev.EVENT_NEW_BLOCK, tev.EventDataNewBlock(block))
+            self.evsw.fire_event(
+                tev.EVENT_NEW_BLOCK_HEADER, tev.EventDataNewBlockHeader(block.header)
+            )
+        event_cache.flush()
+
+    def _provisional_next_state(self, state_copy, block, block_parts):
+        """The H+1 state ASSUMING no EndBlock valset diffs (the common
+        case): last-block pointers advanced, accum rotated, app_hash
+        still H−1's (header H's claim — the applied hash arrives at the
+        join). A real diff is reconciled in _join_apply before any H+1
+        vote could have been verified against the provisional set."""
+        from tendermint_tpu.state.state import ABCIResponses
+
+        prov = state_copy.copy()
+        prov.set_block_and_validators(
+            block.header, block_parts.header(), ABCIResponses.for_block(block)
+        )
+        return prov
+
+    def _join_apply(self, reason: str) -> None:
+        """Block until the deferred apply of rs.height-1 lands, then swap
+        the applied state in. Called (consensus thread only) by every
+        H+1 step that reads app_hash/the applied valset — propose,
+        proposal verify, prevote validate, H+1 vote add, finalize. The
+        wait is the pipeline_join_wait_seconds histogram; apply runtime
+        minus the wait is the overlap the pipeline actually hid."""
+        if self._apply_poisoned is not None:
+            # a deferred apply failed earlier: consensus must stay
+            # wedged (the serial design's semantics — advancing on a
+            # stale app hash would fork from true execution)
+            raise RuntimeError(
+                "consensus halted: deferred apply failed"
+            ) from self._apply_poisoned
+        pending = self._pending_apply
+        if pending is None:
+            return
+        t0 = time.monotonic()
+        try:
+            applied, apply_s = pending.result()
+        except BaseException as exc:
+            # a failed apply means consensus cannot advance past H-1:
+            # surface it on the receive routine exactly where the serial
+            # design would have raised, and POISON every later join so
+            # the receive routine's catch-and-continue can't commit on
+            # the stale provisional state
+            self._pending_apply = None
+            self._apply_poisoned = exc
+            self.logger.error(
+                "deferred apply of height %d failed (join at %s)",
+                pending.height, reason,
+            )
+            raise
+        wait_s = time.monotonic() - t0
+        overlap_s = max(0.0, apply_s - wait_s)
+        self._pending_apply = None
+        self.pipeline_join_wait_last = wait_s
+        self.pipeline_overlap_last = overlap_s
+        hists = cpipeline.pipeline_hists()
+        hists["join_wait"].observe(wait_s)
+        hists["overlap"].observe(overlap_s)
+        self.trace.note("pipeline_join_wait_s", wait_s)
+
+        prov = self.state
+        self.state = applied
+        self._state_provisional = False
+        if applied.validators.hash() != prov.validators.hash():
+            # an EndBlock diff landed: the provisional set was wrong. No
+            # H+1 vote or proposal was verified against it (every such
+            # path joins first), so swapping the set and the empty vote
+            # book is a complete reconciliation.
+            self.pipeline_valset_reconciles += 1
+            rs = self.rs
+            rs.validators = applied.validators
+            rs.last_validators = applied.last_validators
+            fresh = HeightVoteSet(applied.chain_id, rs.height, applied.validators)
+            fresh.set_round(rs.round_ + 1)
+            rs.votes = fresh
+            self.logger.warning(
+                "pipelined apply of %d changed the validator set; "
+                "reconciled rs for height %d at %s",
+                pending.height, rs.height, reason,
+            )
 
     # -- proposals ---------------------------------------------------------
 
@@ -1104,6 +1384,8 @@ class ConsensusState(BaseService):
             return
         if proposal.pol_round != -1 and not (0 <= proposal.pol_round < proposal.round_):
             raise ValueError("invalid proposal POL round")
+        # proposer selection + signature verify need the APPLIED set
+        self._join_apply("set_proposal")
         proposer = rs.validators.get_proposer()
         sign_bytes = proposal.sign_bytes(self.state.chain_id)
         if proposal.signature is None or not self.verifier.verify_one(
@@ -1214,6 +1496,11 @@ class ConsensusState(BaseService):
             self.logger.debug("vote ignored: wrong height %d vs %d", vote.height, rs.height)
             return False
 
+        # a current-height vote verifies against rs.validators: join so
+        # the set (and rs.votes) is the applied one — this is what makes
+        # the provisional set crypto-invisible (no H+1 vote is ever
+        # checked against it)
+        self._join_apply("add_vote")
         added = rs.votes.add_vote(vote, peer_id, verifier=self.verifier.vote_verifier())
         if not added:
             return False

@@ -24,6 +24,17 @@ carries the height's device-vs-CPU split — a breaker-open height
 visibly attributes its verify/hash work to the CPU fallback (the chaos
 tier asserts this).
 
+Pipelined execution (round 14, docs/execution-pipeline.md): the deferred
+apply of height H runs on the executor thread WHILE this recorder traces
+height H+1, so the executor attributes its runtime to the height it
+overlaps via ``note_overlap(H+1, "overlap_apply_s", ...)`` — a locked
+side table (the lock-free single-writer rule holds for ``mark``/``note``;
+overlap notes are the one cross-thread writer and pay a lock). Overlay
+keys are aux attributions: reported, never summed into the partition —
+the consensus thread's segments still partition its own wall clock, and
+the join wait it actually pays surfaces as the ``pipeline_join_wait_s``
+aux note inside whichever segment blocked (normally propose).
+
 Completed traces land in a ring buffer (TENDERMINT_TRACE_RING, default
 128) served by the ``consensus_trace`` RPC and the operator CLI
 ``python -m tendermint_tpu.ops.trace``.
@@ -125,6 +136,12 @@ class TraceRecorder:
         # receive routine
         self._dev_carry: dict | None = None
         self._dev_start: dict = self._probe()
+        # cross-thread overlap attributions (round 14): the apply
+        # executor notes its runtime against the height it overlapped;
+        # notes landing before that height's begin() park in _ov_pending
+        self._ov_mtx = threading.Lock()
+        self._overlay: dict[str, float] = {}
+        self._ov_pending: dict[int, dict[str, float]] = {}
 
     def _probe(self) -> dict:
         if self._device_probe is None:
@@ -139,12 +156,22 @@ class TraceRecorder:
     def begin(self, height: int, now: float | None = None) -> None:
         """Start the clock for `height` (fresh segment table + device
         snapshot)."""
-        self._height = height
         self._segments = {}
         self._aux = {}
         self._rounds = 0
         self._cur = "new_height"
         self._last_t = now if now is not None else time.monotonic()
+        with self._ov_mtx:
+            # _height moves under the overlay lock so a concurrent
+            # note_overlap either parks in _ov_pending (and is adopted
+            # here) or lands in the fresh overlay — never in a dict this
+            # reset is about to discard
+            self._height = height
+            self._overlay = self._ov_pending.pop(height, {})
+            # drop stale parked overlays (a restart/fast-sync jump can
+            # strand entries below the new height forever otherwise)
+            for h in [h for h in self._ov_pending if h < height]:
+                del self._ov_pending[h]
         if self._dev_carry is not None:
             self._dev_start, self._dev_carry = self._dev_carry, None
         else:
@@ -168,11 +195,28 @@ class TraceRecorder:
     def note_round(self, round_: int) -> None:
         self._rounds = max(self._rounds, round_ + 1)
 
+    def note_overlap(self, height: int, key: str, seconds: float) -> None:
+        """Cross-thread aux attribution (round 14): the apply executor
+        credits work to the height it OVERLAPPED (apply of H runs under
+        consensus of H+1). Notes for a height not yet begun park until
+        its begin(); notes for an already-sealed height are dropped —
+        attribution must never resurrect a published trace."""
+        with self._ov_mtx:
+            if height == self._height:
+                self._overlay[key] = self._overlay.get(key, 0.0) + seconds
+            elif height > self._height:
+                d = self._ov_pending.setdefault(height, {})
+                d[key] = d.get(key, 0.0) + seconds
+
     def finish(self, height: int, wall_s: float,
                now: float | None = None) -> HeightTrace:
         """Seal the active trace (closing the open segment at `now`) and
         push it onto the ring."""
         self.mark("done", now=now)
+        with self._ov_mtx:
+            overlay, self._overlay = self._overlay, {}
+        for k, v in overlay.items():
+            self._aux[k] = self._aux.get(k, 0.0) + v
         end = self._probe()
         self._dev_carry = end  # the next begin() starts from this reading
         start = self._dev_start

@@ -28,7 +28,9 @@ Canonical plane prefixes (full catalog: docs/observability.md):
 plus the process-wide instruments the default registry carries
 (devd_stream_chunk_seconds / devd_single_shot_seconds histograms,
 wal_fsync_seconds / wal_group_records, mempool_sig_gate_batch_seconds,
-gateway_hash_batch_seconds, faults_*, p2p_secretconn_* transport
+gateway_hash_batch_seconds, the round-14 execution-pipeline histograms
+consensus_height_seconds / pipeline_join_wait_seconds /
+pipeline_overlap_seconds, faults_*, p2p_secretconn_* transport
 counters, netfaults_* network-chaos aggregates).
 
 ``legacy=True`` producers make up the byte-compatible metrics-RPC dict;
@@ -52,11 +54,13 @@ def build_registry(node) -> telemetry.Registry:
     # and the faults_* producer only once ops/faults is imported (it
     # registers itself at import)
     from tendermint_tpu import devd
+    from tendermint_tpu.consensus import pipeline as cpipeline
     from tendermint_tpu.ops import faults  # noqa: F401 — import = register
     from tendermint_tpu.p2p import secret_connection
 
     devd._latency_hists()
     secret_connection._counters()
+    cpipeline.pipeline_hists()
 
     reg = telemetry.Registry(parent=telemetry.default_registry())
     cs = node.consensus_state
@@ -72,6 +76,15 @@ def build_registry(node) -> telemetry.Registry:
             "height_seconds_last": round(cs.height_seconds_last, 3),
             "height_seconds_max": round(cs.height_seconds_max, 3),
             "peer_msg_drops": cs.peer_msg_drops,
+            # pipelined execution plane (round 14): deferred applies
+            # taken, the last join wait the consensus thread paid, and
+            # the last apply span hidden under the next height (full
+            # distributions: the pipeline_join_wait_seconds /
+            # pipeline_overlap_seconds histograms on GET /metrics)
+            "pipeline_applies": cs.pipeline_applies,
+            "pipeline_serial_commits": cs.pipeline_serial_commits,
+            "pipeline_join_wait_seconds": round(cs.pipeline_join_wait_last, 6),
+            "pipeline_overlap_seconds": round(cs.pipeline_overlap_last, 6),
         }
 
     reg.register_producer("consensus", consensus)

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import logging
 import os
+import queue
 import random
 import threading
 import time
@@ -1062,6 +1063,31 @@ def device_rtt_ms() -> float | None:
 HASH_RTT_MS_MAX = 5.0
 
 
+class _HashFuture:
+    """Join handle for a submitted-early hash job (round 14). result()
+    re-raises the worker-side exception; callers on the hot path catch
+    and fall back to the inline compute."""
+
+    __slots__ = ("_evt", "_value", "_exc")
+
+    def __init__(self):
+        self._evt = threading.Event()
+        self._value = None
+        self._exc: BaseException | None = None
+
+    def _finish(self, value=None, exc: BaseException | None = None) -> None:
+        self._value = value
+        self._exc = exc
+        self._evt.set()
+
+    def result(self, timeout: float | None = None):
+        if not self._evt.wait(timeout):
+            raise TimeoutError("hash submission did not complete")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
 class Hasher:
     """Batched hashing gateway for the PartSet/tx-tree hot paths.
 
@@ -1145,6 +1171,11 @@ class Hasher:
             # tx-root cache (mempool -> proposal path): reproposals and
             # gossip re-validation of an unchanged tx set never rehash
             "tx_root_cache_hits": 0,
+            # round 14: submitted-early futures (pipelined proposal
+            # build) — jobs queued to the submit worker, and how many
+            # txs_hash() calls JOINED an in-flight early submission
+            # instead of recomputing
+            "submitted_jobs": 0, "tx_root_prehash_joins": 0,
             # streamed hash transport gauges, ALWAYS present (zeros off
             # the devd route) so the metrics RPC exports a stable gauge
             # set — flat numerics, same contract as Verifier's stream_*
@@ -1160,6 +1191,15 @@ class Hasher:
         # repropose/re-validate window is a handful of recent sets
         self._tx_roots: OrderedDict[tuple, bytes] = OrderedDict()
         self._tx_roots_cap = 16
+        # round 14 (pipelined execution): submitted-early hash futures.
+        # One daemon worker serializes submissions (the streamed devd
+        # client is pooled but ordering keeps the batch-shape gauges
+        # meaningful); in-flight tx roots dedupe so the consensus
+        # thread's later txs_hash() JOINS the early submission instead
+        # of re-hashing beside it.
+        self._submit_q: "queue.Queue | None" = None
+        self._submit_thread: threading.Thread | None = None
+        self._inflight_tx_roots: dict[tuple, _HashFuture] = {}
         # round 11: full distribution behind batch_ms_last/_avg (one
         # observe per offload batch; scrape-only via GET /metrics)
         from tendermint_tpu.libs import telemetry
@@ -1315,13 +1355,91 @@ class Hasher:
             self._demote_after_failure()
             return None
 
+    # -- submitted-early futures (round 14, pipelined proposal build) -----
+
+    def _submit(self, fn) -> _HashFuture:
+        """Queue `fn` on the single daemon submit worker; returns the
+        join handle. The worker is lazy: processes that never submit
+        (most tests, the verify-only planes) pay nothing."""
+        fut = _HashFuture()
+        with self._mtx:
+            if self._submit_q is None:
+                self._submit_q = queue.Queue()
+                self._submit_thread = threading.Thread(
+                    target=self._submit_loop, daemon=True,
+                    name="gw.hashSubmit",
+                )
+                self._submit_thread.start()
+            self._stats["submitted_jobs"] += 1
+            q = self._submit_q
+        q.put((fut, fn))
+        return fut
+
+    def _submit_loop(self) -> None:
+        while True:
+            fut, fn = self._submit_q.get()
+            try:
+                fut._finish(value=fn())
+            except BaseException as exc:  # noqa: BLE001 — joined by caller
+                fut._finish(exc=exc)
+
+    def submit_tx_root(self, txs: list[bytes]) -> _HashFuture:
+        """Start hashing the tx root NOW (streamed devd plane / AVX /
+        CPU ladder) and return a future; a later tx_merkle_root() on the
+        same tx set joins the in-flight job instead of recomputing.
+        consensus/state.create_proposal_block submits right after the
+        mempool reap so the root hashes while the commit/evidence/header
+        assemble."""
+        key = tuple(txs)
+        done = _HashFuture()
+        with self._mtx:
+            cached = self._tx_roots.get(key)
+            if cached is not None:
+                self._tx_roots.move_to_end(key)
+                done._finish(value=cached)
+                return done
+            fut = self._inflight_tx_roots.get(key)
+            if fut is not None:
+                return fut
+            fut = _HashFuture()
+            self._inflight_tx_roots[key] = fut
+
+        def work():
+            try:
+                root = self._tx_merkle_root_uncached(txs)
+            except BaseException as exc:  # noqa: BLE001 — joined by caller
+                with self._mtx:
+                    self._inflight_tx_roots.pop(key, None)
+                fut._finish(exc=exc)
+                return
+            with self._mtx:
+                # resolve BEFORE clearing in-flight: a joiner either sees
+                # the in-flight future (and gets this root) or the LRU
+                self._tx_roots[key] = root
+                while len(self._tx_roots) > self._tx_roots_cap:
+                    self._tx_roots.popitem(last=False)
+            fut._finish(value=root)
+            with self._mtx:
+                self._inflight_tx_roots.pop(key, None)
+
+        self._submit(work)
+        return fut
+
+    def submit_part_set_tree(self, chunks: list[bytes]) -> _HashFuture:
+        """part_set_tree as a future: the devd/AVX round trip overlaps
+        the caller's Part-object construction (types/part_set.py joins
+        before building proofs). Resolves to (digests, FlatTree) or None
+        exactly like part_set_tree."""
+        return self._submit(lambda: self.part_set_tree(chunks))
+
     def tx_merkle_root(self, txs: list[bytes]) -> bytes:
         """Txs.Hash — the tx-tree root (types/tx.go:33-46), batched when
         wide enough. Injected into types/tx via set_batch_tx_root at node
         assembly so every block build/validate rides it. Roots are
         memoized per tx set (small LRU): the mempool -> proposal path
         recomputes the same root on repropose, block re-validation, and
-        gossip receipt — those now cost one dict lookup, no rehash."""
+        gossip receipt — those now cost one dict lookup, no rehash. A
+        root submitted early (submit_tx_root) is JOINED, not recomputed."""
         key = tuple(txs)
         with self._mtx:
             cached = self._tx_roots.get(key)
@@ -1329,6 +1447,17 @@ class Hasher:
                 self._tx_roots.move_to_end(key)
                 self._stats["tx_root_cache_hits"] += 1
                 return cached
+            fut = self._inflight_tx_roots.get(key)
+        if fut is not None:
+            try:
+                root = fut.result(timeout=120)
+                with self._mtx:
+                    self._stats["tx_root_prehash_joins"] += 1
+                return root
+            except Exception:
+                logger.exception(
+                    "early tx-root submission failed; recomputing inline"
+                )
         root = self._tx_merkle_root_uncached(txs)
         with self._mtx:
             self._tx_roots[key] = root

@@ -64,7 +64,24 @@ def render(traces: list[dict], out=sys.stdout) -> None:
             frac = (v / wall) if wall > 0 else 0.0
             print(f"  {name:<14} {v:>9.4f}s  {_bar(frac)} {frac * 100:5.1f}%",
                   file=out)
-        for k, v in sorted(t.get("aux", {}).items()):
+        aux = t.get("aux", {})
+        if "overlap_apply_s" in aux:
+            # pipelined execution (round 14): the deferred apply of the
+            # PREVIOUS height ran against this height's propose/prevote
+            # segments — split it into the part consensus never waited
+            # for (hidden) vs the join wait it actually paid (idle)
+            apply_s = aux["overlap_apply_s"]
+            wait_s = aux.get("pipeline_join_wait_s", 0.0)
+            hidden = max(0.0, apply_s - wait_s)
+            frac = (hidden / apply_s) if apply_s > 0 else 0.0
+            print(
+                f"  = apply(H-1)   {apply_s:>9.4f}s  {_bar(frac)} "
+                f"{frac * 100:5.1f}% hidden / {wait_s:.4f}s join wait",
+                file=out,
+            )
+        for k, v in sorted(aux.items()):
+            if k == "overlap_apply_s":
+                continue  # rendered as the split line above
             print(f"  ~ {k:<12} {v:>9.4f}s  (overlaps segments)", file=out)
         vt, vc = dev.get("verify_tpu_sigs", 0), dev.get("verify_cpu_sigs", 0)
         ht, hc = dev.get("hash_tpu_leaves", 0), dev.get("hash_cpu_leaves", 0)

@@ -28,6 +28,9 @@ class AppConnConsensus:
     def deliver_tx_async(self, tx: bytes) -> ReqRes:
         return self._client.deliver_tx_async(tx)
 
+    def deliver_txs_async(self, txs: list[bytes]) -> list[ReqRes]:
+        return self._client.deliver_txs_async(txs)
+
     def end_block_sync(self, height: int):
         return self._client.end_block_sync(height)
 

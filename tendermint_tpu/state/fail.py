@@ -21,10 +21,29 @@ production process pays one env lookup:
     * rotate_crash + FAIL_TEST_ROTATE_INDEX=k + FAIL_TEST_ROTATE_PHASE=
       pre|post: die immediately before / after the k-th chunk rotation's
       os.replace, covering the half-flushed rotation boundary.
+    * pipeline + FAIL_TEST_PIPELINE_POINT=name [+ FAIL_TEST_PIPELINE_HITS=k]:
+      the round-14 execution-pipeline tier (docs/execution-pipeline.md) —
+      die at the k-th (default first) hit of the NAMED stage boundary:
+        pre_apply           on the apply-executor thread, after the block
+                            save + WAL #ENDHEIGHT landed but before the
+                            deferred apply touched the app — the "marker
+                            precedes a crashed apply" image;
+        mid_parallel_apply  inside the kvstore sharded deliver_tx, after
+                            the shard workers folded their ops but before
+                            the deterministic merge mutates the app;
+        post_apply          after sm.apply_block completed (state saved at
+                            H) but before the snapshot hook/events fired.
 
-All counters (fail-point index, WAL byte position, rotation count) are
-guarded by one lock; `reset()` clears every counter under that same lock
-so it can never race a concurrent `fail_point()`/`wal_write()` caller.
+FAIL_TEST_INDEX keeps its original SERIAL crash model: when it is armed,
+consensus runs finalize_commit serially (ConsensusState._pipeline_enabled)
+so the i-th fail_point() hit stays a deterministic, single-thread count —
+the pipeline's cross-thread boundaries are covered by the named
+pipeline_point() tier above instead.
+
+All counters (fail-point index, WAL byte position, rotation count,
+per-name pipeline hits) are guarded by one lock; `reset()` clears every
+counter under that same lock so it can never race a concurrent
+`fail_point()`/`wal_write()` caller.
 """
 
 from __future__ import annotations
@@ -35,6 +54,7 @@ import threading
 _counter = 0
 _wal_bytes = 0
 _rotations = 0
+_pipeline_hits: dict = {}
 _mtx = threading.Lock()
 
 EXIT_CODE = 99  # what the harnesses assert on: "died at the fail point"
@@ -98,9 +118,29 @@ def rotate_point(phase: str) -> None:
         os._exit(EXIT_CODE)
 
 
+def pipeline_point(name: str) -> None:
+    """Execution-pipeline stage boundary (round 14). Armed by
+    FAIL_TEST_MODE=pipeline + FAIL_TEST_PIPELINE_POINT=<name>; the
+    optional FAIL_TEST_PIPELINE_HITS=k dies at the k-th hit (0-based,
+    default 0) so a mid-chain boundary can be targeted too. Unlike
+    fail_point(), hits count PER NAME — the boundaries live on different
+    threads and a shared index would be racy by construction."""
+    if os.environ.get("FAIL_TEST_MODE") != "pipeline":
+        return
+    if name != os.environ.get("FAIL_TEST_PIPELINE_POINT"):
+        return
+    target = int(os.environ.get("FAIL_TEST_PIPELINE_HITS", "0"))
+    with _mtx:
+        idx = _pipeline_hits.get(name, 0)
+        _pipeline_hits[name] = idx + 1
+    if idx == target:
+        os._exit(EXIT_CODE)
+
+
 def reset() -> None:
     global _counter, _wal_bytes, _rotations
     with _mtx:
         _counter = 0
         _wal_bytes = 0
         _rotations = 0
+        _pipeline_hits.clear()

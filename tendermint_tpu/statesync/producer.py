@@ -8,6 +8,21 @@ observe exactly height H. The in-process apps serialize in microseconds
 to low milliseconds at test scales; a deployment whose app state is
 huge raises snapshot_interval, it does not move the hook.
 
+Round 14 (pipelined execution, docs/execution-pipeline.md): under the
+pipelined finalize the hook fires from the APPLY EXECUTOR thread, not
+the consensus receive routine — and the quiesce guarantee holds by the
+executor's ordering: apply(H+1), the only source of the next DeliverTx,
+is queued behind this hook on the same single worker. Executor-thread
+audit: `state` is the executor-local post-H copy; the block store is
+lock-protected and block H was saved BEFORE the apply was submitted (the
+stage-1 ordering invariant), so host_sections can always serve H; the
+gateway hasher and the SnapshotStore take their own locks. Concurrent
+mempool CheckTx against app.snapshot() predates the pipeline (CheckTx
+never ran on the consensus thread either) and is read-only in the
+kvstore family. The NEVER-RAISES contract of maybe_snapshot is what
+keeps a producer failure from wedging the executor — and therefore the
+join — regression-tested in tests/test_pipeline.py.
+
 Round 13 (format 2, docs/state-tree.md):
 
 - The node-local SEEN commit moved OUT of the digested payload into the

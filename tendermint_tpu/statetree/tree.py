@@ -175,13 +175,18 @@ class VersionedTree:
 
     # -- writes (staging; visible at the next commit) ------------------------
 
-    def set(self, key: bytes, value: bytes) -> None:
+    def set(self, key: bytes, value: bytes, prio: bytes | None = None) -> None:
+        """`prio`, when given, MUST equal key_priority(key) — it lets a
+        batch caller (the round-14 sharded kvstore apply) precompute the
+        priorities through the gateway's batched RIPEMD plane instead of
+        one hashlib call per new key; the shape (and therefore the root)
+        is byte-identical by construction."""
         if not isinstance(key, bytes) or not isinstance(value, bytes):
             raise TypeError("tree keys and values are bytes")
         with self._mtx:
             self._stats["sets"] += 1
             self._pending.add(key)
-            self._root = self._insert(self._root, key, value)
+            self._root = self._insert(self._root, key, value, prio=prio)
 
     def delete(self, key: bytes) -> bool:
         with self._mtx:
@@ -201,7 +206,8 @@ class VersionedTree:
         self._stats["nodes_created"] += 1
         return _copy(node)
 
-    def _insert(self, root: _Node | None, key: bytes, value: bytes) -> _Node:
+    def _insert(self, root: _Node | None, key: bytes, value: bytes,
+                prio: bytes | None = None) -> _Node:
         # iterative COW descent: copy every node on the search path
         path: list[tuple[_Node, int]] = []  # (fresh copy, side taken: 0/1)
         node = root
@@ -214,7 +220,11 @@ class VersionedTree:
             # value replacement: same key, same priority, same shape
             cur = self._new_node(key, value, node.prio, node.left, node.right)
         else:
-            cur = self._new_node(key, value, key_priority(key), None, None)
+            cur = self._new_node(
+                key, value,
+                prio if prio is not None else key_priority(key),
+                None, None,
+            )
             self._size += 1
         # link upward; a NEW node bubbles up by rotation while its
         # priority beats its parent's (treap heap repair)

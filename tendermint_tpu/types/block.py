@@ -315,6 +315,7 @@ class Block:
         time_ns: int | None = None,
         part_hasher=None,
         part_tree_hasher=None,
+        part_tree_submitter=None,
         evidence=None,
     ) -> tuple["Block", PartSet]:
         """MakeBlock equivalent (types/block.go:26-44): block + its part set.
@@ -339,7 +340,8 @@ class Block:
         block = cls(header, Data(txs=list(txs)), commit, evidence=evidence)
         block.fill_header()
         return block, block.make_part_set(
-            part_size, hasher=part_hasher, tree_hasher=part_tree_hasher
+            part_size, hasher=part_hasher, tree_hasher=part_tree_hasher,
+            tree_submitter=part_tree_submitter,
         )
 
     def fill_header(self) -> None:
@@ -360,9 +362,10 @@ class Block:
         return len(h) > 0 and self.hash() == h
 
     def make_part_set(self, part_size: int, hasher=None,
-                      tree_hasher=None) -> PartSet:
+                      tree_hasher=None, tree_submitter=None) -> PartSet:
         return PartSet.from_data(
-            self.to_bytes(), part_size, hasher=hasher, tree_hasher=tree_hasher
+            self.to_bytes(), part_size, hasher=hasher,
+            tree_hasher=tree_hasher, tree_submitter=tree_submitter,
         )
 
     def validate_basic(

@@ -82,7 +82,8 @@ class PartSet:
 
     @classmethod
     def from_data(
-        cls, data: bytes, part_size: int, hasher=None, tree_hasher=None
+        cls, data: bytes, part_size: int, hasher=None, tree_hasher=None,
+        tree_submitter=None,
     ) -> "PartSet":
         """Split + build Merkle proofs (NewPartSetFromData,
         types/part_set.go:95-122). `hasher` optionally supplies batched leaf
@@ -92,11 +93,45 @@ class PartSet:
         pass — the devd hash_stream tree frame — making the proofs free
         here; returning None falls through to the host path. Either way
         proofs are shared-aunt views over one flat node buffer,
-        byte-identical to the recursive reference."""
+        byte-identical to the recursive reference.
+
+        `tree_submitter` (round 14, ops/gateway.Hasher.submit_part_set_tree)
+        is the FUTURE form of tree_hasher: the chunk batch is on the hash
+        plane while this thread allocates the Part shells, and the future
+        joins only when the proofs are actually needed — the pipelined
+        proposal build's part-hash overlap. A failed submission falls
+        through to the inline ladder; digests are identical either way."""
         total = max((len(data) + part_size - 1) // part_size, 1)
         chunks = [data[i * part_size : (i + 1) * part_size] for i in range(total)]
         leaf_hashes = tree = None
-        if tree_hasher is not None:
+        fut = None
+        if tree_submitter is not None:
+            try:
+                fut = tree_submitter(chunks)
+            except Exception:
+                fut = None  # submission is an accelerator, never a gate
+        if fut is not None:
+            # overlapped host work: the set shell + part list allocate
+            # while the hash plane rounds the chunk batch
+            shell_parts = [
+                Part(index=i, bytes_=c) for i, c in enumerate(chunks)
+            ]
+            try:
+                built = fut.result(timeout=120)
+            except Exception:
+                built = None
+            if built is not None:
+                leaf_hashes, tree = built
+                root, proofs = tree.root(), tree.proofs()
+                ps = cls(total, root)
+                for i, part in enumerate(shell_parts):
+                    part.proof = proofs[i]
+                    part._hash = leaf_hashes[i]
+                    ps._parts[i] = part
+                    ps._bit_array.set_index(i, True)
+                ps._count = total
+                return ps
+        if leaf_hashes is None and tree_hasher is not None:
             built = tree_hasher(chunks)
             if built is not None:
                 leaf_hashes, tree = built

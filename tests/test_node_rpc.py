@@ -248,6 +248,10 @@ METRICS_REQUIRED_KEYS = (
     "consensus_height", "consensus_round", "consensus_step",
     "consensus_height_seconds_last", "consensus_height_seconds_max",
     "consensus_peer_msg_drops",
+    # pipelined execution plane (round 14)
+    "consensus_pipeline_applies",
+    "consensus_pipeline_join_wait_seconds",
+    "consensus_pipeline_overlap_seconds",
     # block store
     "blockstore_height", "blockstore_base",
     # WAL durability plane (present once consensus started)
@@ -321,7 +325,10 @@ def test_prometheus_exposition_endpoint(node):
     # the latency-distribution instruments render as real histograms
     for fam in ("devd_stream_chunk_seconds", "devd_single_shot_seconds",
                 "wal_fsync_seconds", "wal_group_records",
-                "gateway_hash_batch_seconds"):
+                "gateway_hash_batch_seconds",
+                # round 14: the execution-pipeline distributions
+                "consensus_height_seconds", "pipeline_join_wait_seconds",
+                "pipeline_overlap_seconds"):
         assert families.get(fam) == "histogram", fam
     # a live node has fsynced (group commit): the histogram has samples
     count = next(
@@ -344,9 +351,17 @@ def test_consensus_trace_rpc_segments_sum_to_wall(node, client):
         total = sum(t["segments"].values())
         tol = max(0.05 * t["wall_s"], 0.005)  # floor for sub-ms heights
         assert abs(total - t["wall_s"]) <= tol, (total, t["wall_s"])
-        # the commit machinery segments exist on every committed height
-        for seg in ("commit", "block_save", "apply"):
+        # the commit machinery segments exist on every committed height.
+        # Round 14: with the pipelined execution plane (the default) the
+        # apply runs on the executor and is attributed to the height it
+        # OVERLAPS as the overlap_apply_s aux note — the lowest traced
+        # height carries neither (its apply credited to its successor)
+        for seg in ("commit", "block_save"):
             assert seg in t["segments"], t["segments"]
+        if t["height"] > min(heights):
+            assert (
+                "apply" in t["segments"] or "overlap_apply_s" in t["aux"]
+            ), t
         dev = t["device"]
         for k in ("verify_tpu_sigs", "verify_cpu_sigs",
                   "hash_tpu_leaves", "hash_cpu_leaves"):

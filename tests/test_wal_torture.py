@@ -169,6 +169,90 @@ def test_torn_write_sampled_offsets(tmp_path):
     assert set(outcomes.values()) == {"recovered"}, outcomes
 
 
+# -- round 14: execution-pipeline stage boundaries ---------------------------
+#
+# The pipelined finalize (docs/execution-pipeline.md) writes the block +
+# WAL #ENDHEIGHT SYNCHRONOUSLY, then defers apply/hook/events to the
+# executor thread. The named pipeline_point() crash tier (state/fail.py)
+# dies exactly at the new stage boundaries; restart must recover via the
+# same WAL repair + handshake + replay path, with every pre-crash height
+# byte-identical — the "marker precedes a crashed deferred apply" image
+# is the handshake's store==state+1 case.
+
+
+def test_pipeline_crash_before_deferred_apply(tmp_path):
+    """Die on the executor thread AFTER block save + #ENDHEIGHT landed
+    but BEFORE the deferred apply touched the app (the third pipelined
+    height, so recovered history spans applied AND unapplied heights)."""
+    tag = torture_cycle(
+        tmp_path, "pipe-pre-apply",
+        {
+            "FAIL_TEST_MODE": "pipeline",
+            "FAIL_TEST_PIPELINE_POINT": "pre_apply",
+            "FAIL_TEST_PIPELINE_HITS": 2,
+        },
+    )
+    assert tag == "recovered", tag
+
+
+def test_pipeline_crash_mid_parallel_apply(tmp_path):
+    """Die INSIDE the sharded kvstore apply — after the shard workers
+    folded, before the deterministic merge mutates the app. Needs a
+    multi-tx block, so this cycle injects a burst while the point is
+    armed (the shared torture_cycle only waits for the crash)."""
+    home = str(tmp_path / "pipe-mid")
+    init_node_home(home, "torture-pipe-mid")
+    port = free_port()
+    proc = node_proc(home, port, extra_env={
+        "FAIL_TEST_MODE": "pipeline",
+        "FAIL_TEST_PIPELINE_POINT": "mid_parallel_apply",
+        "TENDERMINT_KVSTORE_SHARDS": 2,
+        "TENDERMINT_KVSTORE_SHARD_MIN": 2,
+    })
+    try:
+        assert wait_height(port, 1, CYCLE_DEADLINE_S) >= 1
+        # burst of async txs: the first block carrying >= 2 of them takes
+        # the sharded path and dies at the armed point
+        deadline = time.time() + CYCLE_DEADLINE_S
+        i = 0
+        while proc.poll() is None and time.time() < deadline:
+            try:
+                rpc(port, "broadcast_tx_async", timeout=2,
+                    tx=f"burst{i}={i}".encode().hex())
+            except Exception:
+                pass  # the process may die mid-request — that's the point
+            i += 1
+            time.sleep(0.02)
+        rc = proc.poll()
+        assert rc == 99, f"expected mid-parallel-apply crash exit 99, got {rc}"
+    finally:
+        if proc.poll() is None:
+            _stop(proc)
+        elif proc.stdout:
+            proc.stdout.close()
+
+    pre = _store_fingerprints(home)
+    assert pre, "crash landed before any committed height"
+    h_sync = _wal_last_synced_endheight(home)
+    assert max(pre, default=0) >= h_sync
+
+    port = free_port()
+    proc = node_proc(home, port)
+    try:
+        target = max(pre, default=0) + 1
+        assert wait_height(port, target, CYCLE_DEADLINE_S) >= target
+        res = rpc(port, "broadcast_tx_commit", timeout=30,
+                  tx=b"post-crash=1".hex())
+        assert res["deliver_tx"]["code"] == 0, res
+    finally:
+        _stop(proc)
+    post = _store_fingerprints(home)
+    for height, fp in pre.items():
+        assert post[height] == fp, (
+            f"height {height} rewritten after mid-parallel-apply recovery"
+        )
+
+
 def _rotation_cycle(tmp_path, phase: str) -> None:
     tag = torture_cycle(
         tmp_path, f"rot-{phase}",
