@@ -24,7 +24,7 @@ test:
 # hold (dots no worse than the seed) — plus the chip-free hash-stream
 # smoke (the two asserted BENCH_r07 rows: streamed hash offload >= 1.3x
 # single-shot on the sim transport, flat host builder >= 1.5x recursive).
-tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke pipeline-smoke
+tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke pipeline-smoke fleet-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Chip-free bench smoke: every BASELINE config on the pinned CPU backend,
@@ -97,6 +97,17 @@ net-chaos-smoke:
 pipeline-smoke:
 	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_PIPELINE_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_pipeline.py
 
+# Fleet observability smoke, chip-free (~40 s): bench_fleet.py's reduced
+# pass — a 4-node real-TCP net scraped by ops/fleet (GET /metrics +
+# consensus_trace + GET /health only): per-height cross-node timeline
+# reconstructed (propagation lag / quorum-formation time / commit skew),
+# the partition arm detected and healed purely off /health, and the
+# round-15 per-peer instrumentation overhead bounded <2% à la BENCH_r11
+# (the full scenario matrix lives in tests/test_netchaos.py). Runs as
+# part of `make tier1`.
+fleet-smoke:
+	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_FLEET_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_fleet.py
+
 # Telemetry smoke, chip-free (~20 s): bench_telemetry.py's reduced pass —
 # boot a node, scrape GET /metrics (valid 0.0.4 text, >= 40 families
 # spanning every plane), pull one consensus_trace (segments sum to the
@@ -118,4 +129,4 @@ test_slow:
 native:
 	$(MAKE) -C native
 
-.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke pipeline-smoke
+.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke pipeline-smoke fleet-smoke

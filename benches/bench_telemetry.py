@@ -117,6 +117,16 @@ def _gate_burst_once(txs, want: int) -> tuple[float, int]:
     return elapsed, batcher._batch_hist.count - observes0
 
 
+def per_event_cost_ns(observe_row: dict) -> float:
+    """The 3x-margined worst-case cost of one instrument event: the
+    slower of the bare/labeled observe micro-measurements, tripled, +
+    ~200ns for the perf_counter reads bracketing it. Shared by every
+    computed-bound overhead guard (this gate + bench_fleet's p2p bound)
+    so the two records never drift onto different cost models."""
+    return 3.0 * max(observe_row["observe_ns"],
+                     observe_row["observe_labeled_child_ns"]) + 200.0
+
+
 def bench_gate_overhead(observe_row: dict) -> dict:
     """The histogram-overhead guard (module docstring has the method):
     asserted bound = events x 3x-margined per-event cost / wall time;
@@ -149,11 +159,7 @@ def bench_gate_overhead(observe_row: dict) -> dict:
             else:
                 off_s = min(off_s, t)
     assert observes >= 1, "instrumented burst recorded no observes"
-    # worst-case per-event cost: the slower of the bare/labeled observe
-    # micro-measurements, tripled for margin, + ~200ns for the two
-    # perf_counter reads bracketing each observe
-    per_event_ns = 3.0 * max(observe_row["observe_ns"],
-                             observe_row["observe_labeled_child_ns"]) + 200.0
+    per_event_ns = per_event_cost_ns(observe_row)
     overhead_pct = observes * per_event_ns / (on_s * 1e9) * 100.0
     raw_delta_pct = (on_s - off_s) / off_s * 100.0
     row = {

@@ -47,6 +47,13 @@ Configs (BASELINE.md):
                   gateway sig batch per block + sharded kv fold); byte-
                   identity of all chains asserted (writes BENCH_r14.json;
                   chip-free)
+ 15 fleet        — fleet observability plane: 4-node real-TCP net scraped
+                  by ops/fleet (GET /metrics + consensus_trace +
+                  GET /health only) — cross-node timeline reconstructed
+                  (propagation lag / quorum time / commit skew), the
+                  partition arm detected+healed off /health, per-peer
+                  instrumentation overhead bounded <2% (writes
+                  BENCH_r15.json; chip-free)
  13 statetree    — authenticated app-state commitment: incremental
                   commit vs full tree rebuild, proof correctness rows,
                   delta-vs-full snapshot bytes (delta asserted <= 0.5x
@@ -84,6 +91,7 @@ BENCHES = {
     "12_netchaos": [sys.executable, "benches/bench_netchaos.py"],
     "13_statetree": [sys.executable, "benches/bench_statetree.py"],
     "14_pipeline": [sys.executable, "benches/bench_pipeline.py"],
+    "15_fleet": [sys.executable, "benches/bench_fleet.py"],
 }
 
 

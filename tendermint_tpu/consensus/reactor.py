@@ -69,6 +69,14 @@ class PeerRoundState:
         self.catchup_commit: BitArray | None = None
 
 
+def _peer_label(peer) -> str:
+    """Best-effort peer id for metric labels ("?" for harness stubs)."""
+    try:
+        return peer.id()
+    except Exception:  # noqa: BLE001 — labels must never break gossip
+        return "?"
+
+
 class PeerState:
     """Thread-safe mirror + vote bookkeeping for one peer
     (reactor.go:778-1060)."""
@@ -77,6 +85,19 @@ class PeerState:
         self.peer = peer
         self.prs = PeerRoundState()
         self._mtx = threading.RLock()
+        # per-peer gossip instrumentation (round 15): child series
+        # resolved once — picks vs successful sends is the signal that
+        # would have caught the PR-13 pick-marks-before-send wedge
+        from tendermint_tpu.p2p.telemetry import peer_metrics
+
+        fams = peer_metrics(getattr(peer, "metrics_registry", None))
+        pid = _peer_label(peer)
+        self.m_vote_picks = fams["vote_gossip_picks"].labels(peer=pid)
+        self.m_vote_sends = fams["vote_gossip_sends"].labels(peer=pid)
+        self.m_vote_send_failures = fams["vote_gossip_send_failures"].labels(
+            peer=pid
+        )
+        self.m_catchup_commits = fams["catchup_commits"].labels(peer=pid)
 
     # -- reads -------------------------------------------------------------
 
@@ -177,6 +198,7 @@ class PeerState:
             if prs.catchup_commit_round == round_:
                 return
             prs.catchup_commit_round = round_
+            self.m_catchup_commits.inc()
             # alias the live precommit array only when it EXISTS; a
             # far-behind peer's mirror has none at its own height, and
             # aliasing None here left the catchup picker with no
@@ -652,12 +674,17 @@ class ConsensusReactor(Reactor, BaseService):
     def _send_vote(self, peer, ps: PeerState, vote) -> bool:
         """Send one vote and, ONLY on success, mark the peer as having
         it (the vote carries its own coordinates). A failed send leaves
-        the bit clear so the gossip loop retries it later."""
+        the bit clear so the gossip loop retries it later — and counts
+        on the per-peer failure series, so a wedge shows up as picks
+        outrunning sends instead of a frozen height vector."""
+        ps.m_vote_picks.inc()
         if peer.send(VOTE_CHANNEL, _enc(msgs.VoteMessage(vote))):
             ps.set_has_vote(
                 vote.height, vote.round_, vote.type_, vote.validator_index
             )
+            ps.m_vote_sends.inc()
             return True
+        ps.m_vote_send_failures.inc()
         return False
 
     def _pick_and_send_vote(self, peer, ps: PeerState, rs, prs: PeerRoundState) -> bool:

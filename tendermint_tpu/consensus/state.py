@@ -189,6 +189,17 @@ class ConsensusState(BaseService):
     def get_round_state(self) -> RoundState:
         return self.rs  # single-writer; readers treat as snapshot
 
+    def height_age_s(self) -> float:
+        """Seconds since the current height opened — the liveness signal
+        the health plane (node/health.py) gates on: a stalled chain is a
+        growing age, a healthy one resets every commit."""
+        return time.monotonic() - self._height_started
+
+    def pipeline_poisoned(self) -> bool:
+        """True once a deferred apply failed — the node is wedged at the
+        join and the health plane must report FAILING."""
+        return self._apply_poisoned is not None
+
     def _trace_device_probe(self) -> dict:
         """Gateway counter snapshot for per-height device attribution
         (consensus/trace.py): how many verify sigs / hash leaves this
@@ -1113,6 +1124,10 @@ class ConsensusState(BaseService):
             "finalizing commit of block %d: hash=%s txs=%d",
             height, block.hash().hex()[:12], block.header.num_txs,
         )
+        # gossip arrival mark (round 15): commit receipt — quorum AND the
+        # full block are in hand; the fleet aggregator reads commit skew
+        # off this instant across nodes
+        self.trace.mark_arrival("commit")
         # trace: the commit-wait segment ends here; the finalize
         # sub-phases (save -> apply -> snapshot hook -> events, or
         # save -> submit when pipelined) partition the rest of the
@@ -1397,6 +1412,7 @@ class ConsensusState(BaseService):
 
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet.from_header(proposal.block_parts_header)
+        self.trace.mark_arrival("proposal")
         self.logger.info("received proposal %r", proposal)
 
     def add_proposal_block_part(self, height: int, part, verify: bool) -> bool:
@@ -1407,6 +1423,11 @@ class ConsensusState(BaseService):
         if rs.proposal_block_parts is None:
             return False  # no proposal yet; possible DoS — drop
         added = rs.proposal_block_parts.add_part(part)
+        if added:
+            # first part held for this height (build or gossip): the
+            # cross-node spread of this instant IS the proposer->peer
+            # propagation lag (mark_arrival keeps the first only)
+            self.trace.mark_arrival("first_block_part")
         if added and rs.proposal_block_parts.is_complete():
             block_bytes = rs.proposal_block_parts.get_data()
             rs.proposal_block = Block.from_bytes(block_bytes)
@@ -1520,6 +1541,9 @@ class ConsensusState(BaseService):
 
         # unlock on a newer polka (state.go:1507-1521)
         block_id = prevotes.two_thirds_majority()
+        if block_id is not None and block_id.hash:
+            # gossip arrival mark (round 15): +2/3 prevotes for a block
+            self.trace.mark_arrival("prevote_quorum")
         if (
             rs.locked_block is not None
             and rs.locked_round < vote.round_ <= rs.round_
@@ -1549,6 +1573,11 @@ class ConsensusState(BaseService):
         precommits = rs.votes.precommits(vote.round_)
         self.logger.debug("added precommit %r -> %r", vote, precommits)
         block_id = precommits.two_thirds_majority()
+        if block_id is not None and block_id.hash:
+            # gossip arrival mark (round 15): the commit-able quorum —
+            # after a partition heals, the first height's observation
+            # carries the whole outage (the scrape-visible quorum spike)
+            self.trace.mark_arrival("precommit_quorum")
         if block_id is not None:
             # executed as defers in the reference: latest first
             self.enter_new_round(rs.height, vote.round_)

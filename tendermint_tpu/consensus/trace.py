@@ -80,14 +80,52 @@ def step_segment(step: int) -> str:
     return _STEP_SEGMENTS.get(step, "new_height")
 
 
+# gossip arrival marks (round 15): wall-clock instants recorded once per
+# height, in canonical order. Absolute epoch seconds — the fleet
+# aggregator (ops/fleet.py) compares them ACROSS nodes to reconstruct
+# proposer->peer propagation lag, quorum-formation time, and commit skew
+ARRIVALS = (
+    "proposal",          # proposal message accepted
+    "first_block_part",  # first proposal part added (build or gossip)
+    "prevote_quorum",    # +2/3 prevotes for a block observed
+    "precommit_quorum",  # +2/3 precommits for a block observed
+    "commit",            # finalize began (quorum AND full block held)
+)
+
+
+def arrival_hists(reg=None) -> dict:
+    """The scrape-side distributions of the arrival marks (create-or-get,
+    so node/telemetry.py can materialize them per-node): seconds from
+    height start to quorum formation, by phase. A partition shows up
+    here as a spike — the first post-heal height carries the whole
+    outage in its quorum-formation observation."""
+    from tendermint_tpu.libs import telemetry
+
+    if reg is None:
+        reg = telemetry.default_registry()
+    return {
+        "quorum": reg.histogram(
+            "consensus_quorum_seconds",
+            "seconds from height start to +2/3 quorum formation, by phase",
+            labelnames=("phase",),
+        ),
+        "first_part": reg.histogram(
+            "consensus_first_part_seconds",
+            "seconds from height start to the first proposal part held",
+        ),
+    }
+
+
 class HeightTrace:
     """One committed height's wall-time breakdown. Immutable once built
     (the ring hands references to RPC readers on other threads)."""
 
     __slots__ = ("height", "segments", "aux", "device", "total_s",
-                 "wall_s", "rounds", "completed_at")
+                 "wall_s", "rounds", "completed_at", "arrivals",
+                 "started_at")
 
-    def __init__(self, height, segments, aux, device, wall_s, rounds):
+    def __init__(self, height, segments, aux, device, wall_s, rounds,
+                 arrivals=None, started_at=None):
         self.height = height
         self.segments = segments
         self.aux = aux
@@ -96,6 +134,13 @@ class HeightTrace:
         self.wall_s = wall_s
         self.rounds = rounds
         self.completed_at = time.time()
+        # gossip arrival marks (round 15): absolute wall-clock instants
+        # the fleet aggregator aligns across nodes
+        self.arrivals = dict(arrivals or {})
+        self.started_at = (
+            started_at if started_at is not None
+            else self.completed_at - wall_s
+        )
 
     def to_json(self) -> dict:
         return {
@@ -106,6 +151,8 @@ class HeightTrace:
             "segments": {k: round(v, 6) for k, v in self.segments.items()},
             "aux": {k: round(v, 6) for k, v in self.aux.items()},
             "device": dict(self.device),
+            "started_at": self.started_at,
+            "arrivals": {k: round(v, 6) for k, v in self.arrivals.items()},
             "completed_at": self.completed_at,
         }
 
@@ -131,6 +178,13 @@ class TraceRecorder:
         self._rounds = 0
         self._cur = "new_height"
         self._last_t = time.monotonic()
+        # gossip arrival marks (round 15): wall-clock instants, set once
+        # per height on the receive routine (lock-free single writer like
+        # mark/note). metrics_registry scopes the quorum histograms the
+        # marks feed at finish (node/telemetry.py sets the node registry)
+        self._arrivals: dict[str, float] = {}
+        self._started_wall = time.time()
+        self.metrics_registry = None
         # finish()'s end snapshot doubles as the next begin()'s start —
         # one probe per height boundary, not two back-to-back on the
         # receive routine
@@ -161,6 +215,8 @@ class TraceRecorder:
         self._rounds = 0
         self._cur = "new_height"
         self._last_t = now if now is not None else time.monotonic()
+        self._arrivals = {}
+        self._started_wall = time.time()
         with self._ov_mtx:
             # _height moves under the overlay lock so a concurrent
             # note_overlap either parks in _ov_pending (and is adopted
@@ -195,6 +251,15 @@ class TraceRecorder:
     def note_round(self, round_: int) -> None:
         self._rounds = max(self._rounds, round_ + 1)
 
+    def mark_arrival(self, key: str, at: float | None = None) -> None:
+        """Record a gossip arrival instant (ARRIVALS key) ONCE per
+        height — later duplicates (a re-proposed round, catchup parts)
+        keep the FIRST instant, which is what propagation-lag math
+        wants. Wall-clock epoch seconds so the fleet aggregator can
+        align instants across nodes. Single-writer like mark/note."""
+        if key not in self._arrivals:
+            self._arrivals[key] = at if at is not None else time.time()
+
     def note_overlap(self, height: int, key: str, seconds: float) -> None:
         """Cross-thread aux attribution (round 14): the apply executor
         credits work to the height it OVERLAPPED (apply of H runs under
@@ -228,11 +293,36 @@ class TraceRecorder:
             if k not in _DELTA_KEYS:
                 device[f"{k}_start"] = start.get(k)
                 device[f"{k}_end"] = end.get(k)
+        arrivals = dict(self._arrivals)
         tr = HeightTrace(height, dict(self._segments), dict(self._aux),
-                         device, wall_s, max(self._rounds, 1))
+                         device, wall_s, max(self._rounds, 1),
+                         arrivals=arrivals, started_at=self._started_wall)
+        self._observe_arrivals(arrivals)
         with self._ring_mtx:
             self._ring.append(tr)
         return tr
+
+    def _observe_arrivals(self, arrivals: dict) -> None:
+        """Feed the height's arrival marks into the scrape-side
+        distributions (consensus_quorum_seconds{phase},
+        consensus_first_part_seconds). Failure-proof like the device
+        probe: attribution must never wedge the receive routine."""
+        if not arrivals:
+            return
+        try:
+            hists = arrival_hists(self.metrics_registry)
+            start = self._started_wall
+            for phase in ("prevote", "precommit"):
+                at = arrivals.get(f"{phase}_quorum")
+                if at is not None:
+                    hists["quorum"].labels(phase=phase).observe(
+                        max(0.0, at - start)
+                    )
+            at = arrivals.get("first_block_part")
+            if at is not None:
+                hists["first_part"].observe(max(0.0, at - start))
+        except Exception:  # noqa: BLE001
+            pass
 
     def last(self, n: int = 10) -> list[HeightTrace]:
         """Newest-first slice of the completed-trace ring."""

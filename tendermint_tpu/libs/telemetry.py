@@ -114,6 +114,23 @@ def size_buckets(hi: float = 65536.0) -> tuple[float, ...]:
 OVERFLOW_LABEL = "_other"
 
 
+def family_max_series(name: str) -> int:
+    """Cardinality bound for a labeled family: the per-family override
+    ``TENDERMINT_TELEMETRY_MAX_SERIES_<NAME>`` (family name uppercased)
+    wins over the process-wide ``TENDERMINT_TELEMETRY_MAX_SERIES``
+    (default 64). Both parse defensively (libs/envknob) — a typo'd knob
+    keeps the default, never kills instrument construction. The bound
+    applies to every instrument kind, histograms included: a per-peer
+    latency histogram under 100-peer churn collapses into one ``_other``
+    series exactly like a counter does."""
+    global_max = int(_env_number("TENDERMINT_TELEMETRY_MAX_SERIES", 64,
+                                 cast=int))
+    return int(_env_number(
+        f"TENDERMINT_TELEMETRY_MAX_SERIES_{name.upper()}", global_max,
+        cast=int,
+    ))
+
+
 class _Metric:
     """Base: a named family with optional labels. Unlabeled metrics are
     their own single child (label key ``()``)."""
@@ -129,7 +146,7 @@ class _Metric:
         self._children: dict = {}
         self._max_series = int(
             max_series if max_series is not None
-            else _env_number("TENDERMINT_TELEMETRY_MAX_SERIES", 64, cast=int)
+            else family_max_series(name)
         )
         self.dropped_series = 0
         if not self.labelnames:
@@ -162,6 +179,22 @@ class _Metric:
                 f"{self.name}: labels {sorted(kv)} != {sorted(self.labelnames)}"
             )
         return self._child(tuple(str(kv[k]) for k in self.labelnames))
+
+    def remove_labels(self, **kv) -> None:
+        """Drop one labeled child series — staleness cleanup: a series
+        whose subject is gone (a churned-out peer) must disappear from
+        the scrape, not freeze at its last value. Also frees the slot
+        against the cardinality bound. Missing series is a no-op; the
+        shared ``_other`` overflow series is removable like any other
+        (it re-creates on the next overflow)."""
+        if set(kv) != set(self.labelnames):
+            raise KeyError(
+                f"{self.name}: labels {sorted(kv)} != {sorted(self.labelnames)}"
+            )
+        with self._mtx:
+            self._children.pop(
+                tuple(str(kv[k]) for k in self.labelnames), None
+            )
 
     def _own(self):
         if self.labelnames:
@@ -408,7 +441,18 @@ class Registry:
         self._metrics: dict[str, _Metric] = {}
         # prefix -> (fn, legacy); evaluation order = registration order
         self._producers: dict[str, tuple] = {}
+        # collect-time refreshers (round 15): run before instruments are
+        # gathered, so point-in-time gauges (per-peer last-recv age) are
+        # fresh in the SAME scrape that triggered them
+        self._pre_collect: list = []
         self.parent = parent
+
+    def on_collect(self, fn) -> None:
+        """Register a hook run at the start of every collect() — the
+        seam for labeled gauges whose value only means something at read
+        time. Hook failures propagate (the loud-wiring convention)."""
+        with self._mtx:
+            self._pre_collect.append(fn)
 
     # -- instrument factories (create-or-get by name) ----------------------
 
@@ -487,6 +531,9 @@ class Registry:
         with self._mtx:
             metrics = list(self._metrics.values())
             producers = list(self._producers.items())
+            hooks = list(self._pre_collect)
+        for hook in hooks:
+            hook()
         fams: list[Family] = []
         seen: set[str] = set()
 

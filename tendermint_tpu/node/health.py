@@ -1,0 +1,175 @@
+"""Node health plane (round 15): GET /health + node_health_* gauges.
+
+Before this, netchaos scenarios and probes asserted liveness by reaching
+into harness objects (frozen height vectors, peer sets). This module
+folds the node's existing liveness signals into ONE ok/degraded/failing
+verdict served on the RPC listener (rpc/server.py GET /health), so
+k8s-style probes and the fleet aggregator (ops/fleet.py) assert on the
+observable surface:
+
+    height age      seconds since the current height opened vs the
+                    consensus_height_seconds liveness budget (a stalled
+                    chain is a growing age) — waived while fast sync is
+                    active (catching up is not a stall)
+    peers           connected peer count vs TENDERMINT_HEALTH_MIN_PEERS
+                    (default 0 = not gated: a sole-validator devnode is
+                    healthy with zero peers)
+    breaker         the shared device-plane circuit breaker — OPEN means
+                    the node runs on the CPU fallback (degraded, alive)
+    wal             pending records with a growing sync age = the group-
+                    commit flusher is stuck, not merely idle
+    pipeline        a poisoned deferred apply wedges the join = FAILING
+    mempool         depth beyond the backlog knob = ingress pressure
+
+Verdict: failing if any check fails, degraded if any degrades, else ok.
+HTTP: 200 for ok/degraded, 503 for failing (probes key off the status
+code; the body is machine-readable either way). Every threshold is an
+env knob (libs/envknob — a typo'd value keeps the default):
+
+    TENDERMINT_HEALTH_HEIGHT_AGE_DEGRADED_S   (30)
+    TENDERMINT_HEALTH_HEIGHT_AGE_FAILING_S    (120)
+    TENDERMINT_HEALTH_MIN_PEERS               (0)
+    TENDERMINT_HEALTH_WAL_SYNC_AGE_S          (30)
+    TENDERMINT_HEALTH_MEMPOOL_DEGRADED        (50000)
+
+The flat ``node_health_*`` gauges (node/telemetry.py wires the producer)
+export the same verdict numerically: status 0=ok / 1=degraded /
+2=failing, so alerting needs no JSON endpoint.
+"""
+
+from __future__ import annotations
+
+import time
+
+from tendermint_tpu.libs.envknob import env_number
+
+OK, DEGRADED, FAILING = "ok", "degraded", "failing"
+_CODE = {OK: 0, DEGRADED: 1, FAILING: 2}
+
+
+def _knobs() -> dict:
+    """Read per call: the netchaos tier tightens these live via env."""
+    return {
+        "height_age_degraded_s": float(
+            env_number("TENDERMINT_HEALTH_HEIGHT_AGE_DEGRADED_S", 30.0)
+        ),
+        "height_age_failing_s": float(
+            env_number("TENDERMINT_HEALTH_HEIGHT_AGE_FAILING_S", 120.0)
+        ),
+        "min_peers": int(env_number("TENDERMINT_HEALTH_MIN_PEERS", 0,
+                                    cast=int)),
+        "wal_sync_age_s": float(
+            env_number("TENDERMINT_HEALTH_WAL_SYNC_AGE_S", 30.0)
+        ),
+        "mempool_degraded": int(
+            env_number("TENDERMINT_HEALTH_MEMPOOL_DEGRADED", 50_000,
+                       cast=int)
+        ),
+    }
+
+
+def _worst(a: str, b: str) -> str:
+    return a if _CODE[a] >= _CODE[b] else b
+
+
+def health_report(node) -> dict:
+    """The /health body. Direct attribute reads (the PR-4 loud-wiring
+    convention): a renamed producer field raises here and surfaces as a
+    500 probe failure — which monitoring alerts on — never as a
+    healthy-looking 200 with a silently missing check."""
+    k = _knobs()
+    cs = node.consensus_state
+    checks: dict[str, dict] = {}
+    status = OK
+
+    # -- height age (liveness) --------------------------------------------
+    age = cs.height_age_s()
+    fast_sync = bool(node.blockchain_reactor.fast_sync)
+    if fast_sync:
+        hstatus = OK  # catching up, not stalled; fastsync_* gauges cover it
+    elif age >= k["height_age_failing_s"]:
+        hstatus = FAILING
+    elif age >= k["height_age_degraded_s"]:
+        hstatus = DEGRADED
+    else:
+        hstatus = OK
+    checks["height_age"] = {
+        "status": hstatus, "age_s": round(age, 3),
+        "height": cs.get_round_state().height,
+        "fast_sync": fast_sync,
+        "degraded_at_s": k["height_age_degraded_s"],
+        "failing_at_s": k["height_age_failing_s"],
+    }
+    status = _worst(status, hstatus)
+
+    # -- peers -------------------------------------------------------------
+    outbound, inbound, dialing = node.sw.num_peers()
+    peers = outbound + inbound
+    pstatus = DEGRADED if peers < k["min_peers"] else OK
+    checks["peers"] = {
+        "status": pstatus, "peers": peers, "dialing": dialing,
+        "min_peers": k["min_peers"],
+    }
+    status = _worst(status, pstatus)
+
+    # -- device-plane breaker ----------------------------------------------
+    from tendermint_tpu.ops import gateway
+
+    br = gateway.devd_breaker().stats()
+    bstatus = DEGRADED if br["breaker_state"] == 2 else OK
+    checks["breaker"] = {"status": bstatus, "state": br["breaker_state"],
+                         "opens": br["breaker_opens"]}
+    status = _worst(status, bstatus)
+
+    # -- WAL flusher -------------------------------------------------------
+    wal = cs.wal
+    if wal is None:
+        checks["wal"] = {"status": OK, "open": False}
+    else:
+        ws = wal.stats()
+        wstatus = (
+            DEGRADED
+            if ws["pending"] > 0 and ws["sync_age_s"] > k["wal_sync_age_s"]
+            else OK
+        )
+        checks["wal"] = {
+            "status": wstatus, "open": True, "pending": ws["pending"],
+            "sync_age_s": ws["sync_age_s"],
+        }
+        status = _worst(status, wstatus)
+
+    # -- execution pipeline ------------------------------------------------
+    poisoned = cs.pipeline_poisoned()
+    checks["pipeline"] = {"status": FAILING if poisoned else OK,
+                          "poisoned": poisoned}
+    status = _worst(status, checks["pipeline"]["status"])
+
+    # -- mempool backlog ---------------------------------------------------
+    depth = node.mempool.size()
+    mstatus = DEGRADED if depth >= k["mempool_degraded"] else OK
+    checks["mempool"] = {"status": mstatus, "size": depth,
+                         "degraded_at": k["mempool_degraded"]}
+    status = _worst(status, mstatus)
+
+    return {
+        "status": status,
+        "code": _CODE[status],
+        "time": time.time(),
+        "checks": checks,
+    }
+
+
+def health_gauges(node) -> dict:
+    """Flat numeric view for the telemetry registry (node_health_*
+    families on both surfaces): the verdict, the liveness age, and how
+    many checks sit at each severity."""
+    report = health_report(node)
+    checks = report["checks"].values()
+    return {
+        "status": report["code"],
+        "height_age_s": report["checks"]["height_age"]["age_s"],
+        "peers": report["checks"]["peers"]["peers"],
+        "mempool_size": report["checks"]["mempool"]["size"],
+        "checks_degraded": sum(1 for c in checks if c["status"] == DEGRADED),
+        "checks_failing": sum(1 for c in checks if c["status"] == FAILING),
+    }

@@ -15,7 +15,12 @@ Canonical plane prefixes (full catalog: docs/observability.md):
     wal_*              consensus WAL durability gauges (after start)
     evidence_*         duplicate-vote evidence pool
     mempool_*          pool depth + sig-gate accounting
-    p2p_*              switch peer counts
+    p2p_*              switch peer counts + per-peer gossip aggregates
+    p2p_peer_*         round-15 labeled per-peer/per-channel families
+                       (p2p/telemetry.py; node-registry-scoped, so two
+                       in-process nodes keep separate series)
+    node_health_*      round-15 health verdict (node/health.py): status
+                       0 ok / 1 degraded / 2 failing + liveness age
     fastsync_*         BlockchainReactor progress + stage seconds
     statesync_*        reactor serving/restore + producer cadence (incl.
                        the round-13 delta counters)
@@ -55,8 +60,10 @@ def build_registry(node) -> telemetry.Registry:
     # registers itself at import)
     from tendermint_tpu import devd
     from tendermint_tpu.consensus import pipeline as cpipeline
+    from tendermint_tpu.consensus import trace as ctrace
     from tendermint_tpu.ops import faults  # noqa: F401 — import = register
     from tendermint_tpu.p2p import secret_connection
+    from tendermint_tpu.p2p import telemetry as p2p_telemetry
 
     devd._latency_hists()
     secret_connection._counters()
@@ -64,6 +71,17 @@ def build_registry(node) -> telemetry.Registry:
 
     reg = telemetry.Registry(parent=telemetry.default_registry())
     cs = node.consensus_state
+
+    # round 15: the per-peer p2p families and the quorum-formation
+    # histograms live on the NODE registry — each in-process node keeps
+    # its own series (the netchaos harness runs four nodes per process),
+    # and a scrape's family set is stable from the first height. The
+    # switch hands the registry to every admitted peer; the trace
+    # recorder feeds the arrival histograms at each finish().
+    peer_fams = p2p_telemetry.peer_metrics(reg)
+    ctrace.arrival_hists(reg)
+    node.sw.metrics_registry = reg
+    cs.trace.metrics_registry = reg
 
     def consensus() -> dict:
         rs = cs.get_round_state()
@@ -121,15 +139,81 @@ def build_registry(node) -> telemetry.Registry:
 
     reg.register_producer("mempool", mempool)
 
+    # collect-time refresh of the per-peer staleness gauge: an age only
+    # means something at read time, so every scrape sets the labeled
+    # children for the CURRENT peer set before instruments are gathered.
+    # Disconnected peers must keep AGING, not freeze at their last live
+    # value (the staleness alert fires exactly when a peer dies): the
+    # last recv instant of every peer ever refreshed is remembered and
+    # dead peers' series keep growing from it; churn-evicted peers have
+    # their series REMOVED from the family (a frozen series is the bug
+    # this exists to prevent). The RPC server is threading — concurrent
+    # scrapes share the table under a lock.
+    import threading as _threading
+    import time as _time
+
+    last_recv_instants: dict[str, float] = {}
+    ages_mtx = _threading.Lock()
+
+    def refresh_peer_ages() -> None:
+        age_gauge = peer_fams["last_recv_age"]
+        now = _time.monotonic()
+        live = []
+        for peer in node.sw.peers.list():
+            try:
+                live.append((peer.id(), now - peer.last_recv_age()))
+            except Exception:  # noqa: BLE001 — a peer mid-teardown must
+                # not fail the whole scrape
+                pass
+        with ages_mtx:
+            for pid, instant in live:
+                last_recv_instants[pid] = instant
+            if len(last_recv_instants) > 4 * telemetry.family_max_series(
+                age_gauge.name
+            ):
+                # churn bound: evict the stalest remembered peers AND
+                # drop their series so they vanish from the scrape
+                # instead of freezing at the last written age
+                for pid in sorted(last_recv_instants,
+                                  key=last_recv_instants.get)[
+                        : len(last_recv_instants) // 2]:
+                    del last_recv_instants[pid]
+                    age_gauge.remove_labels(peer=pid)
+                    # the dead peer's point-in-time queue gauges must
+                    # vanish too, not freeze (counters stay: a stopped
+                    # counter is correct Prometheus semantics)
+                    for d in node.sw.ch_descs:
+                        ch = f"{d.id:#x}"
+                        peer_fams["send_queue"].remove_labels(
+                            peer=pid, channel=ch)
+                        peer_fams["send_queue_high_water"].remove_labels(
+                            peer=pid, channel=ch)
+            snapshot = list(last_recv_instants.items())
+        for pid, instant in snapshot:
+            age_gauge.labels(peer=pid).set(round(now - instant, 3))
+
+    reg.on_collect(refresh_peer_ages)
+
     def p2p() -> dict:
         outbound, inbound, dialing = node.sw.num_peers()
-        return {
+        out = {
             "peers_outbound": outbound,
             "peers_inbound": inbound,
             "peers_dialing": dialing,
         }
+        # round 15: flat aggregates over the labeled gossip families
+        # (sums across peers, the _other overflow series included) so
+        # the legacy RPC sees the wedge signal too
+        out.update(p2p_telemetry.family_totals(reg))
+        return out
 
     reg.register_producer("p2p", p2p)
+
+    # round 15: the health verdict as flat gauges on both surfaces —
+    # alerting keys off node_health_status without the JSON endpoint
+    from tendermint_tpu.node.health import health_gauges
+
+    reg.register_producer("node_health", lambda: health_gauges(node))
 
     def fastsync() -> dict:
         bc = node.blockchain_reactor

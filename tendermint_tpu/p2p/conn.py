@@ -167,6 +167,19 @@ class MConnection(BaseService):
         self._errored = threading.Event()
         self._threads: list[threading.Thread] = []
         self._wmtx = threading.Lock()  # serializes raw stream writes
+        # per-peer instrumentation (round 15): armed by set_peer_label
+        # once the handshake knows who the peer is; None = uninstrumented
+        # (pre-handshake traffic, raw harness mconns)
+        self._pm = None
+        self.last_recv = time.monotonic()
+
+    def set_peer_label(self, peer_id: str, registry=None) -> None:
+        """Arm the p2p_peer_* families for this connection. `registry`
+        scopes the series (the switch passes the node registry so two
+        in-process nodes keep separate counters); default process-wide."""
+        from tendermint_tpu.p2p.telemetry import PeerConnMetrics
+
+        self._pm = PeerConnMetrics(peer_id, list(self.channels), registry)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -202,6 +215,7 @@ class MConnection(BaseService):
         ok = ch.send_bytes(msg, self.config.send_timeout)
         if ok:
             self._send_signal.set()
+        self._note_send(ch, ok)
         return ok
 
     def try_send(self, ch_id: int, msg: bytes) -> bool:
@@ -213,7 +227,17 @@ class MConnection(BaseService):
         ok = ch.try_send_bytes(msg)
         if ok:
             self._send_signal.set()
+        self._note_send(ch, ok)
         return ok
+
+    def _note_send(self, ch: _Channel, ok: bool) -> None:
+        pm = self._pm
+        if pm is None:
+            return
+        if ok:
+            pm.queue_sample(ch.id, ch.send_queue_size())
+        else:
+            pm.send_failure(ch.id)
 
     def can_send(self, ch_id: int) -> bool:
         ch = self.channels.get(ch_id)
@@ -253,6 +277,8 @@ class MConnection(BaseService):
                 if now - last_ping >= cfg.ping_interval:
                     last_ping = now
                     self._write(bytes([PACKET_TYPE_PING]))
+                    if self._pm is not None:
+                        self._pm.ping_sent()
                     if now - self._last_pong > cfg.ping_interval + cfg.pong_timeout:
                         raise TimeoutError("pong timeout")
                 # drain up to a burst of packets, fairly
@@ -264,6 +290,10 @@ class MConnection(BaseService):
                     if frame is None:
                         break
                     self._write(frame)
+                    if self._pm is not None:
+                        # frame layout: type, channel, eof (msg done)
+                        self._pm.sent_frame(frame[1], len(frame),
+                                            bool(frame[2]))
                 # decay fairness counters once per wakeup (connection.go:544)
                 for ch in self.channels.values():
                     ch.recently_sent = int(ch.recently_sent * 0.8)
@@ -289,11 +319,14 @@ class MConnection(BaseService):
                 self.recv_monitor.limit(1, cfg.recv_rate)
                 self.recv_monitor.update(1)
                 ptype = head[0]
+                self.last_recv = time.monotonic()
                 if ptype == PACKET_TYPE_PING:
                     self._pong_pending.set()
                     self._send_signal.set()
                 elif ptype == PACKET_TYPE_PONG:
                     self._last_pong = time.monotonic()
+                    if self._pm is not None:
+                        self._pm.pong_received()
                 elif ptype == PACKET_TYPE_MSG:
                     rest = self._read_exact(_MSG_HEADER.size - 1)
                     ch_id, eof, plen = rest[0], rest[1], (rest[2] << 8) | rest[3]
@@ -303,6 +336,9 @@ class MConnection(BaseService):
                     ch = self.channels.get(ch_id)
                     if ch is None:
                         raise ValueError(f"unknown channel {ch_id:#x}")
+                    if self._pm is not None:
+                        self._pm.recv_packet(ch_id, _MSG_HEADER.size + plen,
+                                             bool(eof))
                     msg = ch.recv_packet(payload, bool(eof))
                     if msg is not None and self.on_receive is not None:
                         self.on_receive(ch_id, msg)

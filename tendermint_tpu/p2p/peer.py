@@ -8,6 +8,7 @@ Connection layering: raw stream -> [fuzz wrapper] -> [secret connection]
 from __future__ import annotations
 
 import struct
+import time
 from dataclasses import dataclass, field
 
 from tendermint_tpu.libs.service import BaseService
@@ -69,6 +70,10 @@ class Peer(BaseService):
         self.config = config
         self.node_info: NodeInfo | None = None
         self.data: dict = {}  # per-peer reactor state (e.g. PeerState)
+        # registry scoping the p2p_peer_* series (round 15): the switch
+        # sets this from its own metrics_registry before handshake; None
+        # falls back to the process-wide registry
+        self.metrics_registry = None
 
         if config.fuzz:
             from tendermint_tpu.p2p.fuzz import FuzzedStream
@@ -100,6 +105,9 @@ class Peer(BaseService):
             if self.stream.remote_pubkey().raw != self.node_info.pub_key.raw:
                 raise ConnectionError("node info pubkey != secret conn pubkey")
         self.mconn._name = f"mconn:{self.id()[:8]}"
+        # identity is known now: arm the per-peer instrument families
+        # (p2p/telemetry.py) on whichever registry scopes this peer
+        self.mconn.set_peer_label(self.id(), self.metrics_registry)
         return self.node_info
 
     # -- identity ----------------------------------------------------------
@@ -130,6 +138,12 @@ class Peer(BaseService):
 
     def can_send(self, ch_id: int) -> bool:
         return self.mconn.can_send(ch_id)
+
+    def last_recv_age(self) -> float:
+        """Seconds since ANY packet arrived on this connection — the
+        per-peer staleness signal (p2p_peer_last_recv_age_seconds,
+        refreshed at collect time by node/telemetry.py)."""
+        return time.monotonic() - self.mconn.last_recv
 
     def get(self, key: str):
         return self.data.get(key)

@@ -74,6 +74,11 @@ class Switch(BaseService):
         self.dialing: set[str] = set()
         self.node_priv_key = node_priv_key or gen_priv_key_ed25519()
         self.node_info: NodeInfo | None = None
+        # registry scoping the p2p_peer_* series (round 15): the node
+        # sets this to its own registry (node/telemetry.build_registry)
+        # so two in-process nodes keep separate per-peer counters; None
+        # falls back to the process-wide default
+        self.metrics_registry = None
         self.listeners: list = []
         self.filter_conn_by_addr = None  # callables raising on rejection
         self.filter_conn_by_pubkey = None
@@ -231,6 +236,7 @@ class Switch(BaseService):
                 node_priv_key=self.node_priv_key,
                 persistent=persistent,
             )
+            peer.metrics_registry = self.metrics_registry
             peer.dialed_addr = dialed_addr
             peer = self.add_peer(peer)
         finally:

@@ -166,6 +166,12 @@ class RPCServer(BaseService):
                     # / websocket), which this GET path now shadows.
                     self._serve_prometheus()
                     return
+                if parsed.path == "/health":
+                    # liveness verdict (round 15, node/health.py): 200
+                    # for ok/degraded, 503 for failing — probes key off
+                    # the status code, the body is machine-readable
+                    self._serve_health()
+                    return
                 method = parsed.path.strip("/")
                 if not method:
                     self._respond({"routes": sorted(server.routes)})
@@ -206,6 +212,29 @@ class RPCServer(BaseService):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _serve_health(self):
+                node = getattr(server.ctx, "node", None)
+                if node is None:
+                    # context without a node (mock harnesses): answer the
+                    # probe rather than 404 the endpoint contract
+                    self._respond({"status": "ok", "code": 0, "checks": {},
+                                   "note": "no node in RPC context"})
+                    return
+                from tendermint_tpu.node.health import health_report
+
+                try:
+                    report = health_report(node)
+                except Exception:  # noqa: BLE001 — a broken check is a
+                    # wiring bug; surface it as a probe failure, never
+                    # take the RPC thread down
+                    server.logger.exception("health render failed")
+                    self.send_error(500, "health render failed")
+                    return
+                self._respond(
+                    report, status=503 if report["status"] == "failing"
+                    else 200,
+                )
 
             # -- websocket -------------------------------------------------
 

@@ -121,6 +121,121 @@ def test_byzantine_double_signer_commits_evidence(net4):
         inj.close()
 
 
+@pytest.mark.slow
+def test_partition_fleet_signals_scrape_only(net4, monkeypatch):
+    """Round 15 acceptance: the partition scenario must be VISIBLE in the
+    scraped signals and the heal must recover them — every assertion
+    here reads GET /metrics, GET /health, or the consensus_trace RPC;
+    none reaches into harness objects.
+
+    Partition {3} (minority): the majority keeps committing while node
+    3's scrape shows the stall — peers gone, vote-gossip send counters
+    frozen, /health flipped degraded on height age + peer loss. Heal:
+    /health recovers to ok, and the outage lands in node 3's
+    quorum-formation surface (consensus_quorum_seconds spike / a traced
+    height whose precommit quorum took the whole outage)."""
+    from tendermint_tpu.ops import fleet
+
+    monkeypatch.setenv("TENDERMINT_HEALTH_HEIGHT_AGE_DEGRADED_S", "3.0")
+    monkeypatch.setenv("TENDERMINT_HEALTH_HEIGHT_AGE_FAILING_S", "1e9")
+    monkeypatch.setenv("TENDERMINT_HEALTH_MIN_PEERS", "1")
+    urls = [f"127.0.0.1:{n.rpc_port()}" for n in net4.nodes]
+
+    def status(url):
+        return fleet.fetch_health(url)["status"]
+
+    # -- pre-partition: fleet healthy, timeline reconstructs 4-wide ----
+    assert wait_until(
+        lambda: all(status(u) == "ok" for u in urls), timeout=60
+    ), [status(u) for u in urls]
+    snapshot = fleet.collect(urls, last=8)
+    rows = fleet.build_timeline(
+        {u: e["traces"] for u, e in snapshot.items()}, last=8
+    )
+    full = [r for r in rows if r["nodes_reporting"] == 4]
+    assert full, f"no height traced on all 4 nodes: {rows}"
+    assert any(r["commit_skew_s"] is not None for r in full)
+    assert any(r["precommit_quorum_s_max"] is not None for r in full)
+
+    m3 = fleet.fetch_metrics(urls[3])
+    q_sum0 = fleet.metric_value(
+        m3, "consensus_quorum_seconds_sum", {"phase": "precommit"},
+        default=0.0,
+    )
+
+    # -- partition: the stall is scrape-visible ------------------------
+    net4.partition({3})
+    assert wait_until(lambda: status(urls[3]) == "degraded", timeout=45)
+    health3 = fleet.fetch_health(urls[3])
+    assert health3["checks"]["peers"]["status"] == "degraded", health3
+    m3 = fleet.fetch_metrics(urls[3])
+    peers3 = (
+        fleet.metric_value(m3, "p2p_peers_outbound", default=0)
+        + fleet.metric_value(m3, "p2p_peers_inbound", default=0)
+    )
+    assert peers3 == 0, "severed links must be visible in the peer gauges"
+    sends_stalled = fleet.metric_value(
+        m3, "p2p_peer_vote_gossip_sends_total", default=0.0
+    )
+    h_major0 = fleet.metric_value(
+        fleet.fetch_metrics(urls[0]), "consensus_height"
+    )
+    time.sleep(1.5)
+    m3b = fleet.fetch_metrics(urls[3])
+    assert fleet.metric_value(
+        m3b, "p2p_peer_vote_gossip_sends_total", default=0.0
+    ) == sends_stalled, "gossip sends must freeze on a partitioned node"
+    # hold the partition until the liveness signal engages too (the
+    # peers check flips instantly; the quorum-spike assertion below
+    # needs the stall to actually span the height-age budget)
+    assert wait_until(
+        lambda: fleet.fetch_health(urls[3])["checks"]["height_age"][
+            "status"] == "degraded",
+        timeout=45,
+    )
+    # the majority side kept committing (scraped height moved)
+    assert wait_until(
+        lambda: fleet.metric_value(
+            fleet.fetch_metrics(urls[0]), "consensus_height"
+        ) > h_major0,
+        timeout=60,
+    )
+
+    # -- heal: recovery is scrape-visible ------------------------------
+    net4.heal()
+    assert wait_until(lambda: status(urls[3]) == "ok", timeout=90), (
+        fleet.fetch_health(urls[3])
+    )
+    m3c = fleet.fetch_metrics(urls[3])
+    peers3 = (
+        fleet.metric_value(m3c, "p2p_peers_outbound", default=0)
+        + fleet.metric_value(m3c, "p2p_peers_inbound", default=0)
+    )
+    assert peers3 >= 1, "healed links must re-appear in the peer gauges"
+    assert fleet.metric_value(
+        m3c, "p2p_peer_vote_gossip_sends_total", default=0.0
+    ) >= sends_stalled
+    # the outage shows in the quorum-formation surface: either the
+    # histogram sum jumped by ~the outage, or a freshly traced height
+    # carries it in its arrival marks (both pure scrape reads; the
+    # histogram can miss it only if quorum formed in the instant before
+    # the links dropped)
+    q_sum1 = fleet.metric_value(
+        m3c, "consensus_quorum_seconds_sum", {"phase": "precommit"},
+        default=0.0,
+    )
+    traces3 = fleet.fetch_traces(urls[3], last=10)
+    spiked_trace = any(
+        t["arrivals"].get("precommit_quorum", t["started_at"])
+        - t["started_at"] > 2.0
+        or t["wall_s"] > 2.5
+        for t in traces3
+    )
+    assert (q_sum1 - q_sum0 > 2.0) or spiked_trace, (
+        q_sum0, q_sum1, [t["wall_s"] for t in traces3]
+    )
+
+
 # -- the rest of the matrix ---------------------------------------------------
 
 

@@ -259,8 +259,15 @@ METRICS_REQUIRED_KEYS = (
     "wal_group_size", "wal_repairs", "wal_sync_age_s",
     # evidence + mempool
     "evidence_count", "mempool_size",
-    # p2p
+    # p2p (round 15 adds the flat aggregates over the labeled
+    # p2p_peer_* gossip families — the wedge signal on the legacy dict)
     "p2p_peers_outbound", "p2p_peers_inbound", "p2p_peers_dialing",
+    "p2p_peer_send_failures", "p2p_peer_vote_gossip_picks",
+    "p2p_peer_vote_gossip_sends", "p2p_peer_vote_gossip_send_failures",
+    "p2p_peer_catchup_commits",
+    # health plane (round 15): the /health verdict as flat gauges
+    "node_health_status", "node_health_height_age_s",
+    "node_health_checks_degraded", "node_health_checks_failing",
     # fast sync
     "fastsync_active", "fastsync_blocks_synced",
     "fastsync_rate_blocks_per_sec", "fastsync_apply_s",
@@ -319,16 +326,35 @@ def test_prometheus_exposition_endpoint(node):
     for fam in ("consensus_height", "wal_format", "gateway_verify_tpu_sigs",
                 "gateway_hash_tpu_leaves", "gateway_breaker_state",
                 "mempool_size", "statesync_snapshots", "fastsync_active",
-                "p2p_peers_outbound", "statetree_size", "statetree_commits"):
+                "p2p_peers_outbound", "statetree_size", "statetree_commits",
+                # round 15: health verdict + the per-peer queue gauges
+                "node_health_status", "node_health_height_age_s",
+                "p2p_peer_send_queue", "p2p_peer_send_queue_high_water",
+                "p2p_peer_last_recv_age_seconds"):
         assert fam in families, fam
         assert families[fam] == "gauge"
+    # round 15: the labeled per-peer gossip families are present (and
+    # typed) from the first scrape even with zero peers — family
+    # materialization is what makes churned series collapse instead of
+    # appearing late
+    for fam in ("p2p_peer_send_bytes_total", "p2p_peer_recv_bytes_total",
+                "p2p_peer_send_msgs_total", "p2p_peer_recv_msgs_total",
+                "p2p_peer_send_failures_total",
+                "p2p_peer_vote_gossip_picks_total",
+                "p2p_peer_vote_gossip_sends_total",
+                "p2p_peer_vote_gossip_send_failures_total",
+                "p2p_peer_catchup_commits_total"):
+        assert families.get(fam) == "counter", fam
     # the latency-distribution instruments render as real histograms
     for fam in ("devd_stream_chunk_seconds", "devd_single_shot_seconds",
                 "wal_fsync_seconds", "wal_group_records",
                 "gateway_hash_batch_seconds",
                 # round 14: the execution-pipeline distributions
                 "consensus_height_seconds", "pipeline_join_wait_seconds",
-                "pipeline_overlap_seconds"):
+                "pipeline_overlap_seconds",
+                # round 15: gossip-arrival distributions + per-peer RTT
+                "consensus_quorum_seconds", "consensus_first_part_seconds",
+                "p2p_peer_ping_rtt_seconds"):
         assert families.get(fam) == "histogram", fam
     # a live node has fsynced (group commit): the histogram has samples
     count = next(
@@ -381,3 +407,105 @@ def test_consensus_trace_rpc_segments_sum_to_wall(node, client):
     buf = io.StringIO()
     render(traces, out=buf)
     assert f"height {heights[0]}" in buf.getvalue()
+
+
+def test_consensus_trace_carries_gossip_arrivals(node, client):
+    """Round 15: every committed height's trace carries wall-clock
+    gossip arrival marks in causal order — the raw material the fleet
+    aggregator joins across nodes."""
+    assert wait_until(lambda: node.block_store.height() >= 2)
+    traces = client.consensus_trace(last=3)["traces"]
+    assert traces
+    for t in traces:
+        arr = t["arrivals"]
+        # a sole validator self-delivers its proposal: every mark exists
+        for key in ("proposal", "first_block_part", "prevote_quorum",
+                    "precommit_quorum", "commit"):
+            assert key in arr, (key, arr)
+        assert t["started_at"] <= arr["first_block_part"] + 1e-6
+        assert arr["first_block_part"] <= arr["prevote_quorum"] + 1e-6
+        assert arr["prevote_quorum"] <= arr["precommit_quorum"] + 1e-6
+        assert arr["precommit_quorum"] <= arr["commit"] + 1e-6
+        assert arr["commit"] <= t["completed_at"] + 1e-6
+
+
+def test_health_endpoint_contract(node, client):
+    """GET /health (round 15, node/health.py): a live committing node is
+    ok with every check reported machine-readably, and the same verdict
+    rides the flat node_health_* gauges."""
+    assert wait_until(lambda: node.block_store.height() >= 1)
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{node.rpc_port()}/health", timeout=10
+    ) as resp:
+        assert resp.status == 200
+        body = json.loads(resp.read().decode())
+    assert body["status"] == "ok" and body["code"] == 0
+    for check in ("height_age", "peers", "breaker", "wal", "pipeline",
+                  "mempool"):
+        assert check in body["checks"], body["checks"]
+        assert body["checks"][check]["status"] in ("ok", "degraded")
+    assert body["checks"]["height_age"]["age_s"] >= 0
+    assert body["checks"]["wal"]["open"] is True
+    assert body["checks"]["pipeline"]["poisoned"] is False
+    m = client.metrics()
+    assert m["node_health_status"] == 0
+    assert m["node_health_checks_failing"] == 0
+
+
+def test_health_thresholds_flip_degraded(node, client, monkeypatch):
+    """The env-knob thresholds govern the verdict live (the netchaos
+    tier tightens them the same way): an impossible height-age budget
+    flips the report to degraded, then failing — and the flat gauge
+    follows."""
+    from tendermint_tpu.node.health import health_report
+
+    monkeypatch.setenv("TENDERMINT_HEALTH_HEIGHT_AGE_DEGRADED_S", "0")
+    monkeypatch.setenv("TENDERMINT_HEALTH_HEIGHT_AGE_FAILING_S", "1e9")
+    report = health_report(node)
+    assert report["status"] == "degraded"
+    assert report["checks"]["height_age"]["status"] == "degraded"
+    assert client.metrics()["node_health_status"] == 1
+    monkeypatch.setenv("TENDERMINT_HEALTH_HEIGHT_AGE_FAILING_S", "0")
+    report = health_report(node)
+    assert report["status"] == "failing"
+    # ... and the endpoint answers 503 so k8s-style probes see it
+    import urllib.error
+
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{node.rpc_port()}/health", timeout=10
+        )
+        raise AssertionError("failing health must answer 503")
+    except urllib.error.HTTPError as exc:
+        assert exc.code == 503
+        assert json.loads(exc.read().decode())["status"] == "failing"
+
+
+def test_fleet_scrapes_single_node(node):
+    """ops/fleet against a live (single) node: the aggregator
+    reconstructs the per-height timeline purely from GET /metrics +
+    consensus_trace + GET /health scrapes."""
+    import io
+
+    from tendermint_tpu.ops import fleet
+
+    assert wait_until(lambda: node.block_store.height() >= 2)
+    url = f"127.0.0.1:{node.rpc_port()}"
+    snapshot = fleet.collect([url], last=5)
+    assert "error" not in snapshot[url], snapshot[url].get("error")
+    assert snapshot[url]["health"]["status"] in ("ok", "degraded")
+    rows = fleet.build_timeline(
+        {u: e["traces"] for u, e in snapshot.items()}, last=5
+    )
+    assert rows and rows[0]["height"] >= rows[-1]["height"]
+    for r in rows:
+        assert r["nodes_reporting"] == 1
+        assert r["precommit_quorum_s_max"] is not None
+        assert r["precommit_quorum_s_max"] >= 0
+        # one reporter: no cross-node spreads
+        assert r["commit_skew_s"] is None
+    summary = fleet.fleet_summary(snapshot)
+    assert summary[url]["height"] >= 2
+    buf = io.StringIO()
+    fleet.render(snapshot, rows, out=buf)
+    assert "health ok" in buf.getvalue() or "health degraded" in buf.getvalue()

@@ -645,22 +645,36 @@ def test_vote_gossip_marks_peer_only_on_successful_send():
     ps.prs.height, ps.prs.round_ = 5, 0
     ps.ensure_vote_bit_arrays(5, 4)
     vs = _VoteSet()
+    picks0 = ps.m_vote_picks.value
+    sends0 = ps.m_vote_sends.value
+    fails0 = ps.m_vote_send_failures.value
 
     # pick alone must not mark: the same vote stays pickable
     assert ps.pick_vote_to_send(vs) is not None
     assert ps.pick_vote_to_send(vs) is not None
+    assert ps.m_vote_picks.value == picks0  # picking alone never counts
 
-    # failed send: bit stays clear, the vote is retried later
+    # failed send: bit stays clear, the vote is retried later — AND the
+    # per-peer failure counter moves (round 15: the scrape-visible form
+    # of the PR-13 wedge — picks outrunning sends)
     failing = _Peer(ok=False)
     assert not ConsensusReactor._send_vote(None, failing, ps, _Vote())
     assert failing.sent == 1
     assert ps.pick_vote_to_send(vs) is not None, (
         "a failed send must leave the vote pickable"
     )
+    assert ps.m_vote_picks.value == picks0 + 1
+    assert ps.m_vote_sends.value == sends0
+    assert ps.m_vote_send_failures.value == fails0 + 1, (
+        "a failed vote send must increment the per-peer failure counter"
+    )
 
     # successful send: marked, never picked again
     assert ConsensusReactor._send_vote(None, _Peer(ok=True), ps, _Vote())
     assert ps.pick_vote_to_send(vs) is None
+    assert ps.m_vote_picks.value == picks0 + 2
+    assert ps.m_vote_sends.value == sends0 + 1
+    assert ps.m_vote_send_failures.value == fails0 + 1
 
 
 def test_last_commit_gossip_reaches_peer_in_a_later_round():
@@ -693,10 +707,17 @@ def test_last_commit_gossip_reaches_peer_in_a_later_round():
     ps = PeerState(peer=None)
     ps.prs.height, ps.prs.round_ = 5, 2  # raced past commit round 0
     ps.ensure_vote_bit_arrays(5, 4)     # tracks round 2, not round 0
+    catchups0 = ps.m_catchup_commits.value
     # the hole: without a catchup array at round 0, nothing is pickable
     assert ps.pick_vote_to_send(_LastCommit()) is None
-    # the fix: the height+1 gossip branch ensures the catchup round
+    # the fix: the height+1 gossip branch ensures the catchup round —
+    # and the engagement is COUNTED per peer (round 15: the catchup
+    # signal a fleet scrape alarms on instead of a frozen height vector)
     ps.ensure_catchup_commit_round(5, 0, 4)
+    assert ps.m_catchup_commits.value == catchups0 + 1
+    # re-ensuring the SAME round is a no-op, not a recount
+    ps.ensure_catchup_commit_round(5, 0, 4)
+    assert ps.m_catchup_commits.value == catchups0 + 1
     assert ps.pick_vote_to_send(_LastCommit()) is not None
     # and marking via set_has_vote lands in the SAME tracking array
     ps.set_has_vote(5, 0, VOTE_TYPE_PRECOMMIT, 0)

@@ -173,6 +173,74 @@ class TestLabels:
         assert total == 10
         assert c.labels(k=telemetry.OVERFLOW_LABEL).value == 6
 
+    def test_cardinality_bound_holds_for_labeled_histograms(self, reg):
+        """Round 15: the _other collapse must bound labeled HISTOGRAMS
+        too — a per-peer latency histogram under 100-peer churn stays at
+        the series cap, every observation lands somewhere, and the
+        overflow child is a real histogram (buckets, sum, count)."""
+        h = reg.histogram("peer_lat_seconds", labelnames=("peer",),
+                          buckets=(0.1, 1.0), max_series=8)
+        for i in range(100):  # 100-peer churn
+            h.labels(peer=f"peer{i}").observe(0.5)
+        assert h.series_count() <= 9  # 8 + the shared overflow series
+        assert h.dropped_series == 100 - 8
+        total = sum(child.count for _k, child in h._items())
+        assert total == 100, "observations must survive the collapse"
+        over = h.labels(peer=telemetry.OVERFLOW_LABEL)
+        assert over.count == 92
+        counts, total_sum, count = over.snapshot()
+        assert counts[1] == 92 and count == 92  # 0.5 -> le=1.0 bucket
+        assert total_sum == pytest.approx(92 * 0.5)
+        # ... and the rendered exposition stays parseable and bounded
+        text = reg.render_prometheus()
+        bucket_lines = [l for l in text.splitlines()
+                        if l.startswith("peer_lat_seconds_bucket")]
+        assert len(bucket_lines) == h.series_count() * 3  # 2 bounds + +Inf
+
+    def test_remove_labels_drops_series_and_frees_slot(self, reg):
+        """Round 15: staleness cleanup — a removed child vanishes from
+        the exposition and its slot counts against the cardinality
+        bound again (churned-out peers must disappear, not freeze)."""
+        g = reg.gauge("peer_age", labelnames=("peer",), max_series=2)
+        g.labels(peer="a").set(1)
+        g.labels(peer="b").set(2)
+        g.labels(peer="c").set(3)  # over the bound -> _other
+        assert g.labels(peer="c") is g.labels(peer=telemetry.OVERFLOW_LABEL)
+        g.remove_labels(peer="a")
+        assert 'peer="a"' not in reg.render_prometheus()
+        # freed slots admit a new real series instead of overflowing
+        # (the retained _other series occupies one slot itself)
+        g.remove_labels(peer="b")
+        g.labels(peer="d").set(4)
+        assert g.labels(peer="d") is not g.labels(
+            peer=telemetry.OVERFLOW_LABEL
+        )
+        g.remove_labels(peer="missing")  # no-op
+        with pytest.raises(KeyError):
+            g.remove_labels(wrong="a")
+
+    def test_per_family_max_series_env_override(self, monkeypatch):
+        """TENDERMINT_TELEMETRY_MAX_SERIES_<FAMILY> (round 15) overrides
+        the global bound for one family; a typo'd value keeps the
+        default (envknob contract)."""
+        monkeypatch.setenv("TENDERMINT_TELEMETRY_MAX_SERIES", "16")
+        monkeypatch.setenv(
+            "TENDERMINT_TELEMETRY_MAX_SERIES_NARROW_TOTAL", "2"
+        )
+        reg = Registry()
+        narrow = reg.counter("narrow_total", labelnames=("k",))
+        wide = reg.counter("other_total", labelnames=("k",))
+        for i in range(10):
+            narrow.labels(k=f"v{i}").inc()
+            wide.labels(k=f"v{i}").inc()
+        assert narrow.series_count() <= 3  # 2 + overflow
+        assert wide.series_count() == 10   # global 16 still governs
+        assert telemetry.family_max_series("narrow_total") == 2
+        monkeypatch.setenv(
+            "TENDERMINT_TELEMETRY_MAX_SERIES_NARROW_TOTAL", "oops"
+        )
+        assert telemetry.family_max_series("narrow_total") == 16
+
 
 # -- registry rendering --------------------------------------------------------
 
@@ -307,6 +375,18 @@ class TestRegistry:
         reg.register_producer("weird", lambda: {"a-b.c": 1})
         text = reg.render_prometheus()
         assert "weird_a_b_c 1" in text
+
+    def test_on_collect_hook_refreshes_before_instruments_render(self):
+        """Round 15: a pre-collect hook runs before instruments are
+        gathered, so a point-in-time labeled gauge (per-peer last-recv
+        age) is fresh in the SAME scrape — not one scrape stale."""
+        reg = Registry()
+        g = reg.gauge("age_seconds", labelnames=("peer",))
+        box = {"v": 1.0}
+        reg.on_collect(lambda: g.labels(peer="a").set(box["v"]))
+        assert 'age_seconds{peer="a"} 1.0' in reg.render_prometheus()
+        box["v"] = 2.5
+        assert 'age_seconds{peer="a"} 2.5' in reg.render_prometheus()
 
 
 class TestTraceRecorder:
