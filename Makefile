@@ -24,7 +24,7 @@ test:
 # hold (dots no worse than the seed) — plus the chip-free hash-stream
 # smoke (the two asserted BENCH_r07 rows: streamed hash offload >= 1.3x
 # single-shot on the sim transport, flat host builder >= 1.5x recursive).
-tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke pipeline-smoke fleet-smoke
+tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke pipeline-smoke fleet-smoke committee-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Chip-free bench smoke: every BASELINE config on the pinned CPU backend,
@@ -108,6 +108,16 @@ pipeline-smoke:
 fleet-smoke:
 	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_FLEET_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_fleet.py
 
+# Big-committee smoke, chip-free (~10 s): bench_committee.py's reduced
+# pass — a LIVE 100-validator consensus run (in-process committee pump)
+# batched vs per-vote vote verification with per-height byte-identity
+# (block hash / part-set root / app hash) asserted and batched >= 1.3x
+# per-vote blocks/s asserted, plus the commit-verify and
+# aggregate-commit object rows at 4/100 validators (the full 4-400
+# matrix writes BENCH_r16.json). Runs as part of `make tier1`.
+committee-smoke:
+	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_COMMITTEE_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_committee.py
+
 # Telemetry smoke, chip-free (~20 s): bench_telemetry.py's reduced pass —
 # boot a node, scrape GET /metrics (valid 0.0.4 text, >= 40 families
 # spanning every plane), pull one consensus_trace (segments sum to the
@@ -129,4 +139,4 @@ test_slow:
 native:
 	$(MAKE) -C native
 
-.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke pipeline-smoke fleet-smoke
+.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke pipeline-smoke fleet-smoke committee-smoke

@@ -54,6 +54,13 @@ Configs (BASELINE.md):
                   partition arm detected+healed off /health, per-peer
                   instrumentation overhead bounded <2% (writes
                   BENCH_r15.json; chip-free)
+ 16 committee    — big-committee vote plane: live 100-400-validator
+                  consensus (in-process committee pump) batched vs
+                  per-vote vote-signature verification — byte-identical
+                  chains asserted, batched >= 1.3x at 100 validators —
+                  plus commit-verify latency and aggregate-commit size
+                  rows vs validator count (writes BENCH_r16.json;
+                  chip-free, devd rows auto-join when a daemon serves)
  13 statetree    — authenticated app-state commitment: incremental
                   commit vs full tree rebuild, proof correctness rows,
                   delta-vs-full snapshot bytes (delta asserted <= 0.5x
@@ -92,6 +99,7 @@ BENCHES = {
     "13_statetree": [sys.executable, "benches/bench_statetree.py"],
     "14_pipeline": [sys.executable, "benches/bench_pipeline.py"],
     "15_fleet": [sys.executable, "benches/bench_fleet.py"],
+    "16_committee": [sys.executable, "benches/bench_committee.py"],
 }
 
 

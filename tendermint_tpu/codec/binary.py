@@ -117,6 +117,20 @@ class Decoder:
     def read_u8(self) -> int:
         return self._take(1)[0]
 
+    def peek_u8(self) -> int:
+        """The next byte without consuming it — format-tag dispatch
+        (types/agg_commit.decode_commit reads the aggregate-commit
+        magic off it)."""
+        if self.off >= len(self.buf):
+            raise ValueError("unexpected end of buffer")
+        return self.buf[self.off]
+
+    def read_raw(self, n: int) -> bytes:
+        """Exactly n bytes, no length prefix — the mirror of
+        Encoder.write_raw for fixed-width fields (32-byte points,
+        folded scalars)."""
+        return self._take(n)
+
     def read_u16(self) -> int:
         return struct.unpack(">H", self._take(2))[0]
 

@@ -73,18 +73,38 @@ class HeightVoteSet:
         peers beyond the catchup budget are silently dropped (returns
         False, mirroring ErrGotVoteFromUnwantedRound)."""
         with self._mtx:
-            if not self._is_vote_type_tracked(vote.type_):
-                return False
-            vs = self._get_vote_set(vote.round_, vote.type_)
+            vs = self._resolve_vote_set(vote, peer_id)
             if vs is None:
-                rounds = self._peer_catchup_rounds.setdefault(peer_id, [])
-                if len(rounds) < MAX_CATCHUP_ROUNDS:
-                    self._add_round(vote.round_)
-                    vs = self._get_vote_set(vote.round_, vote.type_)
-                    rounds.append(vote.round_)
-                else:
-                    return False  # punish peer?
-            return vs.add_vote(vote, verifier=verifier)
+                return False
+        return vs.add_vote(vote, verifier=verifier)
+
+    def begin_add(self, vote: Vote, peer_id: str = ""):
+        """Split-add entry (round 16, types/vote_set.py PendingVote):
+        resolves the round's VoteSet — creating a catchup round within
+        the per-peer budget exactly as add_vote would — and runs its
+        structural half. None = dropped/duplicate (add_vote's False);
+        commit via the returned entry's .commit(ok)."""
+        with self._mtx:
+            vs = self._resolve_vote_set(vote, peer_id)
+            if vs is None:
+                return None
+        return vs.begin_add(vote)
+
+    def _resolve_vote_set(self, vote: Vote, peer_id: str):
+        """The add-side round lookup + catchup-budget bookkeeping
+        (callers hold self._mtx)."""
+        if not self._is_vote_type_tracked(vote.type_):
+            return None
+        vs = self._get_vote_set(vote.round_, vote.type_)
+        if vs is None:
+            rounds = self._peer_catchup_rounds.setdefault(peer_id, [])
+            if len(rounds) < MAX_CATCHUP_ROUNDS:
+                self._add_round(vote.round_)
+                vs = self._get_vote_set(vote.round_, vote.type_)
+                rounds.append(vote.round_)
+            else:
+                return None  # punish peer?
+        return vs
 
     @staticmethod
     def _is_vote_type_tracked(t: int) -> bool:

@@ -8,11 +8,14 @@ NewHeight → NewRound → Propose → Prevote(+Wait) → Precommit(+Wait) →
 Commit (consensus/state.go:604-659). That single-owner discipline is what
 makes WAL replay deterministic.
 
-TPU integration: vote signatures verify through `verifier.vote_verifier()`
-(one-at-a-time arrival → CPU latency path) and block validation's
-VerifyCommit through `verifier.commit_batch_verifier()` (wide batch → TPU
-kernel), both from ops.gateway. Accept/reject semantics are identical to
-the reference's sequential loops.
+TPU integration: gossiped vote signatures ride the round-16 VoteBatcher
+(consensus/vote_batcher.py — the receive routine drains each queued run
+into ONE `verifier.verify_batch_async` gateway call per (height, round,
+type) group, per-lane verdicts popped by each add_vote; singletons take
+the CPU latency path) and block validation's VerifyCommit rides
+`verifier.commit_batch_verifier()` (wide batch → TPU kernel), both from
+ops.gateway. Accept/reject semantics are identical to the reference's
+sequential loops.
 
 Pipelined execution (round 14, docs/execution-pipeline.md): with
 ``config.pipeline_apply`` (default on), finalize_commit stages the
@@ -50,6 +53,7 @@ from dataclasses import dataclass
 from tendermint_tpu.consensus import messages as msgs
 from tendermint_tpu.consensus import pipeline as cpipeline
 from tendermint_tpu.consensus import trace as ctrace
+from tendermint_tpu.consensus import vote_batcher as cvb
 from tendermint_tpu.consensus.height_vote_set import HeightVoteSet
 from tendermint_tpu.consensus.round_state import RoundState, RoundStep
 from tendermint_tpu.consensus.ticker import TickerI, TimeoutInfo, TimeoutTicker
@@ -154,6 +158,16 @@ class ConsensusState(BaseService):
         # test/bench seam: height -> block time_ns for deterministic
         # cross-run block bytes (None = wall clock, the default)
         self.propose_time_source = None
+
+        # big-committee vote plane (round 16, docs/committee.md): the
+        # receive routine drains each run of gossiped votes into
+        # per-(height, round, type) micro-batches — ONE gateway call per
+        # group — and every add_vote pops its per-lane verdict.
+        # vote_batching=False (bench A/B seam) restores the true
+        # one-signature-at-a-time path; replay never batches (the WAL
+        # feeds messages outside the receive routine's drain).
+        self.vote_batching = True
+        self.vote_batcher = cvb.VoteBatcher(lambda: self.verifier)
 
         # duplicate-vote evidence (beyond reference: state.go:1438-1447
         # punts with a TODO; we record validated pairs — types/evidence)
@@ -435,6 +449,23 @@ class ConsensusState(BaseService):
             VOTE_TYPE_PRECOMMIT,
             state.last_validators,
         )
+        # one gateway batch for the whole seen commit (round 16): each
+        # add_vote's verify_one below pops its primed lane instead of
+        # paying a cold-start serial verify per precommit
+        items = []
+        sb_cache: dict[bytes, bytes] = {}  # quorum = ONE canonical payload
+        for pc in seen_commit.precommits:
+            if pc is None or pc.signature is None:
+                continue
+            _, val = state.last_validators.get_by_index(pc.validator_index)
+            if val is not None:
+                sbk = pc.block_id.key()
+                sb = sb_cache.get(sbk)
+                if sb is None:
+                    sb = sb_cache[sbk] = pc.sign_bytes(state.chain_id)
+                items.append((val.pub_key.raw, sb, pc.signature.raw))
+        if len(items) >= 2:
+            self.verifier.prime_cache(items)
         for pc in seen_commit.precommits:
             if pc is None:
                 continue
@@ -525,10 +556,12 @@ class ConsensusState(BaseService):
             if tag == "quit":
                 return
             # When a vote heads a burst, drain the already-queued run and
-            # batch-verify the signatures ahead of dispatch (SURVEY §7):
-            # each item is then handled strictly in order — WAL layout and
-            # observable accept/reject are identical to one-at-a-time —
-            # but the signature work rode one batched kernel call.
+            # batch-verify the signatures ahead of dispatch (SURVEY §7;
+            # round 16 groups per (height, round, type) through the
+            # VoteBatcher): each item is then handled strictly in order —
+            # WAL layout and observable accept/reject are identical to
+            # one-at-a-time — but the signature work rode one batched
+            # gateway call per group.
             batch = [(tag, item)]
             if max_steps == 0 and tag == "msg" and isinstance(item.msg, msgs.VoteMessage):
                 while len(batch) < 512:
@@ -537,15 +570,18 @@ class ConsensusState(BaseService):
                     except queue.Empty:
                         break
                 try:
-                    self._prime_vote_batch(
-                        [
-                            i.msg.vote
-                            for t, i in batch
-                            if t == "msg" and isinstance(i.msg, msgs.VoteMessage)
-                        ]
-                    )
+                    if self.vote_batching and not self.replay_mode:
+                        self.vote_batcher.prepare(
+                            [
+                                i.msg.vote
+                                for t, i in batch
+                                if t == "msg" and isinstance(i.msg, msgs.VoteMessage)
+                            ],
+                            self.rs,
+                            self.state.chain_id,
+                        )
                 except Exception:
-                    # priming is purely an accelerator over adversarial
+                    # batching is purely an accelerator over adversarial
                     # input — it must never kill the receive routine
                     self.logger.exception("vote verify-ahead failed; falling through")
             for tag, item in batch:
@@ -567,51 +603,6 @@ class ConsensusState(BaseService):
                         self.handle_txs_available(self.rs.height)
                 except Exception:
                     self.logger.exception("error in receive routine handling %s", tag)
-
-    def _prime_vote_batch(self, votes: list[Vote]) -> None:
-        """Verify-ahead for a drained run of gossiped votes: batch the
-        signatures into one gateway call (TPU when wide enough) so the
-        per-vote verify inside VoteSet.add_vote becomes a cache pop.
-        Purely an accelerator — skipped votes (wrong height, unknown
-        validator, already in the set) just verify on CPU as before, and
-        WAL replay feeds votes one at a time so it never primes.
-
-        Pipeline note: priming deliberately does NOT join a pending
-        deferred apply — against a provisional validator set it would at
-        worst prime cache entries nobody pops (wasted work on the rare
-        valset-change height, never a wrong verdict: add_vote joins
-        before any verify consults the set)."""
-        if len(votes) < 2:
-            return
-        rs = self.rs
-        items, seen = [], set()
-        for v in votes:
-            if v.height != rs.height or v.signature is None:
-                continue
-            # validator lookup FIRST: it bounds-checks the index, which
-            # VoteSet.get_by_index below does not — an adversarial index
-            # must fall through to add_vote's error taxonomy, not raise
-            addr, val = rs.validators.get_by_index(v.validator_index)
-            if val is None or addr != v.validator_address:
-                continue
-            vs = (
-                rs.votes.prevotes(v.round_)
-                if v.type_ == VOTE_TYPE_PREVOTE
-                else rs.votes.precommits(v.round_)
-            ) if rs.votes is not None else None
-            if vs is not None and vs.get_by_index(v.validator_index) is not None:
-                continue  # duplicate gossip: add_vote returns before verify
-            item = (val.pub_key.raw, v.sign_bytes(self.state.chain_id), v.signature.raw)
-            if item in seen:
-                continue
-            seen.add(item)
-            items.append(item)
-        if len(items) >= 2:
-            # async prime: the batch is ON the device (streamed chunks
-            # when the devd backend serves) while this thread gets on
-            # with VoteSet bookkeeping; the first add_vote needing a
-            # verdict blocks inside its verify_one pop
-            self.verifier.prime_cache_async(items)
 
     def handle_msg(self, mi: MsgInfo) -> None:
         """consensus/state.go:662-698."""
@@ -1484,7 +1475,10 @@ class ConsensusState(BaseService):
             if val is None:
                 return
             ev = DuplicateVoteEvidence.new(val.pub_key, vote_a, vote_b)
-            if self.evidence_pool.add(ev, self.state.chain_id):
+            if self.evidence_pool.add(
+                ev, self.state.chain_id,
+                batch_verifier=self.verifier.commit_batch_verifier(),
+            ):
                 self.logger.warning(
                     "recorded duplicate-vote evidence: val %s at %d/%d/%d",
                     vote_a.validator_address.hex()[:12], vote_a.height,
@@ -1504,7 +1498,7 @@ class ConsensusState(BaseService):
                 return False
             if rs.last_commit is None:
                 return False
-            added = rs.last_commit.add_vote(vote, verifier=self.verifier.vote_verifier())
+            added = self._split_add(rs.last_commit, vote)
             if added:
                 self.logger.info("added to last_commit: %r", rs.last_commit)
                 self._fire(tev.EVENT_VOTE, tev.EventDataVote(vote))
@@ -1522,7 +1516,7 @@ class ConsensusState(BaseService):
         # the provisional set crypto-invisible (no H+1 vote is ever
         # checked against it)
         self._join_apply("add_vote")
-        added = rs.votes.add_vote(vote, peer_id, verifier=self.verifier.vote_verifier())
+        added = self._split_add(rs.votes, vote, peer_id)
         if not added:
             return False
         self._fire(tev.EVENT_VOTE, tev.EventDataVote(vote))
@@ -1532,6 +1526,23 @@ class ConsensusState(BaseService):
         elif vote.type_ == VOTE_TYPE_PRECOMMIT:
             self._handle_added_precommit(vote)
         return added
+
+    def _split_add(self, vote_set, vote: Vote, peer_id: str | None = None) -> bool:
+        """The round-16 split-add flow (docs/committee.md): synchronous
+        structural checks produce a pending entry, its signature verdict
+        comes from the micro-batch the receive routine dispatched over
+        the drained run (VoteBatcher.prepare) — a singleton CPU verify on
+        any miss — and commit applies it with add_vote's exact error
+        taxonomy, so one bad signature rejects only its own vote. Replay
+        and vote_batching=False never see a dispatched batch, making
+        every lane a deterministic singleton by construction."""
+        if peer_id is None:
+            pending = vote_set.begin_add(vote)  # last_commit VoteSet
+        else:
+            pending = vote_set.begin_add(vote, peer_id)  # HeightVoteSet
+        if pending is None:
+            return False  # duplicate / unwanted round (add_vote's False)
+        return pending.commit(self.vote_batcher.verdict(pending.item()))
 
     def _handle_added_prevote(self, vote: Vote) -> None:
         """consensus/state.go:1500-1534."""

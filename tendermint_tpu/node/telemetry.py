@@ -35,8 +35,9 @@ plus the process-wide instruments the default registry carries
 wal_fsync_seconds / wal_group_records, mempool_sig_gate_batch_seconds,
 gateway_hash_batch_seconds, the round-14 execution-pipeline histograms
 consensus_height_seconds / pipeline_join_wait_seconds /
-pipeline_overlap_seconds, faults_*, p2p_secretconn_* transport
-counters, netfaults_* network-chaos aggregates).
+pipeline_overlap_seconds, the round-16 vote-plane histogram
+consensus_vote_verify_batch_seconds, faults_*, p2p_secretconn_*
+transport counters, netfaults_* network-chaos aggregates).
 
 ``legacy=True`` producers make up the byte-compatible metrics-RPC dict;
 ``legacy=False`` ones are scrape-only, so the legacy flat key set never
@@ -61,6 +62,7 @@ def build_registry(node) -> telemetry.Registry:
     from tendermint_tpu import devd
     from tendermint_tpu.consensus import pipeline as cpipeline
     from tendermint_tpu.consensus import trace as ctrace
+    from tendermint_tpu.consensus import vote_batcher as cvb
     from tendermint_tpu.ops import faults  # noqa: F401 — import = register
     from tendermint_tpu.p2p import secret_connection
     from tendermint_tpu.p2p import telemetry as p2p_telemetry
@@ -68,6 +70,7 @@ def build_registry(node) -> telemetry.Registry:
     devd._latency_hists()
     secret_connection._counters()
     cpipeline.pipeline_hists()
+    cvb.vote_batch_hists()
 
     reg = telemetry.Registry(parent=telemetry.default_registry())
     cs = node.consensus_state
@@ -103,6 +106,14 @@ def build_registry(node) -> telemetry.Registry:
             "pipeline_serial_commits": cs.pipeline_serial_commits,
             "pipeline_join_wait_seconds": round(cs.pipeline_join_wait_last, 6),
             "pipeline_overlap_seconds": round(cs.pipeline_overlap_last, 6),
+            # big-committee vote plane (round 16): micro-batches the
+            # receive routine dispatched, the signature lanes they
+            # carried, and the verdicts that fell to the one-sig path
+            # (latency distribution: consensus_vote_verify_batch_seconds
+            # on GET /metrics)
+            "vote_batches": cs.vote_batcher.batches,
+            "vote_batched_sigs": cs.vote_batcher.batched_sigs,
+            "vote_singletons": cs.vote_batcher.singletons,
         }
 
     reg.register_producer("consensus", consensus)

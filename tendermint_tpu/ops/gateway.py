@@ -534,19 +534,25 @@ class _PendingBatch:
     moment the device answers — so the transport is ALWAYS drained (a
     devd stream whose resolver never ran would strand its connection and
     the daemon's sender), even when no verify_one ever pops an item
-    (FIFO eviction, re-primed duplicates). result_for just waits."""
+    (FIFO eviction, re-primed duplicates). result_for just waits.
+    `on_done(dt_s)` fires once on successful resolution with the
+    dispatch→verdicts wall time (the round-16 vote plane's batch
+    histogram rides it)."""
 
     __slots__ = ("_done", "_event")
 
-    def __init__(self, items: list[Item], resolve):
+    def __init__(self, items: list[Item], resolve, on_done=None):
         self._done: dict[Item, bool] = {}
         self._event = threading.Event()
+        t0 = time.monotonic()
 
         def materialize() -> None:
             try:
                 self._done.update(
                     (it, bool(ok)) for it, ok in zip(items, resolve())
                 )
+                if on_done is not None:
+                    on_done(time.monotonic() - t0)
             except Exception:  # noqa: BLE001 — round-8 latch sweep:
                 # genuinely unconditional, NOT breaker business. The
                 # resolver underneath already did the breaker accounting
@@ -785,16 +791,25 @@ class Verifier:
         res = _cpu_verify_batch(items)
         return lambda: res
 
+    def pop_primed(self, item: Item) -> bool | None:
+        """Pop (single-use) the primed verdict for one item: True/False
+        from a resolved batch, None if never primed, FIFO-evicted, or
+        the batch failed to resolve — the caller re-verifies. The
+        round-16 VoteBatcher reads its batched-vs-singleton accounting
+        off this; verify_one is pop_primed + the CPU fallback."""
+        with self._mtx:
+            primed = self._primed.pop(item, None)
+        if isinstance(primed, _PendingBatch):
+            # wait OUTSIDE the mutex: this blocks on the device
+            primed = primed.result_for(item)
+        return primed
+
     def verify_one(self, pubkey: bytes, msg: bytes, sig: bytes) -> bool:
         """Single-signature path (vote-by-vote arrival). A result primed
         by prime_cache is consumed here without re-verifying; otherwise
         CPU — latency over throughput. Exists so VoteSet can take one
         pluggable callable."""
-        with self._mtx:
-            primed = self._primed.pop((pubkey, msg, sig), None)
-        if isinstance(primed, _PendingBatch):
-            # wait OUTSIDE the mutex: this blocks on the device
-            primed = primed.result_for((pubkey, msg, sig))
+        primed = self.pop_primed((pubkey, msg, sig))
         if primed is not None:
             return primed
         with self._mtx:
@@ -816,17 +831,19 @@ class Verifier:
             while len(self._primed) > self._primed_cap:
                 self._primed.pop(next(iter(self._primed)))
 
-    def prime_cache_async(self, items: list[Item]) -> None:
+    def prime_cache_async(self, items: list[Item], on_done=None) -> None:
         """Pipelined prime_cache: dispatch the batch to the device NOW
         (verify_batch_async — streamed chunks on the devd backend) and
         park a pending handle per item; the first verify_one to pop one
         blocks for the batch verdicts. The caller's host work between
-        dispatch and first pop (vote-set bookkeeping, canonical-dup
-        checks in consensus/state._prime_vote_batch) overlaps marshal,
-        IPC, and device compute instead of serializing behind them."""
+        dispatch and first pop (vote-set bookkeeping, the VoteBatcher's
+        prepare-time screening in consensus/vote_batcher.py) overlaps
+        marshal, IPC, and device compute instead of serializing behind
+        them. `on_done(dt_s)` observes the dispatch→verdicts wall time
+        on successful resolution."""
         if not items:
             return
-        pending = _PendingBatch(items, self.verify_batch_async(items))
+        pending = _PendingBatch(items, self.verify_batch_async(items), on_done)
         with self._mtx:
             for it in items:
                 self._primed[it] = pending

@@ -184,11 +184,17 @@ class LightClient:
     ) -> None:
         """Condition (d) of advance(): > 2/3 of the OLD trusted set's
         power signed commit(height), counting each precommit under the
-        NEW set's index order but crediting the OLD set's power."""
+        NEW set's index order but crediting the OLD set's power.
+
+        Round 16: with `batch_verifier` wired, the structural filter runs
+        first and every candidate signature flushes in ONE gateway batch
+        (the turnover check was the last per-sig loop on the light walk);
+        per-lane verdicts feed the same tally, so accept/reject is
+        byte-identical to the sequential loop."""
         old = self.validators
-        signed_old_power = 0
+        candidates = []  # (old_val, sign_bytes, signature)
         for idx, pre in enumerate(commit.precommits):
-            if pre is None:
+            if pre is None or pre.signature is None:
                 continue
             # only precommits FOR this commit's block at this height count:
             # commit_tally tolerates valid precommits for other block ids
@@ -209,10 +215,20 @@ class LightClient:
             _, old_val = old.get_by_address(val.address)
             if old_val is None:
                 continue
-            if old_val.pub_key.verify_bytes(
-                pre.sign_bytes(self.chain_id), pre.signature
-            ):
-                signed_old_power += old_val.voting_power
+            candidates.append(
+                (old_val, pre.sign_bytes(self.chain_id), pre.signature)
+            )
+        if self.batch_verifier is not None:
+            oks = self.batch_verifier(
+                [(v.pub_key.raw, sb, sig.raw) for v, sb, sig in candidates]
+            )
+        else:
+            oks = [
+                v.pub_key.verify_bytes(sb, sig) for v, sb, sig in candidates
+            ]
+        signed_old_power = sum(
+            v.voting_power for (v, _, _), ok in zip(candidates, oks) if ok
+        )
         if signed_old_power * 3 <= old.total_voting_power() * 2:
             raise LightClientError(
                 f"validator change at {height}: trusted set signed only "

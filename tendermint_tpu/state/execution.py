@@ -87,12 +87,16 @@ def validate_block(state: State, block, batch_verifier=None) -> None:
 
     # the evidence section is PROPOSER-CONTROLLED input: every piece must
     # be a provable prior-height double-sign by a validator of this chain
-    # before any honest node prevotes the block (types/evidence.py)
+    # before any honest node prevotes the block (types/evidence.py);
+    # round 16 routes every piece's signatures through the same batch
+    # verifier the commit above rode — one gateway call, per-lane
+    # attribution
     from tendermint_tpu.types.evidence import EvidenceError
 
     try:
         block.evidence.validate(
-            state.chain_id, block.header.height, state.validators
+            state.chain_id, block.header.height, state.validators,
+            batch_verifier=batch_verifier,
         )
     except EvidenceError as e:
         raise InvalidBlockError(f"invalid evidence: {e}") from e

@@ -1,0 +1,257 @@
+"""Aggregate commit prototype (round 16, docs/committee.md): +2/3
+precommits as ONE object instead of N full signed votes.
+
+A full ``Commit`` carries every precommit wholesale — address, index,
+height, round, type, block id, and a 64-byte signature per validator:
+~150+ bytes each, ~60 KB of every block and every commit-gossip message
+at N=400. The precommits that actually form the quorum all sign the SAME
+canonical payload (vote sign-bytes exclude the validator identity), so
+the whole section compresses to: the block id, (height, round), a signer
+bit array over the validator set, one 32-byte nonce point R per signer,
+and a single folded scalar — Ed25519 half-aggregation
+(crypto/ed25519_agg.py). That is ~32 bytes per signer instead of ~150:
+the gossip-bytes shrink that makes million-user-scale committees
+plausible (arXiv 2302.00418's aggregated design point).
+
+What the format gives up: precommits for OTHER blocks (tolerated in a
+full Commit as round evidence, never counted toward quorum) cannot join
+the aggregate and are dropped at conversion — the aggregate carries
+exactly the quorum.
+
+Format flag + mixed-net story: the wire form leads with a magic tag byte
+(0xAC) no full Commit can start with (a Commit's first byte is its block
+hash's varint length — 0x00 or 0x14), and ``decode_commit`` only accepts
+it when the chain's genesis says ``commit_format: "aggregate"``
+(types/genesis.py). A full-format node fed an aggregate commit refuses
+LOUDLY at decode, and the genesis docs themselves differ byte-for-byte —
+a mixed net cannot silently form. This is a PROTOTYPE: blocks and the
+block store still carry full commits; the object, wire form, verifier,
+flag, and refusal path are real, the consensus-rule cutover (headers
+committing to aggregate last-commit hashes) is queued in ROADMAP.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.codec.binary import Decoder, Encoder
+from tendermint_tpu.crypto import ed25519_agg
+from tendermint_tpu.libs.bitarray import BitArray
+from tendermint_tpu.types.block import Commit
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.validator_set import CommitError, ValidatorSet
+from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT, Vote
+
+# leading wire byte; a full Commit starts with its block-id hash's varint
+# length byte (0x00 empty / 0x14 twenty) — never this
+AGG_COMMIT_TAG = 0xAC
+
+MAX_AGG_SIGNERS = 1 << 16
+
+
+class AggregateCommit:
+    """(block_id, height, round, signer bits, R per signer, s_agg)."""
+
+    def __init__(self, block_id: BlockID, height: int, round_: int,
+                 signers: BitArray, rs: list[bytes], s_agg: bytes):
+        self.block_id = block_id
+        self.height = height
+        self.round_ = round_
+        self.signers = signers
+        self.rs = rs
+        self.s_agg = s_agg
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_commit(cls, commit: Commit, chain_id: str,
+                    val_set: ValidatorSet) -> "AggregateCommit":
+        """Aggregate a full Commit's quorum precommits. Only ed25519
+        precommits FOR the commit's block join (off-block precommits and
+        other key types cannot — see module docstring); raises
+        CommitError if what remains cannot carry +2/3 of `val_set`."""
+        height, round_ = commit.height(), commit.round_()
+        items, idxs, power = [], [], 0
+        for idx, pre in enumerate(commit.precommits):
+            if pre is None or pre.signature is None:
+                continue
+            if (
+                pre.block_id != commit.block_id
+                or pre.height != height
+                or pre.round_ != round_
+                or len(pre.signature.raw) != 64
+            ):
+                continue
+            _, val = val_set.get_by_index(idx)
+            if val is None or len(val.pub_key.raw) != 32:
+                continue
+            items.append(
+                (val.pub_key.raw, pre.sign_bytes(chain_id), pre.signature.raw)
+            )
+            idxs.append(idx)
+            power += val.voting_power
+        if power * 3 <= val_set.total_voting_power() * 2:
+            raise CommitError(
+                f"aggregable precommits carry only {power}/"
+                f"{val_set.total_voting_power()} power"
+            )
+        rs, s_agg = ed25519_agg.aggregate(items)
+        return cls(
+            commit.block_id, height, round_,
+            BitArray.from_indices(val_set.size(), idxs), rs, s_agg,
+        )
+
+    # -- verification ------------------------------------------------------
+
+    def sign_message(self, chain_id: str) -> bytes:
+        """The ONE canonical payload every aggregated lane signed (vote
+        sign-bytes exclude the validator identity)."""
+        return Vote(
+            validator_address=b"", validator_index=0, height=self.height,
+            round_=self.round_, type_=VOTE_TYPE_PRECOMMIT,
+            block_id=self.block_id,
+        ).sign_bytes(chain_id)
+
+    def verify(self, chain_id: str, val_set: ValidatorSet) -> None:
+        """Raise CommitError unless the aggregate carries +2/3 of
+        `val_set` AND the half-aggregate equation holds for every signer
+        lane — the whole commit's crypto in one multi-term check."""
+        idxs = self.signers.indices()
+        if self.signers.size != val_set.size():
+            raise CommitError(
+                f"wrong set size: {self.signers.size} vs {val_set.size()}"
+            )
+        if len(idxs) != len(self.rs):
+            raise CommitError(
+                f"signer bits ({len(idxs)}) != nonce points ({len(self.rs)})"
+            )
+        pubs, power = [], 0
+        for idx in idxs:
+            _, val = val_set.get_by_index(idx)
+            if val is None:
+                raise CommitError(f"signer index {idx} not in the set")
+            if len(val.pub_key.raw) != 32:
+                raise CommitError(f"signer {idx} is not an ed25519 key")
+            pubs.append(val.pub_key.raw)
+            power += val.voting_power
+        if power * 3 <= val_set.total_voting_power() * 2:
+            raise CommitError(
+                f"insufficient voting power: got {power}, "
+                f"needed {val_set.total_voting_power() * 2 // 3 + 1}"
+            )
+        msg = self.sign_message(chain_id)
+        if not ed25519_agg.verify_aggregate(
+            pubs, [msg] * len(pubs), self.rs, self.s_agg
+        ):
+            raise CommitError("aggregate signature failed verification")
+
+    # -- wire --------------------------------------------------------------
+
+    def encode(self, e: Encoder) -> None:
+        e.write_u8(AGG_COMMIT_TAG)
+        self.block_id.encode(e)
+        e.write_varint(self.height)
+        e.write_varint(self.round_)
+        e.write_varint(self.signers.size)
+        e.write_list(self.signers.indices(), lambda enc, i: enc.write_varint(i))
+        e.write_raw(b"".join(self.rs))
+        e.write_raw(self.s_agg)
+
+    def to_bytes(self) -> bytes:
+        e = Encoder()
+        self.encode(e)
+        return e.buf()
+
+    @classmethod
+    def decode(cls, d: Decoder) -> "AggregateCommit":
+        if d.read_u8() != AGG_COMMIT_TAG:
+            raise ValueError("not an aggregate commit")
+        block_id = BlockID.decode(d)
+        height = d.read_varint()
+        round_ = d.read_varint()
+        size = d.read_varint()
+        if not 0 < size <= MAX_AGG_SIGNERS:
+            raise ValueError(f"bad signer-set size {size}")
+        idxs = d.read_list(lambda dec: dec.read_varint())
+        if len(idxs) > size or any(not 0 <= i < size for i in idxs):
+            raise ValueError("signer index out of range")
+        # strictly ascending is the canonical (and only) wire order:
+        # verify() pairs rs with signers.indices() (sorted), so any
+        # other order would mispair lanes and reject a valid aggregate
+        if any(a >= b for a, b in zip(idxs, idxs[1:])):
+            raise ValueError("signer indices not strictly ascending")
+        rs = [d.read_raw(32) for _ in range(len(idxs))]
+        s_agg = d.read_raw(32)
+        return cls(block_id, height, round_,
+                   BitArray.from_indices(size, idxs), rs, s_agg)
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "AggregateCommit":
+        d = Decoder(b)
+        out = cls.decode(d)
+        if not d.done():
+            raise ValueError("trailing bytes after aggregate commit")
+        return out
+
+    # -- json --------------------------------------------------------------
+
+    def to_json(self):
+        return {
+            "block_id": self.block_id.to_json(),
+            "height": self.height,
+            "round": self.round_,
+            "signers": self.signers.to_json(),
+            "rs": [r.hex().upper() for r in self.rs],
+            "s_agg": self.s_agg.hex().upper(),
+        }
+
+    @classmethod
+    def from_json(cls, obj) -> "AggregateCommit":
+        from tendermint_tpu.codec import jsonval as jv
+
+        obj = jv.require_dict(obj)
+        signers_obj = jv.require_dict(obj.get("signers"))
+        bits = jv.int_field(signers_obj, "bits", 1, MAX_AGG_SIGNERS)
+        elems = signers_obj.get("elems")
+        if not isinstance(elems, str) or len(elems) > (bits // 4) + 2:
+            raise ValueError("bad signer bit array")
+        try:
+            signers = BitArray.from_int(bits, int(elems or "0", 16))
+        except ValueError as exc:
+            raise ValueError("bad signer bit array") from exc
+        rs_hex = jv.list_field(obj, "rs", MAX_AGG_SIGNERS)
+        rs = []
+        for r in rs_hex:
+            if not isinstance(r, str) or len(r) != 64:
+                raise ValueError("bad nonce point hex")
+            rs.append(bytes.fromhex(r))
+        if len(rs) != signers.num_true_bits():
+            raise ValueError("signer bits do not match nonce points")
+        return cls(
+            BlockID.from_json(jv.dict_field(obj, "block_id")),
+            jv.int_field(obj, "height", 0, jv.MAX_HEIGHT),
+            jv.int_field(obj, "round", 0, jv.MAX_ROUND),
+            signers,
+            rs,
+            jv.hex_field(obj, "s_agg"),
+        )
+
+    def __repr__(self):
+        return (
+            f"AggregateCommit{{{len(self.rs)}/{self.signers.size} "
+            f"for {self.block_id!r}}}"
+        )
+
+
+def decode_commit(d: Decoder, aggregate_commits: bool = False):
+    """Format-flag-aware commit decode: dispatches on the aggregate
+    magic tag. `aggregate_commits` is the chain's genesis
+    ``commit_format == "aggregate"`` — a full-format node fed an
+    aggregate commit refuses HERE, loudly (the mixed-net refusal test,
+    tests/test_vote_batch.py)."""
+    if d.peek_u8() == AGG_COMMIT_TAG:
+        if not aggregate_commits:
+            raise ValueError(
+                "aggregate commit refused: this chain's genesis runs "
+                "commit_format=full (mixed-net refusal, docs/committee.md)"
+            )
+        return AggregateCommit.decode(d)
+    return Commit.decode(d)

@@ -213,6 +213,11 @@ class Commit:
                 p.encode(enc)
         e.write_list(self.precommits, write_precommit)
 
+    def to_bytes(self) -> bytes:
+        e = Encoder()
+        self.encode(e)
+        return e.buf()
+
     @classmethod
     def decode(cls, d: Decoder) -> "Commit":
         bid = BlockID.decode(d)

@@ -64,11 +64,29 @@ class DuplicateVoteEvidence:
     def address(self) -> bytes:
         return self.vote_a.validator_address
 
-    def validate(self, chain_id: str) -> None:
+    def validate(self, chain_id: str, batch_verifier=None) -> None:
         """Raise EvidenceError unless this really is a double-sign: same
         validator/H/R/type, DIFFERENT blocks, both signatures valid
         under pub_key for this chain. Anyone can forge an unvalidated
-        pair; a validated one is cryptographic proof."""
+        pair; a validated one is cryptographic proof.
+
+        batch_verifier (round 16): callable(items) -> list[bool] — the
+        gateway batch plane (ops.gateway.Verifier.commit_batch_verifier);
+        None keeps the per-signature pure path."""
+        self.validate_structure(chain_id)
+        if batch_verifier is not None:
+            oks = batch_verifier(self.sig_items(chain_id))
+            if not all(oks):
+                raise EvidenceError("invalid signature on evidence vote")
+            return
+        for v in (self.vote_a, self.vote_b):
+            if not self.pub_key.verify_bytes(v.sign_bytes(chain_id), v.signature):
+                raise EvidenceError("invalid signature on evidence vote")
+
+    def validate_structure(self, chain_id: str) -> None:
+        """Everything validate checks BEFORE signatures (round 16 split:
+        EvidenceData.validate batches every piece's signatures through
+        one gateway call after the structural pass)."""
         a, b = self.vote_a, self.vote_b
         if (
             a.validator_address != b.validator_address
@@ -86,10 +104,16 @@ class DuplicateVoteEvidence:
         if self.pub_key.address() != a.validator_address:
             raise EvidenceError("pub_key does not match validator address")
         for v in (a, b):
-            if v.signature is None or not self.pub_key.verify_bytes(
-                v.sign_bytes(chain_id), v.signature
-            ):
+            if v.signature is None:
                 raise EvidenceError("invalid signature on evidence vote")
+
+    def sig_items(self, chain_id: str) -> list:
+        """The two gateway verify lanes (pubkey, sign_bytes, signature);
+        call after validate_structure (signatures proven present)."""
+        return [
+            (self.pub_key.raw, v.sign_bytes(chain_id), v.signature.raw)
+            for v in (self.vote_a, self.vote_b)
+        ]
 
     def hash(self) -> bytes:
         return ripemd160(
@@ -157,18 +181,20 @@ class EvidencePool:
         self._committed_max = max(4 * max_size, 4096)
         self._mtx = threading.Lock()
 
-    def add(self, ev: DuplicateVoteEvidence, chain_id: str) -> bool:
+    def add(self, ev: DuplicateVoteEvidence, chain_id: str,
+            batch_verifier=None) -> bool:
         """Validate + insert; False if duplicate or invalid (invalid
         evidence is dropped, not raised — the vote path must not die on
         a malformed pair). Dedup runs BEFORE validation: a peer
         re-gossiping a known conflict must cost a hash, not two ed25519
-        verifies per replay."""
+        verifies per replay. `batch_verifier` routes the pair's two
+        signatures through one gateway batch (round 16)."""
         h = ev.hash()
         with self._mtx:
             if h in self._by_hash:
                 return False
         try:
-            ev.validate(chain_id)
+            ev.validate(chain_id, batch_verifier=batch_verifier)
         except EvidenceError:
             return False
         with self._mtx:
@@ -266,11 +292,17 @@ class EvidenceData:
                 )
         return self._hash
 
-    def validate(self, chain_id: str, block_height: int, validators) -> None:
+    def validate(self, chain_id: str, block_height: int, validators,
+                 batch_verifier=None) -> None:
         """Raise EvidenceError unless every piece is a provable,
         in-committee, prior-height double-sign and the section carries no
         duplicates (the proposer controls this list — it is adversarial
-        input to every other validator)."""
+        input to every other validator).
+
+        batch_verifier (round 16): with the gateway batch plane wired,
+        every structural check runs first and then ALL pieces' signatures
+        (two per piece) flush in ONE batched call — per-lane verdicts
+        keep attribution, so a forged lane names exactly its piece."""
         if len(self.evidence) > MAX_EVIDENCE_PER_BLOCK:
             raise EvidenceError(
                 f"too much evidence: {len(self.evidence)} > {MAX_EVIDENCE_PER_BLOCK}"
@@ -291,7 +323,21 @@ class EvidenceData:
                 raise EvidenceError(
                     f"evidence validator {ev.address.hex()[:12]} not in the set"
                 )
-            ev.validate(chain_id)
+            if batch_verifier is None:
+                ev.validate(chain_id)
+            else:
+                ev.validate_structure(chain_id)
+        if batch_verifier is not None and self.evidence:
+            items = []
+            for ev in self.evidence:
+                items.extend(ev.sig_items(chain_id))
+            oks = batch_verifier(items)
+            for i, ev in enumerate(self.evidence):
+                if not all(oks[2 * i : 2 * i + 2]):
+                    raise EvidenceError(
+                        "invalid signature on evidence vote (piece "
+                        f"{i}, validator {ev.address.hex()[:12]})"
+                    )
 
     def encode(self, e: Encoder) -> None:
         e.write_list(self.evidence, lambda enc, ev: ev.encode(enc))

@@ -23,6 +23,14 @@ class GenesisValidator:
         return cls(pub_key_from_json(obj["pub_key"]), obj["power"], obj.get("name", ""))
 
 
+# commit wire formats (round 16, docs/committee.md): "full" = the
+# reference Commit (one signed vote per validator); "aggregate" = the
+# half-aggregated prototype (types/agg_commit.py). A format flag in
+# GENESIS, not config: every node of a chain must agree or refuse —
+# mixed-format nets cannot silently form (decode_commit's refusal).
+COMMIT_FORMATS = ("full", "aggregate")
+
+
 @dataclass
 class GenesisDoc:
     genesis_time_ns: int
@@ -30,6 +38,7 @@ class GenesisDoc:
     validators: list[GenesisValidator] = field(default_factory=list)
     app_hash: bytes = b""
     consensus_params: ConsensusParams = field(default_factory=ConsensusParams)
+    commit_format: str = "full"
 
     def validate_and_complete(self) -> None:
         """types/genesis.go:55-84: ensure chain id, >=1 validator with
@@ -39,11 +48,20 @@ class GenesisDoc:
         err = self.consensus_params.validate()
         if err:
             raise ValueError(err)
+        if self.commit_format not in COMMIT_FORMATS:
+            raise ValueError(
+                f"unknown commit_format {self.commit_format!r}; "
+                f"expected one of {COMMIT_FORMATS}"
+            )
         if not self.validators:
             raise ValueError("genesis doc must include at least one validator")
         for v in self.validators:
             if v.power <= 0:
                 raise ValueError(f"validator {v.name!r} has non-positive power")
+
+    def aggregate_commits(self) -> bool:
+        """The agg_commit.decode_commit gate."""
+        return self.commit_format == "aggregate"
 
     def validator_hash(self) -> bytes:
         from tendermint_tpu.types.validator import Validator
@@ -53,13 +71,18 @@ class GenesisDoc:
         return vs.hash()
 
     def to_json(self):
-        return {
+        out = {
             "genesis_time": self.genesis_time_ns,
             "chain_id": self.chain_id,
             "validators": [v.to_json() for v in self.validators],
             "app_hash": self.app_hash.hex().upper(),
             "consensus_params": self.consensus_params.to_json(),
         }
+        if self.commit_format != "full":
+            # key present only off the default so every existing genesis
+            # doc serializes byte-identically to the pre-flag format
+            out["commit_format"] = self.commit_format
+        return out
 
     def save_as(self, path: str) -> None:
         with open(path, "w") as f:
@@ -73,6 +96,7 @@ class GenesisDoc:
             validators=[GenesisValidator.from_json(v) for v in obj.get("validators", [])],
             app_hash=bytes.fromhex(obj.get("app_hash", "")),
             consensus_params=ConsensusParams.from_json(obj.get("consensus_params")),
+            commit_format=obj.get("commit_format", "full"),
         )
         doc.validate_and_complete()
         return doc

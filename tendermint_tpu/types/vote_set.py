@@ -6,12 +6,17 @@ memory-bounding argument are preserved).
 The per-vote Ed25519 verify here (reference types/vote_set.go:175) is a TPU
 hot path: `add_vote` takes an optional single-item verifier, and the
 consensus layer batches votes through ops.gateway before insertion; the
-observable accept/reject behavior is identical either way. Since round 6
-the drained-vote batch is primed ASYNCHRONOUSLY
-(gateway.Verifier.prime_cache_async from consensus/state._prime_vote_batch):
-the signatures stream to the device daemon in chunks while this module's
-bookkeeping for the leading votes runs, and the first add_vote whose
-verifier pop needs a verdict blocks for the batch.
+observable accept/reject behavior is identical either way.
+
+Round 16 (big committees, docs/committee.md) splits the add into its two
+halves so the consensus thread can micro-batch signatures across a
+drained run of gossiped votes: `begin_add` runs every NON-signature
+check — index/address bounds, height/round/type, exact-duplicate and
+different-signature screens — and returns a `PendingVote` whose
+`item()` is the gateway verify tuple; `commit_add(pending, ok)` applies
+the verdict with add_vote's exact error taxonomy (one bad signature
+rejects only its own vote). `add_vote` is now a composition of the two,
+so the split path cannot drift from the sequential one.
 """
 
 from __future__ import annotations
@@ -53,6 +58,30 @@ class _BlockVotes:
         return self.votes[index]
 
 
+class PendingVote:
+    """The structural half of an add (round 16): produced by
+    `VoteSet.begin_add` once every non-signature check passed. `item()`
+    is the gateway verify tuple; `commit(ok)` applies the signature
+    verdict and finishes the insertion with add_vote's error taxonomy."""
+
+    __slots__ = ("vote_set", "vote", "val", "sign_bytes", "block_key")
+
+    def __init__(self, vote_set: "VoteSet", vote: Vote, val, sign_bytes: bytes,
+                 block_key: bytes):
+        self.vote_set = vote_set
+        self.vote = vote
+        self.val = val
+        self.sign_bytes = sign_bytes
+        self.block_key = block_key
+
+    def item(self) -> tuple[bytes, bytes, bytes]:
+        """(pubkey, message, signature) — the ops.gateway batch lane."""
+        return (self.val.pub_key.raw, self.sign_bytes, self.vote.signature.raw)
+
+    def commit(self, ok: bool) -> bool:
+        return self.vote_set.commit_add(self, ok)
+
+
 class VoteSet:
     def __init__(
         self, chain_id: str, height: int, round_: int, type_: int, val_set: ValidatorSet
@@ -71,6 +100,13 @@ class VoteSet:
         self._maj23: BlockID | None = None
         self._votes_by_block: dict[bytes, _BlockVotes] = {}
         self._peer_maj23s: dict[str, BlockID] = {}
+        # sign-bytes memo: every vote in this set at the same block id
+        # shares ONE canonical payload (identity is excluded from sign
+        # bytes), so a 400-validator quorum costs one serialization, not
+        # 400. Small cap — adversarial distinct-block spam must not pin
+        # memory (each entry is ~200 B; honest rounds see 1-2 blocks)
+        self._sb_cache: dict[bytes, bytes] = {}
+        self._sb_cache_cap = 8
 
     def size(self) -> int:
         return self.val_set.size()
@@ -86,11 +122,30 @@ class VoteSet:
         CPU verify. The consensus layer passes the batching gateway's
         single-item interface so WAL-replayed and gossiped votes take the
         same code path.
-        """
-        with self._mtx:
-            return self._add_vote(vote, verifier)
 
-    def _add_vote(self, vote: Vote, verifier) -> bool:
+        Composed from the split halves (round 16), so batched and
+        sequential insertion cannot diverge."""
+        pending = self.begin_add(vote)
+        if pending is None:
+            return False  # exact duplicate
+        if verifier is not None:
+            ok = verifier(*pending.item())
+        else:
+            ok = pending.val.pub_key.verify_bytes(
+                pending.sign_bytes, vote.signature
+            )
+        return self.commit_add(pending, ok)
+
+    def begin_add(self, vote: Vote) -> PendingVote | None:
+        """Every check add_vote runs BEFORE the signature verify:
+        index/address bounds, height/round/type, the exact-duplicate
+        screen (returns None — add_vote's False), the different-
+        signature and missing-signature screens (raised). The returned
+        entry's signature still needs a verdict before commit_add."""
+        with self._mtx:
+            return self._begin_add(vote)
+
+    def _begin_add(self, vote: Vote) -> PendingVote | None:
         val_index = vote.validator_index
         val_addr = vote.validator_address
         block_key = vote.block_id.key()
@@ -117,23 +172,39 @@ class VoteSet:
         existing = self._get_vote(val_index, block_key)
         if existing is not None:
             if existing.signature == vote.signature:
-                return False  # exact duplicate
+                return None  # exact duplicate
             # same H/R/S/block but different signature: invalid, since
             # ed25519 signing is deterministic
             raise InvalidSignatureError("different signature for same vote")
 
-        # signature check — the hot path
         if vote.signature is None:
             raise InvalidSignatureError("missing signature")
-        sign_bytes = vote.sign_bytes(self.chain_id)
-        if verifier is not None:
-            ok = verifier(val.pub_key.raw, sign_bytes, vote.signature.raw)
-        else:
-            ok = val.pub_key.verify_bytes(sign_bytes, vote.signature)
+        sign_bytes = self._sb_cache.get(block_key)
+        if sign_bytes is None:
+            sign_bytes = vote.sign_bytes(self.chain_id)
+            self._sb_cache[block_key] = sign_bytes
+            while len(self._sb_cache) > self._sb_cache_cap:
+                self._sb_cache.pop(next(iter(self._sb_cache)))
+        return PendingVote(self, vote, val, sign_bytes, block_key)
+
+    def commit_add(self, pending: PendingVote, ok: bool) -> bool:
+        """Apply a pending entry's signature verdict. Error taxonomy is
+        add_vote's: a failed verdict raises InvalidSignatureError for
+        THIS vote only, a conflict raises ConflictingVotesError. The
+        duplicate screen re-runs under the lock so an interleaved add of
+        the same vote degrades to add_vote's False, never a crash."""
+        vote = pending.vote
         if not ok:
             raise InvalidSignatureError(repr(vote))
-
-        added, conflicting = self._add_verified_vote(vote, block_key, val.voting_power)
+        with self._mtx:
+            existing = self._get_vote(vote.validator_index, pending.block_key)
+            if existing is not None:
+                if existing.signature == vote.signature:
+                    return False  # duplicate landed between begin and commit
+                raise InvalidSignatureError("different signature for same vote")
+            added, conflicting = self._add_verified_vote(
+                vote, pending.block_key, pending.val.voting_power
+            )
         if conflicting is not None:
             raise ConflictingVotesError(conflicting, vote)
         if not added:

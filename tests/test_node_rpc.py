@@ -252,6 +252,9 @@ METRICS_REQUIRED_KEYS = (
     "consensus_pipeline_applies",
     "consensus_pipeline_join_wait_seconds",
     "consensus_pipeline_overlap_seconds",
+    # big-committee vote plane (round 16)
+    "consensus_vote_batches", "consensus_vote_batched_sigs",
+    "consensus_vote_singletons",
     # block store
     "blockstore_height", "blockstore_base",
     # WAL durability plane (present once consensus started)
@@ -324,6 +327,8 @@ def test_prometheus_exposition_endpoint(node):
     # one family per plane the acceptance bar names (statetree_*: the
     # kvstore app carries the round-13 authenticated tree, scrape-only)
     for fam in ("consensus_height", "wal_format", "gateway_verify_tpu_sigs",
+                # round 16: the big-committee vote-plane counters
+                "consensus_vote_batches", "consensus_vote_singletons",
                 "gateway_hash_tpu_leaves", "gateway_breaker_state",
                 "mempool_size", "statesync_snapshots", "fastsync_active",
                 "p2p_peers_outbound", "statetree_size", "statetree_commits",
@@ -352,6 +357,8 @@ def test_prometheus_exposition_endpoint(node):
                 # round 14: the execution-pipeline distributions
                 "consensus_height_seconds", "pipeline_join_wait_seconds",
                 "pipeline_overlap_seconds",
+                # round 16: the vote micro-batch distribution
+                "consensus_vote_verify_batch_seconds",
                 # round 15: gossip-arrival distributions + per-peer RTT
                 "consensus_quorum_seconds", "consensus_first_part_seconds",
                 "p2p_peer_ping_rtt_seconds"):
