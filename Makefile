@@ -24,7 +24,7 @@ test:
 # hold (dots no worse than the seed) — plus the chip-free hash-stream
 # smoke (the two asserted BENCH_r07 rows: streamed hash offload >= 1.3x
 # single-shot on the sim transport, flat host builder >= 1.5x recursive).
-tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke pipeline-smoke fleet-smoke committee-smoke
+tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Chip-free bench smoke: every BASELINE config on the pinned CPU backend,
@@ -127,6 +127,18 @@ committee-smoke:
 metrics-smoke:
 	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_TELEMETRY_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_telemetry.py
 
+# Tx-lifecycle tracing smoke, chip-free (~45 s): bench_txtrace.py's
+# reduced pass — the per-tx span recorder on a live committing node
+# (every completed trace's spans-through-commit asserted to sum within
+# 10% of its measured end-to-end commit latency), the tracing +
+# flight-recorder overhead bound on the mempool signed-burst shape
+# asserted <2%, and a flight-record wedge dump written + parsed back.
+# Runs as part of `make tier1` (the contract matrix lives in
+# tests/test_txtrace.py + tests/test_flightrec.py; the netchaos
+# partition wedge-diagnosis scenario in tests/test_netchaos.py).
+txtrace-smoke:
+	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_TXTRACE_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_txtrace.py
+
 test_race:
 	$(PY) -m pytest tests/test_race.py -q
 
@@ -139,4 +151,4 @@ test_slow:
 native:
 	$(MAKE) -C native
 
-.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke pipeline-smoke fleet-smoke committee-smoke
+.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke

@@ -61,6 +61,13 @@ Configs (BASELINE.md):
                   plus commit-verify latency and aggregate-commit size
                   rows vs validator count (writes BENCH_r16.json;
                   chip-free, devd rows auto-join when a daemon serves)
+ 17 txtrace      — request-level observability: sampled per-tx lifecycle
+                  spans on a live committing chain (per-stage p50/p99,
+                  spans-through-commit asserted within 10% of measured
+                  end-to-end latency), tracing + flight-recorder
+                  overhead bound asserted <2% on the signed-burst
+                  shape, wedge-dump artifact row (writes BENCH_r17.json;
+                  chip-free)
  13 statetree    — authenticated app-state commitment: incremental
                   commit vs full tree rebuild, proof correctness rows,
                   delta-vs-full snapshot bytes (delta asserted <= 0.5x
@@ -100,6 +107,7 @@ BENCHES = {
     "14_pipeline": [sys.executable, "benches/bench_pipeline.py"],
     "15_fleet": [sys.executable, "benches/bench_fleet.py"],
     "16_committee": [sys.executable, "benches/bench_committee.py"],
+    "17_txtrace": [sys.executable, "benches/bench_txtrace.py"],
 }
 
 

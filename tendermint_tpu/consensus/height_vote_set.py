@@ -22,6 +22,11 @@ from tendermint_tpu.types import (
 
 MAX_CATCHUP_ROUNDS = 2
 
+# begin_add's distinguishable drop (round 17): a vote for a round this
+# set refuses to track (catchup budget spent) is NOT an already-seen
+# duplicate — the redundancy counters must only count true re-deliveries
+UNWANTED_ROUND = object()
+
 
 class _RoundVoteSet:
     __slots__ = ("prevotes", "precommits")
@@ -82,12 +87,15 @@ class HeightVoteSet:
         """Split-add entry (round 16, types/vote_set.py PendingVote):
         resolves the round's VoteSet — creating a catchup round within
         the per-peer budget exactly as add_vote would — and runs its
-        structural half. None = dropped/duplicate (add_vote's False);
-        commit via the returned entry's .commit(ok)."""
+        structural half. None = exact duplicate (add_vote's False);
+        the UNWANTED_ROUND sentinel = dropped untracked-round vote
+        (also add_vote's False, but NOT a gossip re-delivery — the
+        round-17 duplicate counters key off the distinction); commit
+        via the returned entry's .commit(ok)."""
         with self._mtx:
             vs = self._resolve_vote_set(vote, peer_id)
             if vs is None:
-                return None
+                return UNWANTED_ROUND
         return vs.begin_add(vote)
 
     def _resolve_vote_set(self, vote: Vote, peer_id: str):

@@ -685,6 +685,11 @@ class ConsensusReactor(Reactor, BaseService):
             ps.m_vote_sends.inc()
             return True
         ps.m_vote_send_failures.inc()
+        fr = getattr(getattr(self, "con_s", None), "flightrec", None)
+        if fr is not None:
+            # picks-without-sends IS the gossip-stall signature a wedge
+            # dump must carry (node/flightrec.py)
+            fr.record("gossip_send_fail", peer=_peer_label(peer))
         return False
 
     def _pick_and_send_vote(self, peer, ps: PeerState, rs, prs: PeerRoundState) -> bool:

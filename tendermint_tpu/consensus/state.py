@@ -140,6 +140,16 @@ class ConsensusState(BaseService):
         self.trace = ctrace.TraceRecorder(
             device_probe=self._trace_device_probe
         )
+        # round 17 observability plane (node/node.py wires both; None in
+        # bare harnesses — every site guards):
+        # - txtrace: sampled per-tx lifecycle spans (libs/txtrace.py)
+        # - flightrec: the black-box event ring (node/flightrec.py)
+        self.txtrace = None
+        self.flightrec = None
+        # votes begin_add screened as already-seen — the 2NxN gossip
+        # redundancy number the queued dedup PR needs a before for
+        # (per-peer attribution rides p2p_peer_vote_duplicates_total)
+        self.vote_duplicates = 0
 
         # pipelined execution plane (round 14): stage-2 (apply) rides an
         # ordered executor; the consensus thread holds at most ONE
@@ -535,13 +545,35 @@ class ConsensusState(BaseService):
         # (single-writer: only this receive routine marks)
         self.trace.mark(ctrace.step_segment(self.rs.step))
         self.trace.note_round(self.rs.round_)
+        fr = self.flightrec
+        if fr is not None:
+            # the flight ring's progress spine: a wedge reads as these
+            # freezing at one height (node/flightrec.py)
+            fr.record("step", height=self.rs.height, round=self.rs.round_,
+                      step=int(self.rs.step))
         if self.evsw is not None:
             self.evsw.fire_event(tev.EVENT_NEW_ROUND_STEP, rs_event)
 
     # -- the receive routine ----------------------------------------------
 
     def receive_routine(self, max_steps: int) -> None:
-        """consensus/state.go:609-659. max_steps=0 means run forever."""
+        """consensus/state.go:609-659. max_steps=0 means run forever.
+
+        An exception ESCAPING this routine kills the consensus thread —
+        the node is dead from that instant, silently. The flight
+        recorder captures the crash and dumps the ring first (round 17),
+        so the post-mortem artifact exists even when nobody was
+        watching; the exception still propagates (the thread must not
+        limp on)."""
+        try:
+            self._receive_routine(max_steps)
+        except BaseException as exc:
+            fr = self.flightrec
+            if fr is not None:
+                fr.note_exception("consensus", exc)
+            raise
+
+    def _receive_routine(self, max_steps: int) -> None:
         steps = 0
         while True:
             if max_steps > 0 and steps >= max_steps:
@@ -835,6 +867,11 @@ class ConsensusState(BaseService):
             self.logger.error("propose without last commit (+2/3 missing)")
             return None, None
         txs = self.mempool.reap(self.config.max_block_size_txs)
+        if self.txtrace is not None:
+            # lifecycle mark: reaped into OUR proposal (a non-proposer
+            # stamps the same stage when the gossiped proposal block
+            # completes — add_proposal_block_part)
+            self.txtrace.stamp_present(txs, "proposal")
         t0 = time.perf_counter()
         # submitted-early future: the tx root starts hashing on the hash
         # plane NOW, overlapping commit/evidence/header assembly below;
@@ -1137,8 +1174,18 @@ class ConsensusState(BaseService):
 
         if self.wal is not None:
             self.wal.write_end_height(height)
+            if self.flightrec is not None:
+                # the durability mark: everything before this instant
+                # survives a power failure (docs/crash-recovery.md)
+                self.flightrec.record("wal_endheight", height=height)
 
         fail_point()
+
+        if self.txtrace is not None:
+            # lifecycle mark: the block carrying a traced tx is now
+            # chain history (stage 1 done — marker on disk); also
+            # resets the first-K-per-height sampling window
+            self.txtrace.commit(block.data.txs, height)
 
         state_copy = self.state.copy()
         event_cache = EventCache(self.evsw) if self.evsw is not None else _NullCache()
@@ -1281,6 +1328,10 @@ class ConsensusState(BaseService):
         mode runs it as the executor's tail (EventSwitch is
         lock-protected; subscribers already handle cross-thread fires
         from the reactors)."""
+        if self.txtrace is not None:
+            # lifecycle mark: the block's (serial or deferred) apply
+            # just completed — both modes route through this tail
+            self.txtrace.stamp_present(block.data.txs, "apply")
         if mark_trace:
             self.trace.mark("snapshot_hook")
         if self.post_apply_hook is not None and not self.replay_mode:
@@ -1298,6 +1349,10 @@ class ConsensusState(BaseService):
                 tev.EVENT_NEW_BLOCK_HEADER, tev.EventDataNewBlockHeader(block.header)
             )
         event_cache.flush()
+        if self.txtrace is not None:
+            # lifecycle terminus: the txs' DeliverTx events just flushed
+            # to subscribers — seal the traces (visible latency)
+            self.txtrace.delivered(block.data.txs)
 
     def _provisional_next_state(self, state_copy, block, block_parts):
         """The H+1 state ASSUMING no EndBlock valset diffs (the common
@@ -1422,6 +1477,12 @@ class ConsensusState(BaseService):
         if added and rs.proposal_block_parts.is_complete():
             block_bytes = rs.proposal_block_parts.get_data()
             rs.proposal_block = Block.from_bytes(block_bytes)
+            if self.txtrace is not None:
+                # lifecycle mark: the proposal carrying a traced tx
+                # arrived whole (the non-proposer half of "proposal")
+                self.txtrace.stamp_present(
+                    rs.proposal_block.data.txs, "proposal"
+                )
             self.logger.info("received complete proposal block %s", rs.proposal_block.hash().hex()[:12])
             self._fire(tev.EVENT_COMPLETE_PROPOSAL, rs.round_state_event())
             if rs.step <= RoundStep.PROPOSE and self.is_proposal_complete():
@@ -1455,6 +1516,12 @@ class ConsensusState(BaseService):
         except UnexpectedStepError:
             pass  # vote for an old height/step — harmless
         except VoteError as e:
+            fr = self.flightrec
+            if fr is not None:
+                fr.record("vote_reject", height=vote.height,
+                          round=vote.round_, type=vote.type_,
+                          err=f"{type(e).__name__}: {e}",
+                          peer=peer_id or "self")
             self.logger.warning("bad vote from %s: %s", peer_id or "self", e)
 
     def _record_duplicate_vote_evidence(self, vote_a: Vote, vote_b: Vote) -> None:
@@ -1498,7 +1565,7 @@ class ConsensusState(BaseService):
                 return False
             if rs.last_commit is None:
                 return False
-            added = self._split_add(rs.last_commit, vote)
+            added = self._split_add(rs.last_commit, vote, peer_id=peer_id)
             if added:
                 self.logger.info("added to last_commit: %r", rs.last_commit)
                 self._fire(tev.EVENT_VOTE, tev.EventDataVote(vote))
@@ -1516,7 +1583,8 @@ class ConsensusState(BaseService):
         # the provisional set crypto-invisible (no H+1 vote is ever
         # checked against it)
         self._join_apply("add_vote")
-        added = self._split_add(rs.votes, vote, peer_id)
+        added = self._split_add(rs.votes, vote, peer_id=peer_id,
+                                height_set=True)
         if not added:
             return False
         self._fire(tev.EVENT_VOTE, tev.EventDataVote(vote))
@@ -1527,7 +1595,8 @@ class ConsensusState(BaseService):
             self._handle_added_precommit(vote)
         return added
 
-    def _split_add(self, vote_set, vote: Vote, peer_id: str | None = None) -> bool:
+    def _split_add(self, vote_set, vote: Vote, peer_id: str = "",
+                   height_set: bool = False) -> bool:
         """The round-16 split-add flow (docs/committee.md): synchronous
         structural checks produce a pending entry, its signature verdict
         comes from the micro-batch the receive routine dispatched over
@@ -1535,14 +1604,44 @@ class ConsensusState(BaseService):
         any miss — and commit applies it with add_vote's exact error
         taxonomy, so one bad signature rejects only its own vote. Replay
         and vote_batching=False never see a dispatched batch, making
-        every lane a deterministic singleton by construction."""
-        if peer_id is None:
-            pending = vote_set.begin_add(vote)  # last_commit VoteSet
-        else:
+        every lane a deterministic singleton by construction.
+
+        Round 17: a begin_add exact-duplicate from a PEER is the 2NxN
+        vote-gossip redundancy — counted process-flat
+        (consensus_vote_duplicates) and per sender
+        (p2p_peer_vote_duplicates_total) so the queued gossip-dedup PR
+        has a before number. Unwanted-round drops (catchup budget) and
+        our own re-delivered votes (empty peer_id) are NOT gossip
+        redundancy and stay uncounted."""
+        from tendermint_tpu.consensus.height_vote_set import UNWANTED_ROUND
+
+        if height_set:
             pending = vote_set.begin_add(vote, peer_id)  # HeightVoteSet
+        else:
+            pending = vote_set.begin_add(vote)  # last_commit VoteSet
+        if pending is UNWANTED_ROUND:
+            return False  # untracked round dropped (add_vote's False)
         if pending is None:
-            return False  # duplicate / unwanted round (add_vote's False)
+            if peer_id:
+                self._note_vote_duplicate(peer_id)
+            return False  # exact duplicate (add_vote's False)
         return pending.commit(self.vote_batcher.verdict(pending.item()))
+
+    def _note_vote_duplicate(self, peer_id: str) -> None:
+        """Count one already-seen gossiped vote: the flat gauge, the
+        labeled per-peer counter, and a sampled flight-recorder event.
+        Metric failures must never cost the vote path."""
+        self.vote_duplicates += 1
+        try:
+            from tendermint_tpu.p2p.telemetry import peer_metrics
+
+            fams = peer_metrics(self.trace.metrics_registry)
+            fams["vote_duplicates"].labels(peer=peer_id).inc()
+        except Exception:  # noqa: BLE001
+            pass
+        fr = self.flightrec
+        if fr is not None:
+            fr.note_vote_dup(peer_id)
 
     def _handle_added_prevote(self, vote: Vote) -> None:
         """consensus/state.go:1500-1534."""

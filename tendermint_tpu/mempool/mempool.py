@@ -270,8 +270,32 @@ class Mempool:
         self.recheck_end = None
         self.notified_txs_available = False
         self._txs_available_cb = None
+        # tx-lifecycle tracing (round 17, libs/txtrace.py): the node
+        # wires one recorder across mempool/reactor/consensus; None in
+        # bare harnesses — every stamp site guards it. _admit_rec is the
+        # precomputed per-tx admit-stamp seam: only the UNGATED path
+        # stamps admit from the per-tx response callback (the sig-gate
+        # path stamps it batch-granularly in _sig_gate_results), so the
+        # gated burst hot path pays zero per-tx tracing there.
+        self._txtrace = None
+        self._admit_rec = None
+        # the recorder-bound sampling countdown (libs/txtrace.bind_tick):
+        # check_tx's fast path is a pure local-attribute decrement; with
+        # no recorder it counts down from 2^60 — never fires
+        self._trace_tick = 1 << 60
         self._mtx = threading.RLock()  # the proxy mtx (mempool/mempool.go:58)
         proxy_app_conn.set_response_callback(self._res_cb)
+
+    @property
+    def txtrace(self):
+        return self._txtrace
+
+    @txtrace.setter
+    def txtrace(self, rec) -> None:
+        self._txtrace = rec
+        self._admit_rec = rec if self.sig_batcher is None else None
+        if rec is not None:
+            rec.bind_tick(self)
 
     # -- wal ---------------------------------------------------------------
 
@@ -322,15 +346,26 @@ class Mempool:
 
     # -- checktx -----------------------------------------------------------
 
-    def check_tx(self, tx: bytes, cb=None) -> None:
+    def check_tx(self, tx: bytes, cb=None, source: str = "rpc") -> None:
         """Validate tx against the app; good txs enter the pool when the
         async response lands (mempool/mempool.go:166-205). With a
         SigBatcher wired, sig-carrying txs first pass the batched
         signature gate — invalid signatures are rejected here without
-        ever reaching the app."""
+        ever reaching the app. `source` tags the tx-lifecycle trace
+        (round 17): "rpc" for a client submit, "peer" for gossip."""
         with self._mtx:
             if not self.cache.push(tx):
                 raise TxInCacheError(tx.hex()[:16])
+            # lifecycle ingress, inlined (the <2% discipline): an
+            # untraced tx pays ONE local-attribute countdown decrement;
+            # only the sampled tx enters the recorder (which re-arms
+            # this tick through the bind_tick mirror)
+            self._trace_tick -= 1
+            if self._trace_tick <= 0:
+                if self._txtrace is not None:
+                    self._txtrace.ingress(tx, source)
+                else:
+                    self._trace_tick = 1 << 60
             if self.wal is not None:
                 self.wal.write_line(tx.hex())
                 self.wal.flush()
@@ -341,12 +376,29 @@ class Mempool:
                         # gate saturated: refuse retriably, never grow an
                         # unbounded backlog off a peer-driven path
                         self.cache.remove(tx)
+                        if self._txtrace is not None:
+                            # a traced tx leaving the lifecycle here
+                            # must seal, not linger as a false PARKED
+                            self._txtrace.reject(tx, "gate_saturated")
                         if cb is not None:
                             cb(ResponseCheckTx(
                                 code=CODE_UNAUTHORIZED,
                                 log="signature gate saturated; retry",
                             ))
                     return
+                if self._txtrace is not None and tx in self._txtrace._active:
+                    # gate-BYPASSING traced tx (no parseable signature,
+                    # off the gated hot path): the batch-granular admit
+                    # stamp won't cover it — stamp on its own response
+                    rec, orig_cb = self._txtrace, cb
+
+                    def cb(res, _tx=tx, _orig=orig_cb, _rec=rec):
+                        if res.is_ok:
+                            _rec.stamp(_tx, "mempool_admit")
+                        else:
+                            _rec.reject(_tx, "checktx_reject")
+                        if _orig is not None:
+                            _orig(res)
             reqres = self.proxy_app_conn.check_tx_async(tx)
             if cb is not None:
                 reqres.set_callback(lambda res: cb(res))
@@ -358,8 +410,15 @@ class Mempool:
         trip for the whole batch); failures reject without app dispatch,
         same cache semantics as an app-rejected tx
         (mempool/mempool.go:231)."""
+        rec = self._txtrace
         ok_entries = [ctx for ctx, ok in results if ok]
+        if rec is not None and rec._active:
+            # batch-granular stamping (the <2% discipline): one set
+            # build for the whole verdict batch, zero per-tx calls
+            rec.stamp_gate_batch(ok_entries)
         for tx, cb in (ctx for ctx, ok in results if not ok):
+            if rec is not None:
+                rec.reject(tx, "bad_sig")
             try:
                 self._reject_bad_sig(tx, cb)
             except Exception:  # noqa: BLE001 — one raising reject callback
@@ -401,11 +460,17 @@ class Mempool:
 
     def _res_cb_normal(self, tx: bytes, res: ResponseCheckTx) -> None:
         if res.is_ok:
+            if self._admit_rec is not None:
+                # ungated path only: the sig-gate path already stamped
+                # admit batch-granularly (_sig_gate_results)
+                self._admit_rec.stamp(tx, "mempool_admit")
             self.counter += 1
             self.txs.push_back(MemTx(self.counter, self.height, tx))
             self._notify_txs_available()
         else:
             # bad tx: allow future resubmission (mempool/mempool.go:231)
+            if self._txtrace is not None:
+                self._txtrace.reject(tx, "checktx_reject")
             self.cache.remove(tx)
 
     def _res_cb_recheck(self, tx: bytes, res: ResponseCheckTx) -> None:

@@ -82,7 +82,7 @@ class MempoolReactor(Reactor, BaseService):
             self.switch.stop_peer_for_error(peer, exc)
             return
         try:
-            self.mempool.check_tx(tx)
+            self.mempool.check_tx(tx, source="peer")
         except Exception:  # noqa: BLE001 — dup-in-cache / app reject: fine
             pass
 
@@ -109,6 +109,11 @@ class MempoolReactor(Reactor, BaseService):
                     return
                 stop.wait(PEER_CATCHUP_SLEEP)
                 continue
+            rec = self.mempool.txtrace
+            if rec is not None:
+                # lifecycle mark: first successful gossip send of this
+                # tx to ANY peer (keep-first stamp semantics)
+                rec.stamp(mem_tx.tx, "p2p_broadcast")
             # advance strictly once per sent tx
             while self.is_running() and not stop.is_set():
                 nxt = element.next_wait(timeout=0.5)

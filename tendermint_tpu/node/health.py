@@ -151,6 +151,14 @@ def health_report(node) -> dict:
                          "degraded_at": k["mempool_degraded"]}
     status = _worst(status, mstatus)
 
+    # flight recorder (round 17): every health evaluation — scrape,
+    # probe, or the watchdog — feeds the verdict to the recorder, which
+    # records CHANGES and auto-dumps the event ring exactly once per
+    # transition into failing (node/flightrec.py)
+    fr = getattr(node, "flightrec", None)
+    if fr is not None:
+        fr.note_health(status)
+
     return {
         "status": status,
         "code": _CODE[status],

@@ -181,6 +181,17 @@ class Node(BaseService):
         # signature gate: CheckTx bursts verify through the TPU gateway
         # BEFORE app dispatch (BASELINE config 5; the reference app
         # verifies per-tx on CPU, mempool/mempool.go:166-205) ------------
+        # -- round-17 debugging substrate: one tx-lifecycle recorder
+        # (libs/txtrace.py) stamped by mempool + reactor + consensus,
+        # and one black-box flight recorder (node/flightrec.py) fed by
+        # consensus/p2p/health — both constructed before the subsystems
+        # that stamp them
+        from tendermint_tpu.libs.txtrace import TxTraceRecorder
+        from tendermint_tpu.node.flightrec import FlightRecorder
+
+        self.txtrace = TxTraceRecorder()
+        self.flightrec = FlightRecorder(home=config.base.root_dir)
+
         sig_batcher = None
         local_app = getattr(client_creator, "app", None)
         # round 13: apps with an authenticated state tree route their
@@ -205,6 +216,7 @@ class Node(BaseService):
         self.mempool = Mempool(
             config.mempool, self.proxy_app.mempool(), sig_batcher=sig_batcher
         )
+        self.mempool.txtrace = self.txtrace
         self.mempool.init_wal()
         self.mempool_reactor = MempoolReactor(config.mempool, self.mempool)
 
@@ -263,6 +275,8 @@ class Node(BaseService):
         )
         if priv_validator is not None:
             self.consensus_state.set_priv_validator(priv_validator)
+        self.consensus_state.txtrace = self.txtrace
+        self.consensus_state.flightrec = self.flightrec
         self.consensus_state.set_event_switch(self.evsw)
         if self.snapshot_producer is not None:
             self.consensus_state.post_apply_hook = self.snapshot_producer.maybe_snapshot
@@ -322,6 +336,8 @@ class Node(BaseService):
             )
         )
         self.sw = Switch(config.p2p, peer_config)
+        self.sw.flightrec = self.flightrec
+        self.blockchain_reactor.flightrec = self.flightrec
         self.sw.add_reactor("MEMPOOL", self.mempool_reactor)
         self.sw.add_reactor("BLOCKCHAIN", self.blockchain_reactor)
         self.sw.add_reactor("CONSENSUS", self.consensus_reactor)
@@ -367,6 +383,26 @@ class Node(BaseService):
         from tendermint_tpu.node.telemetry import build_registry
 
         self.telemetry = build_registry(self)
+
+        # flight-dump counter snapshot: the p2p gossip totals (picks vs
+        # sends vs failures vs duplicates — the wedge signature) and the
+        # consensus position ride every dump, so a wedge is triaged
+        # from the artifact alone (node/flightrec.py)
+        from tendermint_tpu.p2p import telemetry as p2p_telemetry
+
+        def _flight_counters() -> dict:
+            rs = self.consensus_state.rs
+            out = {
+                "height": rs.height,
+                "round": rs.round_,
+                "step": int(rs.step),
+                "vote_duplicates": self.consensus_state.vote_duplicates,
+                "peer_msg_drops": self.consensus_state.peer_msg_drops,
+            }
+            out.update(p2p_telemetry.family_totals(self.telemetry))
+            return out
+
+        self.flightrec.counters_fn = _flight_counters
 
     # -- statesync wiring --------------------------------------------------
 
@@ -468,7 +504,13 @@ class Node(BaseService):
         if self.config.rpc.grpc_laddr:
             self._start_grpc()
 
+        # flight-recorder trigger scan: breaker transitions, the health
+        # verdict (the failing-transition auto-dump fires even when
+        # nothing scrapes), the height-age wedge dump
+        self.flightrec.start_watchdog(self)
+
     def on_stop(self) -> None:
+        self.flightrec.stop_watchdog()
         if self.grpc_server is not None:
             self.grpc_server.stop()
         if self.rpc_server is not None:

@@ -21,6 +21,13 @@ Canonical plane prefixes (full catalog: docs/observability.md):
                        in-process nodes keep separate series)
     node_health_*      round-15 health verdict (node/health.py): status
                        0 ok / 1 degraded / 2 failing + liveness age
+    txtrace_*          round-17 tx-lifecycle sampling counters
+                       (libs/txtrace.py; the per-stage distributions are
+                       the tx_stage_seconds / tx_commit_latency_seconds /
+                       tx_visible_latency_seconds histograms)
+    flightrec_*        round-17 black-box recorder ring/dump accounting
+                       (node/flightrec.py; the ring itself is
+                       GET /debug/flight)
     fastsync_*         BlockchainReactor progress + stage seconds
     statesync_*        reactor serving/restore + producer cadence (incl.
                        the round-13 delta counters)
@@ -86,6 +93,14 @@ def build_registry(node) -> telemetry.Registry:
     node.sw.metrics_registry = reg
     cs.trace.metrics_registry = reg
 
+    # round 17: the tx-lifecycle histograms (tx_stage_seconds{stage} +
+    # the two end-to-end latencies) live on the NODE registry like the
+    # per-peer families, materialized now for a stable family set
+    from tendermint_tpu.libs import txtrace as _txtrace
+
+    _txtrace.txtrace_hists(reg)
+    node.txtrace.metrics_registry = reg
+
     def consensus() -> dict:
         rs = cs.get_round_state()
         return {
@@ -114,6 +129,10 @@ def build_registry(node) -> telemetry.Registry:
             "vote_batches": cs.vote_batcher.batches,
             "vote_batched_sigs": cs.vote_batcher.batched_sigs,
             "vote_singletons": cs.vote_batcher.singletons,
+            # round 17: gossiped votes screened as already-seen — the
+            # 2NxN redundancy before-number for the gossip-dedup work
+            # (per-peer attribution: p2p_peer_vote_duplicates_total)
+            "vote_duplicates": cs.vote_duplicates,
         }
 
     reg.register_producer("consensus", consensus)
@@ -225,6 +244,12 @@ def build_registry(node) -> telemetry.Registry:
     from tendermint_tpu.node.health import health_gauges
 
     reg.register_producer("node_health", lambda: health_gauges(node))
+
+    # round 17: tx-lifecycle sampling counters + the flight recorder's
+    # ring/dump accounting (the distributions ride the histograms above;
+    # the event ring itself is GET /debug/flight)
+    reg.register_producer("txtrace", node.txtrace.stats)
+    reg.register_producer("flightrec", node.flightrec.stats)
 
     def fastsync() -> dict:
         bc = node.blockchain_reactor

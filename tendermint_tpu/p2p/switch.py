@@ -79,6 +79,10 @@ class Switch(BaseService):
         # so two in-process nodes keep separate per-peer counters; None
         # falls back to the process-wide default
         self.metrics_registry = None
+        # black-box flight recorder (round 17, node/flightrec.py): the
+        # node wires it so peer connect/drop land in the event ring;
+        # None (bare switches) records nothing
+        self.flightrec = None
         self.listeners: list = []
         self.filter_conn_by_addr = None  # callables raising on rejection
         self.filter_conn_by_pubkey = None
@@ -280,6 +284,9 @@ class Switch(BaseService):
             peer.stop()
             raise
         self.logger.info("added peer %s", peer)
+        if self.flightrec is not None:
+            self.flightrec.record("peer_add", peer=peer.id(),
+                                  outbound=peer.outbound)
         return peer
 
     def _on_peer_receive(self, peer: Peer, ch_id: int, msg_bytes: bytes) -> None:
@@ -376,6 +383,11 @@ class Switch(BaseService):
             self.ip_ranges.remove(ip)
 
     def _stop_and_remove(self, peer: Peer, reason) -> None:
+        if self.flightrec is not None:
+            self.flightrec.record(
+                "peer_drop", peer=peer.id(),
+                reason="graceful" if reason is None else str(reason)[:200],
+            )
         self._uncount_stream(peer.stream)
         self.peers.remove(peer)
         peer.stop()

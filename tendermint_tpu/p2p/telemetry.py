@@ -26,6 +26,10 @@ gossip plane observable per link:
     p2p_peer_catchup_commits_total{peer}         catchup-commit tracking
                                                  arrays engaged for a
                                                  lagging peer
+    p2p_peer_vote_duplicates_total{peer}         gossiped votes already
+                                                 seen (round 17: the
+                                                 2NxN redundancy before-
+                                                 number for gossip dedup)
 
 Label cardinality rides the registry's ``_other`` collapse
 (libs/telemetry.py): peer churn past the per-family bound
@@ -133,6 +137,13 @@ def peer_metrics(reg: "telemetry.Registry | None" = None) -> dict:
             "catchup-commit tracking arrays engaged for a lagging peer",
             labelnames=p,
         ),
+        "vote_duplicates": reg.counter(
+            "p2p_peer_vote_duplicates_total",
+            "gossiped votes from this peer already seen (begin_add "
+            "screen) — the 2NxN redundancy the gossip-dedup work "
+            "targets (round 17)",
+            labelnames=p,
+        ),
     }
     setattr(reg, _CACHE_ATTR, fams)
     return fams
@@ -153,6 +164,7 @@ def family_totals(reg: "telemetry.Registry | None" = None) -> dict:
         "peer_vote_gossip_sends": total("vote_gossip_sends"),
         "peer_vote_gossip_send_failures": total("vote_gossip_send_failures"),
         "peer_catchup_commits": total("catchup_commits"),
+        "peer_vote_duplicates": total("vote_duplicates"),
     }
 
 

@@ -394,6 +394,25 @@ def consensus_trace(ctx, last: int = 10) -> dict:
     return {"traces": [t.to_json() for t in rec.last(int(last))]}
 
 
+def tx_trace(ctx, hash="", last: int = 20) -> dict:
+    """Sampled tx-lifecycle traces (round 17, libs/txtrace.py): the
+    completed ring (newest first) PLUS the in-flight actives — a
+    partition-parked tx is visible mid-flight with its stages frozen at
+    wherever it stalled. `hash` filters both lists to one tx (the
+    cross-node causal id ops/txtrace joins on)."""
+    node = getattr(ctx, "node", None)
+    rec = getattr(node, "txtrace", None)
+    if rec is None:
+        return {"traces": [], "active": []}
+    traces = rec.last(int(last))
+    active = rec.active()
+    if hash:
+        want = str(hash).upper()
+        traces = [t for t in traces if t["hash"] == want]
+        active = [t for t in active if t["hash"] == want]
+    return {"traces": traces, "active": active}
+
+
 def unsafe_flush_mempool(ctx) -> dict:
     ctx.mempool.flush()
     return {}
@@ -465,6 +484,7 @@ ROUTES_TABLE = {
     "snapshots": (snapshots, []),
     "metrics": (metrics, []),
     "consensus_trace": (consensus_trace, ["last"]),
+    "tx_trace": (tx_trace, ["hash", "last"]),
     "tx": (tx, ["hash", "prove"]),
     "unconfirmed_txs": (unconfirmed_txs, []),
     "num_unconfirmed_txs": (num_unconfirmed_txs, []),

@@ -172,6 +172,13 @@ class RPCServer(BaseService):
                     # the status code, the body is machine-readable
                     self._serve_health()
                     return
+                if parsed.path.startswith("/debug/"):
+                    # live wedge-triage surface (round 17): the flight
+                    # ring, all-thread stacks, and queue depths — the
+                    # three reads an operator needs against a node
+                    # that stopped answering anything clever
+                    self._serve_debug(parsed.path[len("/debug/"):])
+                    return
                 method = parsed.path.strip("/")
                 if not method:
                     self._respond({"routes": sorted(server.routes)})
@@ -235,6 +242,30 @@ class RPCServer(BaseService):
                     report, status=503 if report["status"] == "failing"
                     else 200,
                 )
+
+            # -- debug introspection (round 17) ----------------------------
+
+            def _serve_debug(self, what: str):
+                """GET /debug/{flight,stacks,queues}. Every read is
+                best-effort against live objects — a subsystem mid-
+                teardown costs its section, never the endpoint (this is
+                the surface for nodes that are already wedged)."""
+                from tendermint_tpu.rpc.core.debug import debug_payload
+
+                node = getattr(server.ctx, "node", None)
+                try:
+                    payload = debug_payload(what, node)
+                except KeyError:
+                    self.send_error(
+                        404, "unknown debug endpoint (flight|stacks|queues)"
+                    )
+                    return
+                except Exception:  # noqa: BLE001 — triage must not take
+                    # the RPC thread down
+                    server.logger.exception("debug render failed")
+                    self.send_error(500, "debug render failed")
+                    return
+                self._respond(payload)
 
             # -- websocket -------------------------------------------------
 

@@ -255,6 +255,8 @@ METRICS_REQUIRED_KEYS = (
     # big-committee vote plane (round 16)
     "consensus_vote_batches", "consensus_vote_batched_sigs",
     "consensus_vote_singletons",
+    # vote-gossip redundancy (round 17): the 2NxN before-number
+    "consensus_vote_duplicates",
     # block store
     "blockstore_height", "blockstore_base",
     # WAL durability plane (present once consensus started)
@@ -267,7 +269,10 @@ METRICS_REQUIRED_KEYS = (
     "p2p_peers_outbound", "p2p_peers_inbound", "p2p_peers_dialing",
     "p2p_peer_send_failures", "p2p_peer_vote_gossip_picks",
     "p2p_peer_vote_gossip_sends", "p2p_peer_vote_gossip_send_failures",
-    "p2p_peer_catchup_commits",
+    "p2p_peer_catchup_commits", "p2p_peer_vote_duplicates",
+    # tx-lifecycle tracing + flight recorder (round 17)
+    "txtrace_sampled", "txtrace_completed", "txtrace_active",
+    "flightrec_events", "flightrec_dumps",
     # health plane (round 15): the /health verdict as flat gauges
     "node_health_status", "node_health_height_age_s",
     "node_health_checks_degraded", "node_health_checks_failing",
@@ -335,7 +340,11 @@ def test_prometheus_exposition_endpoint(node):
                 # round 15: health verdict + the per-peer queue gauges
                 "node_health_status", "node_health_height_age_s",
                 "p2p_peer_send_queue", "p2p_peer_send_queue_high_water",
-                "p2p_peer_last_recv_age_seconds"):
+                "p2p_peer_last_recv_age_seconds",
+                # round 17: tx-lifecycle sampling + flight recorder +
+                # the vote-gossip redundancy number
+                "txtrace_sampled", "flightrec_events",
+                "consensus_vote_duplicates"):
         assert fam in families, fam
         assert families[fam] == "gauge"
     # round 15: the labeled per-peer gossip families are present (and
@@ -348,7 +357,8 @@ def test_prometheus_exposition_endpoint(node):
                 "p2p_peer_vote_gossip_picks_total",
                 "p2p_peer_vote_gossip_sends_total",
                 "p2p_peer_vote_gossip_send_failures_total",
-                "p2p_peer_catchup_commits_total"):
+                "p2p_peer_catchup_commits_total",
+                "p2p_peer_vote_duplicates_total"):
         assert families.get(fam) == "counter", fam
     # the latency-distribution instruments render as real histograms
     for fam in ("devd_stream_chunk_seconds", "devd_single_shot_seconds",
@@ -361,7 +371,10 @@ def test_prometheus_exposition_endpoint(node):
                 "consensus_vote_verify_batch_seconds",
                 # round 15: gossip-arrival distributions + per-peer RTT
                 "consensus_quorum_seconds", "consensus_first_part_seconds",
-                "p2p_peer_ping_rtt_seconds"):
+                "p2p_peer_ping_rtt_seconds",
+                # round 17: the tx-lifecycle distributions
+                "tx_stage_seconds", "tx_commit_latency_seconds",
+                "tx_visible_latency_seconds"):
         assert families.get(fam) == "histogram", fam
     # a live node has fsynced (group commit): the histogram has samples
     count = next(
@@ -516,3 +529,124 @@ def test_fleet_scrapes_single_node(node):
     buf = io.StringIO()
     fleet.render(snapshot, rows, out=buf)
     assert "health ok" in buf.getvalue() or "health degraded" in buf.getvalue()
+
+
+def test_tx_trace_rpc_spans_sum_to_commit_latency(node, client):
+    """Round 17: a committed tx's lifecycle trace is served by the
+    tx_trace RPC with its per-stage spans summing (within 10%, the
+    acceptance bar — they telescope, so this guards the stamp sites) to
+    the measured end-to-end commit latency, and the cross-node CLI
+    renders it."""
+    tx = b"txtrace-rpc-key=txtrace-rpc-val"
+    res = client.broadcast_tx_commit(tx=tx.hex())
+    assert res["deliver_tx"]["code"] == 0
+    want_hash = res["hash"]
+
+    def traced():
+        return [
+            t for t in client.tx_trace(last=50)["traces"]
+            if t["hash"] == want_hash
+        ]
+
+    assert wait_until(lambda: traced(), timeout=30), (
+        client.tx_trace(last=50)
+    )
+    [t] = traced()
+    assert t["outcome"] == "committed"
+    assert t["height"] == res["height"]
+    assert t["source"] == "rpc"
+    # the lifecycle stages a sole-validator commit must cross
+    for stage in ("rpc_ingress", "mempool_admit", "proposal",
+                  "block_commit", "apply", "event_delivery"):
+        assert stage in t["stages"], (stage, t["stages"])
+    # stamped instants are causally ordered
+    from tendermint_tpu.libs.txtrace import STAGES
+
+    stamped = [t["stages"][s] for s in STAGES if s in t["stages"]]
+    assert stamped == sorted(stamped)
+    # spans through block_commit sum to the commit latency within 10%
+    assert t["commit_latency_s"] is not None and t["commit_latency_s"] > 0
+    commit_idx = STAGES.index("block_commit")
+    span_sum = sum(
+        v for k, v in t["spans"].items() if STAGES.index(k) <= commit_idx
+    )
+    assert abs(span_sum - t["commit_latency_s"]) <= max(
+        0.10 * t["commit_latency_s"], 1e-4
+    ), (span_sum, t["commit_latency_s"])
+    assert t["visible_latency_s"] >= t["commit_latency_s"]
+    # hash filter returns exactly this tx
+    only = client.tx_trace(hash=want_hash, last=50)
+    assert [x["hash"] for x in only["traces"]] == [want_hash]
+    # the cross-node joiner + renderer work against the live scrape
+    import io
+
+    from tendermint_tpu.ops import txtrace as ops_txtrace
+
+    url = f"127.0.0.1:{node.rpc_port()}"
+    snapshot = ops_txtrace.collect_txtraces([url], tx_hash=want_hash)
+    rows = ops_txtrace.join_tx_timelines(snapshot)
+    assert len(rows) == 1 and rows[0]["committed"]
+    assert rows[0]["submitted_on"] == url
+    buf = io.StringIO()
+    ops_txtrace.render(rows, out=buf)
+    assert f"committed @h={res['height']}" in buf.getvalue()
+
+
+def test_debug_flight_endpoint(node, client):
+    """GET /debug/flight serves the live event ring: step transitions
+    and WAL endheight marks from real commits, newest events carrying
+    the current chain position."""
+    assert wait_until(lambda: node.block_store.height() >= 2)
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{node.rpc_port()}/debug/flight", timeout=10
+    ) as resp:
+        body = json.loads(resp.read().decode())
+    assert body["enabled"] is True
+    assert body["recorded_total"] >= len(body["events"]) >= 1
+    kinds = {e["kind"] for e in body["events"]}
+    assert "step" in kinds and "wal_endheight" in kinds
+    ts = [e["t"] for e in body["events"]]
+    assert ts == sorted(ts)
+    steps = [e for e in body["events"] if e["kind"] == "step"]
+    assert steps[-1]["height"] >= node.block_store.height() - 1
+
+
+def test_debug_stacks_endpoint(node):
+    """GET /debug/stacks: every live thread with a readable stack — the
+    consensus receive routine must be among them (the wedge-triage
+    read)."""
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{node.rpc_port()}/debug/stacks", timeout=10
+    ) as resp:
+        body = json.loads(resp.read().decode())
+    assert body["count"] >= 3
+    names = {t["name"] for t in body["threads"]}
+    assert any(n.startswith("cs.receiveRoutine") for n in names), names
+    for t in body["threads"]:
+        assert isinstance(t["stack"], list) and t["stack"]
+
+
+def test_debug_queues_endpoint(node):
+    """GET /debug/queues: the backlog view — consensus input queues,
+    pipeline executor, mempool, vote batcher — every section present
+    and numeric on a live node."""
+    import urllib.error
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{node.rpc_port()}/debug/queues", timeout=10
+    ) as resp:
+        body = json.loads(resp.read().decode())
+    for section in ("consensus", "pipeline", "vote_batcher", "mempool",
+                    "p2p"):
+        assert section in body, body.keys()
+        assert "error" not in body[section], body[section]
+    assert body["consensus"]["height"] >= 1
+    assert body["consensus"]["inputs"] >= 0
+    assert body["pipeline"]["poisoned"] is False
+    assert body["mempool"]["size"] >= 0
+    # unknown debug endpoints 404, not 500
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{node.rpc_port()}/debug/nope", timeout=10
+        )
+    assert exc_info.value.code == 404

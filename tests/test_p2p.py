@@ -585,3 +585,60 @@ def test_pex_flood_eviction_requires_ip_match():
     pex.receive(0x00, FakePeer(), b"{}")
     assert str(victim) in book._addrs  # victim survives
     assert pex.switch.stopped  # flooder still disconnected
+
+
+def test_recv_routine_never_inherits_the_admission_timeout():
+    """Round-17 regression for the full-suite fast-sync flake ("stream
+    closed" on both sides, B stuck at 0): Switch.add_peer_from_stream
+    arms a handshake timeout on the RAW socket and only restores
+    blocking mode AFTER add_peer returns — but peer.start() (inside
+    add_peer) launches the mconn recv routine first, and CPython fixes
+    a recv's deadline at call entry, so the first blocking read
+    inherited the armed timeout. A link quiet past that budget (mconn
+    pings only every 40 s; under full-suite load the remote's first
+    sends can be arbitrarily late) then tripped the timeout, which
+    SocketStream.read reports as EOF — the connection died as
+    ConnectionError("stream closed") with nothing wrong on the wire.
+
+    The deterministic interleaving: arm a short admission timeout, let
+    the peer start (recv enters with it armed), restore blocking mode a
+    beat later exactly as the switch does, stay SILENT past the armed
+    budget, then speak. Pre-fix the message is lost and on_error fires
+    "stream closed"; post-fix (Peer.on_start clears the raw socket's
+    timeout before the recv routine launches) the peer survives."""
+    import socket as _socket
+    import struct as _struct
+
+    from tendermint_tpu.p2p.peer import Peer, PeerConfig
+    from tendermint_tpu.p2p.stream import SocketStream
+
+    a, b = _socket.socketpair()
+    a.settimeout(0.4)  # the switch's admission arming
+    got, errs = [], []
+    peer = Peer(
+        SocketStream(a),
+        outbound=False,
+        channel_descs=[ChannelDescriptor(id=0x20)],
+        on_receive=lambda p, ch, msg: got.append((ch, msg)),
+        on_error=lambda p, exc: errs.append(exc),
+        config=PeerConfig(auth_enc=False),
+        node_priv_key=gen_priv_key_ed25519(),
+    )
+    peer.start()           # recv routine enters its first blocking read
+    time.sleep(0.05)
+    a.settimeout(None)     # the finally in add_peer_from_stream — which
+    # pre-fix was too late for the already-parked recv call
+    try:
+        time.sleep(1.0)    # silent link, well past the armed 0.4 s
+        payload = b"hello-after-quiet"
+        b.sendall(
+            _struct.pack(">BBBH", 0x02, 0x20, 1, len(payload)) + payload
+        )
+        assert wait_until(lambda: got, timeout=5), (
+            f"message lost; connection errors: {errs}"
+        )
+        assert got[0] == (0x20, payload)
+        assert not errs, f"connection fataled on a healthy quiet link: {errs}"
+    finally:
+        peer.stop()
+        b.close()
