@@ -24,7 +24,7 @@ test:
 # hold (dots no worse than the seed) — plus the chip-free hash-stream
 # smoke (the two asserted BENCH_r07 rows: streamed hash offload >= 1.3x
 # single-shot on the sim transport, flat host builder >= 1.5x recursive).
-tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke
+tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke wan-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Chip-free bench smoke: every BASELINE config on the pinned CPU backend,
@@ -84,6 +84,18 @@ statetree-smoke:
 # part of `make tier1`.
 net-chaos-smoke:
 	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_NETCHAOS_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_netchaos.py
+
+# WAN/adversary smoke, chip-free (~60 s): bench_wan.py's reduced pass —
+# a 4-node real-TCP signedkv net under ONE seeded WAN profile
+# (continental latency/jitter/loss via ops/netfaults WanProfile) with
+# heights/s + commit skew recorded off the ops/fleet timelines, then one
+# mempool flood burst: a hostile peer pushes garbage signatures at the
+# sig gate, the shed asserted visible in telemetry and the commit
+# cadence asserted >= 1/3 of baseline, final state byte-identical (the
+# full profile matrix + adversary catalog lives in tests/test_netchaos.py,
+# incl. the slow-marked WAN soak). Runs as part of `make tier1`.
+wan-smoke:
+	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_WAN_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_wan.py
 
 # Pipeline smoke, chip-free (~10 s): bench_pipeline.py's reduced pass —
 # a real single-validator durable chain committing the same deterministic
@@ -151,4 +163,4 @@ test_slow:
 native:
 	$(MAKE) -C native
 
-.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke
+.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke wan-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke

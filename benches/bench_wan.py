@@ -1,0 +1,241 @@
+"""WAN + adversarial-load bench (round 18): consensus throughput and
+commit skew of a real-TCP testnet under named WAN profiles, plus the
+flood-shed liveness row (docs/netchaos.md).
+
+BENCH_r12 measured the net under socket faults; this one measures it
+under internet SHAPE (seeded latency/jitter/loss/bandwidth sampled from
+`ops/netfaults.WAN_PROFILES`) and under protocol-fluent ATTACK (the
+hostile-peer mempool flooder from tests/netchaos_common.py). Cross-node
+timing comes from the round-15 `ops/fleet` plane — heights/s from the
+stores, commit skew / quorum-formation from scraped traces only.
+
+Rows:
+- wan:<profile>:  heights/s + committed-tx/s + commit skew (median/max
+                  over the fleet-timeline rows) per WAN profile; >= 3
+                  profiles on a full run, one on the smoke
+- flood_shed:     heights/s while a hostile peer floods garbage
+                  signatures at the sig gate, vs the lan baseline —
+                  liveness asserted >= MIN_FLOOD_RATIO x baseline and
+                  the shed asserted visible in
+                  p2p_adversary_flood_txs_rejected
+- convergence:    final per-height byte-identity across every node
+
+Asserted floors (chip-free — this gates `make wan-smoke` in tier1):
+- every profiled window still commits (heights/s > 0)
+- flood-window heights/s >= MIN_FLOOD_RATIO (default 1/3) x baseline
+- >= 80% of the garbage flood visibly shed in telemetry
+- final byte-identical convergence
+
+BENCH_WAN_SMOKE=1 shrinks to 4 nodes / 1 profile / shorter windows for
+the tier-1 gate (~40 s). Prints ONE JSON line like the other benches;
+writes BENCH_r18.json on full runs.
+Run from the repo root: python benches/bench_wan.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tests"))
+
+SMOKE = os.environ.get("BENCH_WAN_SMOKE", "") == "1"
+N_NODES = int(os.environ.get("BENCH_WAN_NODES", "4"))
+WINDOW_S = float(os.environ.get("BENCH_WAN_WINDOW_S", "8" if SMOKE else "20"))
+PROFILES = (
+    ["continental"]
+    if SMOKE
+    else ["lan", "continental", "intercontinental", "lossy-mobile"]
+)
+FLOOD_TXS = int(os.environ.get("BENCH_WAN_FLOOD_TXS", "1500" if SMOKE else "4000"))
+MIN_FLOOD_RATIO = float(os.environ.get("BENCH_WAN_MIN_FLOOD_RATIO", "0.33"))
+
+
+def _committed_txs(net, upto: int) -> int:
+    store = net.nodes[0].block_store
+    return sum(
+        store.load_block(h).header.num_txs for h in range(1, upto + 1)
+    )
+
+
+def _skew_row(urls, last: int = 12) -> dict:
+    """Commit skew + quorum time from scrapes only (ops/fleet)."""
+    from tendermint_tpu.ops import fleet
+
+    snapshot = fleet.collect(urls, last=last)
+    rows = fleet.build_timeline(
+        {u: e.get("traces", []) for u, e in snapshot.items()}, last=last
+    )
+    skews = [
+        r["commit_skew_s"] for r in rows
+        if r.get("commit_skew_s") is not None and r["nodes_reporting"] >= 2
+    ]
+    quorums = [
+        r["precommit_quorum_s_max"] for r in rows
+        if r.get("precommit_quorum_s_max") is not None
+    ]
+    return {
+        "timeline_rows": len(rows),
+        "commit_skew_s_median": round(statistics.median(skews), 4) if skews else None,
+        "commit_skew_s_max": round(max(skews), 4) if skews else None,
+        "precommit_quorum_s_max": round(max(quorums), 4) if quorums else None,
+    }
+
+
+def main() -> None:
+    # hermetic like tests/conftest.py: never dial a production daemon,
+    # and pin the CPU platform before jax loads
+    os.environ.setdefault("TENDERMINT_DEVD_SOCK", "/nonexistent/devd.sock")
+    os.environ.setdefault("TENDERMINT_TPU_PLATFORM", "cpu")
+
+    from netchaos_common import ChaosNet, MempoolFlooder, wait_until
+    from tendermint_tpu.abci.apps.signedkv import make_sig_tx
+    from tendermint_tpu.ops import fleet, netfaults
+
+    root = tempfile.mkdtemp(prefix="bench-wan-")
+    net = ChaosNet(N_NODES, root, app="signedkv")
+    rows = []
+    try:
+        t0 = time.perf_counter()
+        net.start()
+        assert net.wait_height(2, timeout=150), net.heights()
+        boot_s = time.perf_counter() - t0
+        urls = [f"127.0.0.1:{n.rpc_port()}" for n in net.nodes]
+
+        # light honest tx trickle keeps blocks non-trivial in every row
+        seeds = [bytes([i + 1]) * 32 for i in range(4)]
+
+        def pump(tag: str, n: int) -> None:
+            for i in range(n):
+                tx = make_sig_tx(seeds[i % 4], f"{tag}-{i}={i}".encode())
+                net.broadcast_tx(tx, via=i % N_NODES)
+
+        # -- per-profile windows ------------------------------------------
+        lan_hps = None
+        for profile in PROFILES:
+            net.apply_wan(profile, seed=18)
+            h0 = min(net.heights())
+            tx0 = _committed_txs(net, h0)
+            t0 = time.perf_counter()
+            i = 0
+            while time.perf_counter() - t0 < WINDOW_S:
+                pump(f"{profile}-{i}", 2)
+                i += 1
+                time.sleep(0.5)
+            assert net.wait_height(h0 + 1, timeout=90), (profile, net.heights())
+            wall = time.perf_counter() - t0
+            h1 = min(net.heights())
+            hps = (h1 - h0) / wall
+            assert hps > 0, f"no commits under profile {profile}"
+            row = {
+                "mode": f"wan:{profile}",
+                "heights_per_s": round(hps, 3),
+                "committed_tx_per_s": round(
+                    (_committed_txs(net, h1) - tx0) / wall, 1
+                ),
+            }
+            row.update(_skew_row(urls))
+            wan = netfaults.telemetry_counters()
+            row["wan_delays_applied"] = wan["netfaults_wan_delays_applied"]
+            row["wan_loss_stalls"] = wan["netfaults_wan_loss_stalls"]
+            rows.append(row)
+            if profile == "lan":
+                lan_hps = hps
+        net.clear_wan()
+
+        # -- flood-shed liveness row --------------------------------------
+        # time-to-K-commits, baseline vs under-flood: a windowed
+        # heights/s on a slow box quantizes to 0-2 commits and flakes
+        # the ratio; the time to commit the SAME K heights compares
+        # cleanly (the pump keeps running in both phases)
+        K = 2
+
+        def time_to_commits(tag: str, cap_s: float = 150.0) -> float:
+            h0 = min(net.heights())
+            t0 = time.perf_counter()
+            i = 0
+            while min(net.heights()) < h0 + K:
+                assert time.perf_counter() - t0 < cap_s, (
+                    tag, net.heights(), h0
+                )
+                pump(f"{tag}-{i}", 2)
+                i += 1
+                time.sleep(0.5)
+            return time.perf_counter() - t0
+
+        base_t = time_to_commits("base")
+
+        url1 = urls[1]
+        rejected0 = fleet.metric_value(
+            fleet.fetch_metrics(url1),
+            "p2p_adversary_flood_txs_rejected", default=0.0,
+        )
+        flooder = MempoolFlooder(
+            "127.0.0.1", net.nodes[1].listener.internal_address().port,
+            "netchaos",
+        )
+        try:
+            sent = flooder.flood_garbage(FLOOD_TXS, seed=18)
+            flood_t = time_to_commits("flood")
+            assert wait_until(
+                lambda: fleet.metric_value(
+                    fleet.fetch_metrics(url1),
+                    "p2p_adversary_flood_txs_rejected", default=0.0,
+                ) - rejected0 >= 0.8 * sent,
+                timeout=60,
+            ), "flood not visibly shed"
+        finally:
+            flooder.close()
+        shed = fleet.metric_value(
+            fleet.fetch_metrics(url1),
+            "p2p_adversary_flood_txs_rejected", default=0.0,
+        ) - rejected0
+        base_hps, flood_hps = K / base_t, K / flood_t
+        # the liveness floor: consensus cadence flat within the stated
+        # bound while the flood is shed
+        assert flood_hps >= MIN_FLOOD_RATIO * base_hps, (
+            f"flood degraded liveness: {K} heights took {flood_t:.1f}s "
+            f"flooded vs {base_t:.1f}s baseline (floor {MIN_FLOOD_RATIO}x)"
+        )
+        rows.append({
+            "mode": "flood_shed",
+            "flood_txs_sent": sent,
+            "flood_txs_shed": int(shed),
+            "baseline_heights_per_s": round(base_hps, 3),
+            "flood_heights_per_s": round(flood_hps, 3),
+            "vs_baseline": round(flood_hps / base_hps, 2) if base_hps else None,
+            "asserted_min_ratio": MIN_FLOOD_RATIO,
+            "lan_heights_per_s": round(lan_hps, 3) if lan_hps else None,
+        })
+
+        # -- final byte-identity ------------------------------------------
+        top = min(net.heights())
+        net.assert_converged(top)
+        rows.append({"mode": "convergence", "upto_height": top, "ok": True})
+        boot_row = {"mode": "boot", "nodes": N_NODES, "boot_s": round(boot_s, 2)}
+        rows.insert(0, boot_row)
+    finally:
+        net.stop()
+
+    record = {
+        "bench": "wan",
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": "cpu",
+        "smoke": SMOKE,
+        "rows": rows,
+    }
+    if not SMOKE:
+        with open(os.path.join(ROOT, "BENCH_r18.json"), "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

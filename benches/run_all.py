@@ -68,6 +68,15 @@ Configs (BASELINE.md):
                   overhead bound asserted <2% on the signed-burst
                   shape, wedge-dump artifact row (writes BENCH_r17.json;
                   chip-free)
+ 18 wan          — internet-scale adversarial tier: real-TCP testnet
+                  under named WAN profiles (seeded latency/jitter/loss/
+                  bandwidth via ops/netfaults) — heights/s + commit
+                  skew per profile off the ops/fleet timelines — plus
+                  the flood-shed row: heights cadence asserted >= 1/3
+                  baseline while a hostile peer floods garbage
+                  signatures at the sig gate, shed asserted visible in
+                  p2p_adversary_flood_txs_rejected (writes
+                  BENCH_r18.json; chip-free)
  13 statetree    — authenticated app-state commitment: incremental
                   commit vs full tree rebuild, proof correctness rows,
                   delta-vs-full snapshot bytes (delta asserted <= 0.5x
@@ -108,6 +117,7 @@ BENCHES = {
     "15_fleet": [sys.executable, "benches/bench_fleet.py"],
     "16_committee": [sys.executable, "benches/bench_committee.py"],
     "17_txtrace": [sys.executable, "benches/bench_txtrace.py"],
+    "18_wan": [sys.executable, "benches/bench_wan.py"],
 }
 
 

@@ -338,19 +338,36 @@ class ConsensusReactor(Reactor, BaseService):
     # -- Reactor interface -------------------------------------------------
 
     def get_channels(self) -> list[ChannelDescriptor]:
+        from tendermint_tpu.types.params import MAX_BLOCK_PART_SIZE_BYTES
+
+        # recv_message_capacity right-sized per channel (round 18): the
+        # default 21 MiB is the BLOCK ceiling — on the consensus
+        # channels the largest legal messages are a block part at the
+        # params-validated MAX_BLOCK_PART_SIZE_BYTES bound (hex-doubled
+        # + proof inside JSON — the DATA cap derives from that bound)
+        # and sub-KiB steps/votes/bitarrays. Before this, an
+        # oversized-frame peer could park 21 MiB of never-delivered
+        # reassembly bytes on EVERY channel of every connection
+        # (~147 MiB per hostile peer); now an over-claim errors the
+        # peer at the right-sized bound.
         return [
-            ChannelDescriptor(id=STATE_CHANNEL, priority=5, send_queue_capacity=100),
+            ChannelDescriptor(id=STATE_CHANNEL, priority=5, send_queue_capacity=100,
+                              recv_message_capacity=1 << 16),
             ChannelDescriptor(
                 id=DATA_CHANNEL, priority=10, send_queue_capacity=100,
                 recv_buffer_capacity=50 * 4096,
+                # 2x for hex + proof steps / envelope headroom
+                recv_message_capacity=2 * MAX_BLOCK_PART_SIZE_BYTES + (1 << 16),
             ),
             ChannelDescriptor(
                 id=VOTE_CHANNEL, priority=5, send_queue_capacity=100,
                 recv_buffer_capacity=100 * 100,
+                recv_message_capacity=1 << 16,
             ),
             ChannelDescriptor(
                 id=VOTE_SET_BITS_CHANNEL, priority=1, send_queue_capacity=2,
                 recv_buffer_capacity=1024,
+                recv_message_capacity=1 << 16,
             ),
         ]
 

@@ -81,6 +81,10 @@ class SigBatcher:
         # delivered == submitted - refused.
         self.delivered = 0
         self.fail_open = 0  # batches delivered un-verified (see _deliver)
+        # round 18: gate verdicts that failed — the mempool-flood
+        # adversary's garbage signatures, shed here without ever
+        # reaching the app (p2p_adversary_flood_txs_rejected)
+        self.bad_sigs = 0
         # round 11: per-batch gate latency distribution (dispatch ->
         # verdicts delivered) — scrape-only; the flat mempool_sig_gate_*
         # gauges stay the legacy metrics-RPC surface. One observe per
@@ -198,6 +202,7 @@ class SigBatcher:
         ]
         self._batch_hist.observe(time.perf_counter() - t0)
         self.delivered += len(results)
+        self.bad_sigs += sum(1 for _ctx, ok in results if not ok)
         try:
             self.on_results(results)
         except Exception:  # noqa: BLE001 — a bad sink must not stall the gate
@@ -263,6 +268,10 @@ class Mempool:
         self.counter = 0
         self.height = 0
         self.cache = TxCache()
+        # round 18: already-seen txs shed at the dedup cache — the
+        # valid-but-DUPLICATE arm of a mempool flood (one int += on the
+        # dup path only; the clean path pays nothing)
+        self.cache_dups = 0
         self.wal: Group | None = None
         # recheck cursor: txs in [recheck_cursor, recheck_end] are being
         # re-validated post-commit (mempool/mempool.go:72-75)
@@ -355,6 +364,7 @@ class Mempool:
         (round 17): "rpc" for a client submit, "peer" for gossip."""
         with self._mtx:
             if not self.cache.push(tx):
+                self.cache_dups += 1
                 raise TxInCacheError(tx.hex()[:16])
             # lifecycle ingress, inlined (the <2% discipline): an
             # untraced tx pays ONE local-attribute countdown decrement;

@@ -35,8 +35,16 @@ class MempoolReactor(Reactor, BaseService):
     # -- Reactor interface -------------------------------------------------
 
     def get_channels(self) -> list[ChannelDescriptor]:
+        from tendermint_tpu.codec import jsonval as jv
+
         return [
-            ChannelDescriptor(id=MEMPOOL_CHANNEL, priority=5, send_queue_capacity=64)
+            ChannelDescriptor(
+                id=MEMPOOL_CHANNEL, priority=5, send_queue_capacity=64,
+                # largest legal frame: one MAX_TX_BYTES tx, hex-doubled
+                # inside the JSON envelope (round-18 right-sizing — the
+                # 21 MiB block default gave flooders 2.5x headroom)
+                recv_message_capacity=2 * jv.MAX_TX_BYTES + 4096,
+            )
         ]
 
     def add_peer(self, peer) -> None:

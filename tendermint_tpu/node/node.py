@@ -488,7 +488,14 @@ class Node(BaseService):
             listen_addr=(
                 str(self.listener.external_address()) if self.listener else ""
             ),
-            other=["consensus_version=v1", f"rpc_addr={self.config.rpc.laddr}"],
+            other=[
+                "consensus_version=v1",
+                f"rpc_addr={self.config.rpc.laddr}",
+                # round 18: the genesis commit-format flag rides the
+                # handshake so mixed-format nets refuse loudly at
+                # peering (NodeInfo.compatible_with)
+                f"commit_format={self.genesis_doc.commit_format}",
+            ],
         )
         self.sw.set_node_info(info)
         if self.listener:

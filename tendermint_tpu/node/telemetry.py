@@ -71,6 +71,10 @@ def build_registry(node) -> telemetry.Registry:
     from tendermint_tpu.consensus import trace as ctrace
     from tendermint_tpu.consensus import vote_batcher as cvb
     from tendermint_tpu.ops import faults  # noqa: F401 — import = register
+    from tendermint_tpu.ops import netfaults  # noqa: F401 — import =
+    # register: the scrape-only netfaults_* family set (incl. the
+    # round-18 netfaults_wan_* WAN-shaping counters) is stable from the
+    # first scrape, all-zero outside a chaos harness
     from tendermint_tpu.p2p import secret_connection
     from tendermint_tpu.p2p import telemetry as p2p_telemetry
 
@@ -159,12 +163,19 @@ def build_registry(node) -> telemetry.Registry:
     )
 
     def mempool() -> dict:
-        out = {"size": node.mempool.size()}
+        # cache_dups: already-seen txs shed at the dedup cache — under
+        # a duplicate flood this is the shed counter; on a quiet net it
+        # counts benign gossip redundancy (round 18)
+        out = {
+            "size": node.mempool.size(),
+            "cache_dups": node.mempool.cache_dups,
+        }
         batcher = node.mempool.sig_batcher
         if batcher is not None:
             out["sig_gate_dropped"] = batcher.dropped
             out["sig_gate_delivered"] = batcher.delivered
             out["sig_gate_fail_open"] = batcher.fail_open
+            out["sig_gate_bad_sigs"] = batcher.bad_sigs
         return out
 
     reg.register_producer("mempool", mempool)
@@ -235,6 +246,26 @@ def build_registry(node) -> telemetry.Registry:
         # (sums across peers, the _other overflow series included) so
         # the legacy RPC sees the wedge signal too
         out.update(p2p_telemetry.family_totals(reg))
+        # round 18: defense-side adversary accounting — what hostile
+        # pressure this node shed (flat on both surfaces so the
+        # adversarial scenario matrix asserts on scrapes alone)
+        adv = node.sw.adversary_stats()
+        out["adversary_eclipse_dials_refused"] = (
+            adv["ip_range_refused"] + adv["max_peers_refused"]
+        )
+        out["adversary_handshake_rejects"] = adv["handshake_rejects"]
+        out["adversary_frame_violations"] = adv["frame_violations"]
+        # gate-level sheds only: bad signatures are unambiguously
+        # hostile, saturation drops are shed load. Dedup-cache hits
+        # deliberately do NOT count here — honest gossip re-delivery
+        # and client resubmits hit the cache too, and an operator
+        # alerting on an adversary_* family must not page on normal
+        # redundancy (the dup-storm arm reads mempool_cache_dups)
+        flood = 0
+        batcher = node.mempool.sig_batcher
+        if batcher is not None:
+            flood = batcher.bad_sigs + batcher.dropped
+        out["adversary_flood_txs_rejected"] = flood
         return out
 
     reg.register_producer("p2p", p2p)

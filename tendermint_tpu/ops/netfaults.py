@@ -20,6 +20,13 @@ top of the byte relay sit the network fault controls:
 - `set_delay(c2s_s=, s2c_s=)`: ASYMMETRIC per-direction latency — each
   relayed chunk sleeps before forwarding, so a link can be slow one way
   and fast the other (the classic consensus-timeout aggravator).
+- `set_wan(profile, seed=)` (round 18): seeded WAN shaping sampled from
+  a named `WanProfile` distribution (`lan`, `continental`,
+  `intercontinental`, `lossy-mobile`) — per-link base latency sampled
+  once per direction, per-chunk jitter, a retransmit-STALL loss model
+  (a TCP relay cannot drop stream bytes; loss is latency), bandwidth
+  pacing, and a severe-loss connection-reset arm. Counted in the
+  `netfaults_wan_*` scrape family.
 - `set_reorder(n)`: swap the next n pairs of adjacent chunks. The
   SecretConnection's counter-nonce AEAD makes stream reordering
   DETECTABLE-BY-DESIGN: the receiver sees an authentication failure,
@@ -52,10 +59,12 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import random
 import signal
 import socket
 import threading
 import time
+from dataclasses import dataclass
 
 from tendermint_tpu.ops.faults import FaultPlan, _kill_sock
 
@@ -67,7 +76,89 @@ _COUNTER_KEYS = (
     "conns", "conns_refused", "bytes_c2s", "bytes_s2c",
     "partitions", "heals", "partition_drops",
     "delays_injected", "reorders_injected", "plan_faults",
+    # WAN tier (round 18): per-chunk latency/jitter actually applied,
+    # cumulative sleep injected, retransmit-stall hits from the loss
+    # model, bytes paced through the bandwidth cap, and severe-loss
+    # connection resets
+    "wan_delays_applied", "wan_delay_seconds", "wan_loss_stalls",
+    "wan_bytes_shaped", "wan_resets",
 )
+
+
+# -- WAN profiles (round 18) --------------------------------------------------
+#
+# A LinkProxy is a TCP byte relay, so byte LOSS cannot be modeled by
+# dropping bytes (the AEAD layer above would read it as tamper, and real
+# TCP never loses stream bytes anyway — loss shows up as retransmit
+# latency). The loss model here is therefore a per-chunk retransmit
+# STALL (an RTO-shaped delay spike) plus, for the severely lossy
+# profiles, a small per-chunk probability of a full connection reset
+# (the carrier-grade-NAT / cell-handoff failure mode; the dialing
+# switch's persistent reconnect loop rides through it). Bandwidth caps
+# pace each chunk by its serialization delay.
+
+
+@dataclass(frozen=True)
+class WanProfile:
+    """A named distribution of link behavior. `delay_range_s` is sampled
+    ONCE per link direction with a seeded RNG (links differ, runs with
+    the same seed do not); jitter/loss/reset draw per chunk from the
+    same seeded stream."""
+
+    name: str
+    delay_range_s: tuple[float, float]  # one-way base latency range
+    jitter_s: float = 0.0               # uniform [0, jitter) per chunk
+    loss: float = 0.0                   # P(chunk pays a retransmit stall)
+    loss_stall_s: float = 0.0           # the stall (TCP RTO analogue)
+    bandwidth_bps: float = 0.0          # 0 = uncapped
+    reset_prob: float = 0.0             # P(connection reset per chunk)
+
+
+WAN_PROFILES: dict[str, WanProfile] = {
+    "lan": WanProfile("lan", (0.0002, 0.001), jitter_s=0.0005),
+    "continental": WanProfile(
+        "continental", (0.012, 0.035), jitter_s=0.004,
+        loss=0.004, loss_stall_s=0.05, bandwidth_bps=8e6,
+    ),
+    "intercontinental": WanProfile(
+        "intercontinental", (0.04, 0.09), jitter_s=0.012,
+        loss=0.01, loss_stall_s=0.1, bandwidth_bps=4e6,
+    ),
+    "lossy-mobile": WanProfile(
+        "lossy-mobile", (0.03, 0.08), jitter_s=0.03,
+        loss=0.05, loss_stall_s=0.12, bandwidth_bps=2e6,
+        reset_prob=0.0003,
+    ),
+}
+
+
+def wan_profile(profile: "WanProfile | str") -> WanProfile:
+    """Resolve a profile by name (the scenario-matrix spelling) or pass
+    a custom WanProfile through."""
+    if isinstance(profile, WanProfile):
+        return profile
+    try:
+        return WAN_PROFILES[profile]
+    except KeyError:
+        raise KeyError(
+            f"unknown WAN profile {profile!r}; "
+            f"known: {sorted(WAN_PROFILES)}"
+        ) from None
+
+
+def geo_clusters(n: int, k: int) -> list[list[int]]:
+    """Contiguous split of nodes 0..n-1 into k geo clusters — the
+    "k clusters x m nodes" declaration scenarios use instead of hand-set
+    delays (NetFabric.apply_geo maps intra/inter profiles onto it)."""
+    if k <= 0:
+        raise ValueError("need at least one cluster")
+    base, extra = divmod(n, k)
+    out, start = [], 0
+    for c in range(k):
+        size = base + (1 if c < extra else 0)
+        out.append(list(range(start, start + size)))
+        start += size
+    return [c for c in out if c]
 
 
 class LinkProxy:
@@ -85,6 +176,9 @@ class LinkProxy:
         self._partitioned = False
         self._delay = {"c2s": 0.0, "s2c": 0.0}
         self._reorder_budget = 0
+        # WAN shaping (round 18): (profile, per-direction sampled base
+        # delay, seeded per-chunk RNG) or None. Armed by set_wan.
+        self._wan: tuple[WanProfile, dict, random.Random] | None = None
         self._counters = {k: 0 for k in _COUNTER_KEYS}
         self._conns: list[socket.socket] = []
         self._stop = threading.Event()
@@ -138,6 +232,31 @@ class LinkProxy:
             self._delay["c2s"] = max(0.0, float(c2s_s))
             self._delay["s2c"] = max(0.0, float(s2c_s))
 
+    def set_wan(self, profile: "WanProfile | str | None",
+                seed: int = 0) -> None:
+        """Arm (or clear, profile=None) a WAN profile on this link. The
+        per-direction base latency is sampled HERE, once, from a RNG
+        seeded by (seed, link name, profile name) — deterministic across
+        runs, different across links — so a fabric-wide apply_wan gives
+        every link its own stable place in the distribution. Per-chunk
+        jitter/loss/reset draws continue from the same stream."""
+        if profile is None:
+            with self._mtx:
+                self._wan = None
+            return
+        p = wan_profile(profile)
+        rng = random.Random(f"{seed}:{self.name}:{p.name}")
+        base = {
+            "c2s": rng.uniform(*p.delay_range_s),
+            "s2c": rng.uniform(*p.delay_range_s),
+        }
+        with self._mtx:
+            self._wan = (p, base, rng)
+
+    def wan_profile_name(self) -> str | None:
+        with self._mtx:
+            return self._wan[0].name if self._wan is not None else None
+
     def set_reorder(self, swaps: int) -> None:
         """Swap the next `swaps` pairs of adjacent relayed chunks
         (either direction claims from the shared budget). The AEAD layer
@@ -151,10 +270,20 @@ class LinkProxy:
         support: the next dial succeeds)."""
         self._drop_all(count_as=None)
 
+    def retarget(self, upstream: tuple[str, int]) -> None:
+        """Point the link at a new upstream (rolling-restart support:
+        a restarted node binds a fresh listener port; the fabric's
+        inbound links re-aim so the dialers' persistent reconnect loops
+        re-peer without test intervention). Live connections keep their
+        old upstream until dropped."""
+        with self._mtx:
+            self.upstream = tuple(upstream)
+
     def stats(self) -> dict:
         with self._mtx:
             out = {f"netfaults_{k}": v for k, v in self._counters.items()}
             out["netfaults_partitioned"] = int(self._partitioned)
+            out["netfaults_wan_profiled"] = int(self._wan is not None)
             return out
 
     def stop(self) -> None:
@@ -269,6 +398,38 @@ class LinkProxy:
                     want_reorder = self._reorder_budget > 0 and held is None
                     if want_reorder:
                         self._reorder_budget -= 1
+                    wan = self._wan
+                    wan_sleep, wan_stalled, wan_reset = 0.0, False, False
+                    if wan is not None:
+                        # per-chunk draws under the link lock: both relay
+                        # directions share the seeded RNG stream
+                        p, base, rng = wan
+                        wan_sleep = base[direction]
+                        if p.jitter_s:
+                            wan_sleep += rng.uniform(0.0, p.jitter_s)
+                        if p.bandwidth_bps:
+                            # bandwidth_bps is BITS per second (the
+                            # profile table says Mbps): 8 bits/byte
+                            wan_sleep += len(data) * 8 / p.bandwidth_bps
+                        if p.loss and rng.random() < p.loss:
+                            wan_sleep += p.loss_stall_s
+                            wan_stalled = True
+                        if p.reset_prob and rng.random() < p.reset_prob:
+                            wan_reset = True
+                if wan is not None:
+                    self._note("wan_delays_applied")
+                    self._note("wan_delay_seconds", wan_sleep)
+                    if p.bandwidth_bps:
+                        self._note("wan_bytes_shaped", len(data))
+                    if wan_stalled:
+                        self._note("wan_loss_stalls")
+                    if wan_reset:
+                        # severe-loss model: the connection dies (the
+                        # finally clause resets both sides); the dialing
+                        # switch's persistent reconnect loop recovers
+                        self._note("wan_resets")
+                        return
+                    time.sleep(wan_sleep)
                 if delay > 0:
                     self._note("delays_injected")
                     time.sleep(delay)
@@ -340,6 +501,37 @@ class NetFabric:
         for link in self.links().values():
             link.heal()
 
+    # -- WAN tier (round 18) ------------------------------------------------
+
+    def apply_wan(self, profile: "WanProfile | str | None",
+                  seed: int = 0) -> None:
+        """One WAN profile across every link (per-link latencies still
+        differ: each samples its own base delay from the seeded
+        distribution). None clears."""
+        for link in self.links().values():
+            link.set_wan(profile, seed=seed)
+
+    def clear_wan(self) -> None:
+        self.apply_wan(None)
+
+    def apply_geo(self, clusters: list[list[int]],
+                  intra: "WanProfile | str" = "lan",
+                  inter: "WanProfile | str" = "intercontinental",
+                  seed: int = 0) -> None:
+        """Geo-cluster topology: low latency inside a cluster, high
+        between clusters — "k clusters x m nodes" declared as data
+        (geo_clusters(n, k) builds the cluster lists) instead of
+        hand-set per-link delays. Links touching a node outside every
+        cluster get the inter profile (conservative)."""
+        member = {
+            node: ci for ci, cl in enumerate(clusters) for node in cl
+        }
+        for (i, j), link in self.links().items():
+            same = (
+                i in member and j in member and member[i] == member[j]
+            )
+            link.set_wan(intra if same else inter, seed=seed)
+
     def set_delay(self, i: int, j: int, c2s_s: float = 0.0,
                   s2c_s: float = 0.0) -> None:
         link = self.link(i, j)
@@ -351,6 +543,7 @@ class NetFabric:
         """Aggregate flat counters over every link (the scrape surface)."""
         out = {f"netfaults_{k}": 0 for k in _COUNTER_KEYS}
         out["netfaults_partitioned"] = 0
+        out["netfaults_wan_profiled"] = 0
         out["netfaults_links"] = 0
         for link in self.links().values():
             out["netfaults_links"] += 1
@@ -386,6 +579,7 @@ def unregister_fabric(fabric: NetFabric) -> None:
 def telemetry_counters() -> dict:
     out = {f"netfaults_{k}": 0 for k in _COUNTER_KEYS}
     out["netfaults_partitioned"] = 0
+    out["netfaults_wan_profiled"] = 0
     out["netfaults_links"] = 0
     with _reg_mtx:
         fabrics = list(_fabrics)
@@ -430,6 +624,10 @@ def main(argv=None) -> int:
     ap.add_argument("--delay-s2c", type=float, default=0.0)
     ap.add_argument("--reorder", type=int, default=0,
                     help="swap the next N adjacent chunk pairs")
+    ap.add_argument("--wan-profile", default="",
+                    help=f"WAN shaping profile: one of {sorted(WAN_PROFILES)}")
+    ap.add_argument("--wan-seed", type=int, default=0,
+                    help="seed for the per-link WAN latency sample")
     args = ap.parse_args(argv)
 
     host, port = args.upstream.rsplit(":", 1)
@@ -437,6 +635,8 @@ def main(argv=None) -> int:
     proxy.set_delay(c2s_s=args.delay_c2s, s2c_s=args.delay_s2c)
     if args.reorder:
         proxy.set_reorder(args.reorder)
+    if args.wan_profile:
+        proxy.set_wan(args.wan_profile, seed=args.wan_seed)
     done = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: done.set())

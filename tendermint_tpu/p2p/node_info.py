@@ -30,15 +30,35 @@ class NodeInfo:
         """Peer key: hex of the node pubkey address."""
         return self.pub_key.address().hex()
 
+    def _commit_format(self) -> str:
+        """The genesis `commit_format` flag this node runs under, from
+        the `other` key/value list; peers predating the flag (or bare
+        test switches that never set it) are "full" — exactly the
+        genesis default, so homogeneous old nets stay compatible."""
+        for entry in self.other:
+            if isinstance(entry, str) and entry.startswith("commit_format="):
+                return entry.split("=", 1)[1]
+        return "full"
+
     def compatible_with(self, other: "NodeInfo") -> str | None:
         """None if compatible, else a human-readable reason
-        (p2p/types.go:28-56: same protocol version, same network)."""
+        (p2p/types.go:28-56: same protocol version, same network; round
+        18 adds the genesis commit_format flag — a mixed-format net must
+        refuse LOUDLY at the handshake, not wedge later when one side
+        gossips commit bytes the other's decode_commit rejects,
+        docs/committee.md)."""
         mine = self.version.split("/", 1)[0]
         theirs = other.version.split("/", 1)[0]
         if mine != theirs:
             return f"protocol version mismatch: {mine} vs {theirs}"
         if self.network != other.network:
             return f"network mismatch: {self.network} vs {other.network}"
+        if self._commit_format() != other._commit_format():
+            return (
+                f"commit format mismatch: {self._commit_format()} vs "
+                f"{other._commit_format()} (mixed-format nets refuse at "
+                f"handshake; docs/committee.md)"
+            )
         return None
 
     def to_json(self) -> dict:

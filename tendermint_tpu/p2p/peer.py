@@ -30,26 +30,92 @@ class PeerConfig:
     mconfig: MConnConfig = field(default_factory=MConnConfig)
 
 
+def _raw_sock(stream):
+    """The raw socket under a wrapper chain (fuzz wrapper, secret
+    connection — each keeps its inner stream as `.stream`), or None for
+    socketless streams (in-process test fabrics)."""
+    obj, hops = stream, 0
+    while obj is not None and hops < 4:
+        sock = getattr(obj, "sock", None)
+        if sock is not None:
+            return sock
+        obj = getattr(obj, "stream", None)
+        hops += 1
+    return None
+
+
 def exchange_node_info(stream, our_info: NodeInfo, timeout: float) -> NodeInfo:
     """Concurrent length-prefixed NodeInfo swap (p2p/peer.go:159-200).
     Write first, then read — both sides do the same, so no deadlock
-    (payloads are far below socket buffer sizes)."""
-    raw = our_info.encode()
-    stream.write(_HS_LEN.pack(len(raw)) + raw)
+    (payloads are far below socket buffer sizes).
+
+    The deadline is ABSOLUTE (round 18): the switch's admission timeout
+    used to bound each socket READ at `timeout`, so a byte-dribbling
+    peer — one byte every timeout-minus-epsilon — could hold the
+    admission thread for MAX_NODE_INFO_SIZE reads (a slow-loris against
+    the handshake path). Every read now re-arms the socket with the
+    REMAINING budget, exactly like the SecretConnection handshake; the
+    prior socket timeout is restored on exit so the caller's own
+    bookkeeping (Switch.add_peer_from_stream) is undisturbed."""
+    import socket as _socket
+
+    deadline = (
+        time.monotonic() + timeout if timeout and timeout > 0 else None
+    )
+    sock = _raw_sock(stream)
+    prior = None
+    if sock is not None:
+        try:
+            prior = sock.gettimeout()
+        except OSError:
+            sock = None
+
+    def arm() -> None:
+        if deadline is None or sock is None:
+            return
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise ConnectionError("node-info handshake timed out")
+        try:
+            sock.settimeout(remaining)
+        except OSError:
+            pass
 
     def read_exact(n: int) -> bytes:
         buf = bytearray()
         while len(buf) < n:
-            chunk = stream.read(n - len(buf))
+            arm()
+            try:
+                chunk = stream.read(n - len(buf))
+            except _socket.timeout as exc:
+                raise ConnectionError(
+                    "node-info handshake timed out"
+                ) from exc
             if not chunk:
-                raise ConnectionError("stream closed during node-info handshake")
+                # SocketStream swallows OSError (incl. timeouts) into
+                # b"" — distinguish deadline expiry from a peer hangup
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise ConnectionError("node-info handshake timed out")
+                raise ConnectionError(
+                    "stream closed during node-info handshake"
+                )
             buf += chunk
         return bytes(buf)
 
-    (ln,) = _HS_LEN.unpack(read_exact(_HS_LEN.size))
-    if ln > MAX_NODE_INFO_SIZE:
-        raise ValueError(f"node info too large: {ln}")
-    return NodeInfo.decode(read_exact(ln))
+    try:
+        raw = our_info.encode()
+        arm()
+        stream.write(_HS_LEN.pack(len(raw)) + raw)
+        (ln,) = _HS_LEN.unpack(read_exact(_HS_LEN.size))
+        if ln > MAX_NODE_INFO_SIZE:
+            raise ValueError(f"node info too large: {ln}")
+        return NodeInfo.decode(read_exact(ln))
+    finally:
+        if sock is not None:
+            try:
+                sock.settimeout(prior)
+            except OSError:
+                pass
 
 
 class Peer(BaseService):

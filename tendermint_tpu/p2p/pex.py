@@ -41,7 +41,12 @@ class PEXReactor(Reactor, BaseService):
     # -- Reactor interface -------------------------------------------------
 
     def get_channels(self) -> list[ChannelDescriptor]:
-        return [ChannelDescriptor(id=PEX_CHANNEL, priority=1, send_queue_capacity=10)]
+        # a pex_addrs message carries <= 250 "host:port" strings — 64 KiB
+        # bounds it with an order of magnitude to spare (round-18
+        # recv-ceiling right-sizing; the default was the 21 MiB block cap)
+        return [ChannelDescriptor(id=PEX_CHANNEL, priority=1,
+                                  send_queue_capacity=10,
+                                  recv_message_capacity=1 << 16)]
 
     def add_peer(self, peer) -> None:
         info = peer.node_info

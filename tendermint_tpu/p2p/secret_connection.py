@@ -89,6 +89,11 @@ def _counters() -> dict:
             "p2p_secretconn_auth_failures_total",
             "AEAD frame authentication failures (tamper/reorder/desync)",
         ),
+        "oversized_frames": reg.counter(
+            "p2p_secretconn_oversized_frames_total",
+            "frames refused for an illegal length claim before any "
+            "payload was buffered (oversized-frame adversary)",
+        ),
     }
 
 
@@ -272,6 +277,21 @@ class SecretConnection:
     def _read_msg(self) -> bytes:
         """One frame's plaintext."""
         (clen,) = _LEN.unpack(self._read_exact(_LEN.size))
+        if clen > DATA_MAX_SIZE + 16:
+            # oversized-frame adversary (round 18): our writer never
+            # exceeds plaintext DATA_MAX_SIZE + the 16-byte tag, so a
+            # larger claim is protocol abuse — refuse BEFORE buffering
+            # the claimed payload (the old path read up to 64 KiB of
+            # attacker bytes per frame just to fail the AEAD tag)
+            _counters()["oversized_frames"].inc()
+            _counters()["auth_failures"].inc()
+            err = SecretConnectionError(
+                f"secret connection: oversized frame claim ({clen} B; "
+                f"legal max {DATA_MAX_SIZE + 16})"
+            )
+            self._poisoned = err
+            self.stream.close()
+            raise err
         ct = self._read_exact(clen)
         try:
             pt = self._recv_aead.decrypt(self._nonce12(self._recv_nonce), ct, None)

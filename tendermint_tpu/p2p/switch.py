@@ -90,7 +90,29 @@ class Switch(BaseService):
         from tendermint_tpu.p2p.ip_range_counter import IPRangeCounter
 
         self.ip_ranges = IPRangeCounter()
+        # defense-side adversary accounting (round 18): how much hostile
+        # pressure this switch shed — eclipse dials refused at the
+        # IP-range / max-peers gates, admission handshakes rejected
+        # (timeouts, incompatible versions/formats, bad bytes), and
+        # framing-contract violations that dropped a live peer
+        # (oversized frames, recv-ceiling breaches, unknown channels).
+        # Exported as p2p_adversary_* on both metric surfaces
+        # (node/telemetry.py).
+        self.adversary = {
+            "ip_range_refused": 0,
+            "max_peers_refused": 0,
+            "handshake_rejects": 0,
+            "frame_violations": 0,
+        }
         self._mtx = threading.Lock()
+
+    def _note_adversary(self, kind: str) -> None:
+        with self._mtx:
+            self.adversary[kind] += 1
+
+    def adversary_stats(self) -> dict:
+        with self._mtx:
+            return dict(self.adversary)
 
     # -- registry (before start) ------------------------------------------
 
@@ -184,6 +206,7 @@ class Switch(BaseService):
             self.logger.info(
                 "rejecting inbound peer: at max_num_peers=%d", max_peers
             )
+            self._note_adversary("max_peers_refused")
             try:
                 sock.close()
             except OSError:
@@ -198,6 +221,7 @@ class Switch(BaseService):
             pass
         if ip and not self.ip_ranges.try_add(ip):
             self.logger.info("rejecting inbound peer %s: IP range at limit", ip)
+            self._note_adversary("ip_range_refused")
             try:
                 sock.close()
             except OSError:
@@ -209,6 +233,7 @@ class Switch(BaseService):
             self.add_peer_from_stream(stream, outbound=False)
         except Exception as exc:  # noqa: BLE001 — one bad peer can't kill accept
             self.logger.info("inbound peer rejected: %s", exc)
+            self._note_adversary("handshake_rejects")
             self._uncount_stream(stream)
             try:
                 sock.close()
@@ -295,6 +320,17 @@ class Switch(BaseService):
             reactor.receive(ch_id, peer, msg_bytes)
 
     def _on_peer_error(self, peer: Peer, exc: Exception) -> None:
+        # framing-contract violations are adversary-shaped: an oversized
+        # SecretConnection frame claim / AEAD tamper, a reassembly past
+        # a channel's recv ceiling, an unknown channel or packet type —
+        # as opposed to plain IO errors (hangups, resets), which stay
+        # uncounted. Both classes are TYPED (conn.FrameViolation,
+        # SecretConnectionError), never sniffed from message text.
+        from tendermint_tpu.p2p.conn import FrameViolation
+        from tendermint_tpu.p2p.secret_connection import SecretConnectionError
+
+        if isinstance(exc, (SecretConnectionError, FrameViolation)):
+            self._note_adversary("frame_violations")
         self.stop_peer_for_error(peer, exc)
 
     # -- dialing ------------------------------------------------------------

@@ -24,6 +24,15 @@ class BlockGossipParams:
     block_part_size_bytes: int = 65536  # types/params.go:62-68
 
 
+# Upper bound on a legal block part (round 18): the consensus DATA
+# channel's reassembly ceiling is sized to carry any part this
+# validation admits (a part hex-doubles inside its JSON gossip message,
+# plus proof steps — consensus/reactor.get_channels derives from this).
+# Without the bound, a genesis declaring a bigger part size would make
+# every block-part message a fatal frame violation at the recv ceiling.
+MAX_BLOCK_PART_SIZE_BYTES = 1 << 18  # 256 KiB
+
+
 @dataclass
 class ConsensusParams:
     block_size: BlockSizeParams = field(default_factory=BlockSizeParams)
@@ -36,6 +45,12 @@ class ConsensusParams:
             return "block_size.max_bytes must be > 0"
         if self.block_gossip.block_part_size_bytes <= 0:
             return "block_gossip.block_part_size_bytes must be > 0"
+        if self.block_gossip.block_part_size_bytes > MAX_BLOCK_PART_SIZE_BYTES:
+            return (
+                "block_gossip.block_part_size_bytes must be <= "
+                f"{MAX_BLOCK_PART_SIZE_BYTES} (the consensus data "
+                "channel's recv ceiling is sized to this bound)"
+            )
         return None
 
     def to_json(self):

@@ -12,14 +12,25 @@ severed link keeps retrying through an outage and heals without test
 intervention (switch reconnect cadence is env-tuned tight for tests).
 Inbound/outbound dedup never races: only i dials j, never both.
 
+Round 18 grows this into the ADVERSARIAL network tier
+(docs/netchaos.md): ChaosNet gains WAN-profile / geo-cluster verbs
+(seeded latency distributions over the same link proxies), a rolling
+restart arm (stop -> retarget links -> statesync re-join), per-node
+genesis commit_format overrides (mixed-version nets), and soak
+instrumentation (RSS / disk / flight-recorder quietness); the
+VoteInjector generalizes into a HostilePeer family — mempool flooder,
+oversized-frame peer, slow-loris, eclipse identities, frame corruptor —
+every one speaking the real encrypted protocol.
+
 Shared by tests/test_netchaos.py (the scenario matrix) and
-benches/bench_netchaos.py (BENCH_r12: partition-heal recovery time,
-committed-tx/s under churn), which is why it lives in a _common module
-like tests/consensus_common.py.
+benches/bench_netchaos.py + benches/bench_wan.py (BENCH_r12/r18),
+which is why it lives in a _common module like
+tests/consensus_common.py.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import shutil
 import socket
@@ -28,7 +39,7 @@ import time
 from tendermint_tpu.config.config import test_config
 from tendermint_tpu.config.toml import ensure_root
 from tendermint_tpu.node.node import Node, default_new_node
-from tendermint_tpu.ops.netfaults import NetFabric
+from tendermint_tpu.ops.netfaults import NetFabric, geo_clusters
 from tendermint_tpu.crypto.keys import gen_priv_key_ed25519
 from tendermint_tpu.types import GenesisDoc, GenesisValidator, PrivValidatorFS
 
@@ -54,11 +65,16 @@ class ChaosNet:
     """N-validator kvstore net over real TCP through fault proxies."""
 
     def __init__(self, n: int, root: str, app: str = "kvstore",
-                 snapshot_interval: int = 0):
+                 snapshot_interval: int = 0,
+                 commit_format_of: dict[int, str] | None = None):
         self.n = n
         self.root = root
         self.app = app
         self.snapshot_interval = snapshot_interval
+        # mixed-version nets (round 18): per-node genesis commit_format
+        # override — {idx: "aggregate"} boots node idx under the other
+        # flag; NodeInfo.compatible_with refuses the peering loudly
+        self.commit_format_of = commit_format_of or {}
         self.fabric = NetFabric(name=f"chaosnet-{os.path.basename(root)}")
         self.nodes: list[Node] = []
         self.pvs: list[PrivValidatorFS] = []
@@ -100,7 +116,11 @@ class ChaosNet:
             cfg.statesync.rpc_servers = ",".join(
                 f"127.0.0.1:{self.nodes[j].rpc_port()}" for j in statesync_from
             )
-        self.genesis.save_as(cfg.base.genesis_file())
+        gen = self.genesis
+        fmt = self.commit_format_of.get(idx)
+        if fmt is not None:
+            gen = dataclasses.replace(gen, commit_format=fmt)
+        gen.save_as(cfg.base.genesis_file())
         return cfg
 
     def _listener_port(self, j: int) -> int:
@@ -161,6 +181,142 @@ class ChaosNet:
     def clear_delays(self) -> None:
         for link in self.fabric.links().values():
             link.set_delay(0, 0)
+
+    # -- WAN tier (round 18) -------------------------------------------------
+
+    # the test preset's 10x-shortened consensus timeouts (100 ms propose)
+    # can NEVER cover an intercontinental link (40-90 ms per relayed
+    # chunk): proposals always miss the window and rounds churn forever
+    # with 1 ms deltas. Real WAN operators provision timeouts for RTT
+    # (the production schedule is 3 s propose); applying a heavy profile
+    # therefore also raises the live nodes' timeout schedule to a
+    # WAN-shaped floor, and clear_wan restores the test preset. The
+    # schedule is read per round from the shared config object, so the
+    # mutation takes effect at the next round.
+    _WAN_TIMEOUT_FLOOR = {
+        "timeout_propose": 1.0, "timeout_propose_delta": 0.25,
+        "timeout_prevote": 0.4, "timeout_prevote_delta": 0.2,
+        "timeout_precommit": 0.4, "timeout_precommit_delta": 0.2,
+    }
+
+    def _wan_timeouts(self, on: bool) -> None:
+        for node in self.nodes:
+            ccfg = node.config.consensus
+            if on:
+                if not hasattr(ccfg, "_pre_wan_timeouts"):
+                    ccfg._pre_wan_timeouts = {
+                        k: getattr(ccfg, k) for k in self._WAN_TIMEOUT_FLOOR
+                    }
+                for k, floor in self._WAN_TIMEOUT_FLOOR.items():
+                    setattr(ccfg, k, max(getattr(ccfg, k), floor))
+            else:
+                pre = getattr(ccfg, "_pre_wan_timeouts", None)
+                if pre is not None:
+                    for k, v in pre.items():
+                        setattr(ccfg, k, v)
+
+    @staticmethod
+    def _is_heavy(profile) -> bool:
+        from tendermint_tpu.ops.netfaults import wan_profile
+
+        return profile is not None and wan_profile(profile).name != "lan"
+
+    def apply_wan(self, profile, seed: int = 0) -> None:
+        """One named WAN profile (ops/netfaults.WAN_PROFILES) across
+        every link; per-link latencies still differ (seeded sample).
+        Heavy profiles also raise the consensus timeout schedule to the
+        WAN floor (see _WAN_TIMEOUT_FLOOR)."""
+        self.fabric.apply_wan(profile, seed=seed)
+        self._wan_timeouts(self._is_heavy(profile))
+
+    def apply_geo_clusters(self, clusters=None, k: int = 2,
+                           intra="lan", inter="intercontinental",
+                           seed: int = 0) -> list[list[int]]:
+        """Geo-cluster topology declared as data: "k clusters x m
+        nodes" — low intra-cluster latency, high inter-cluster. Returns
+        the cluster lists actually applied."""
+        if clusters is None:
+            clusters = geo_clusters(self.n, k)
+        self.fabric.apply_geo(clusters, intra=intra, inter=inter, seed=seed)
+        self._wan_timeouts(self._is_heavy(inter) or self._is_heavy(intra))
+        return clusters
+
+    def clear_wan(self) -> None:
+        self.fabric.clear_wan()
+        self._wan_timeouts(False)
+
+    # -- rolling restart (round 18) ------------------------------------------
+
+    def restart_node(self, idx: int, statesync_from: list[int] | None = None,
+                     wipe: bool = False) -> Node:
+        """Stop node idx and boot it again — same home (a plain restart)
+        or wiped + statesync (the rolling-upgrade cold-replace arm). The
+        fabric's inbound links retarget to the fresh listener port so
+        the other nodes' persistent reconnect loops re-peer on their
+        own; the restarted node re-dials its earlier peers through the
+        SAME links (WAN profiles / delays riding them stay armed)."""
+        old = self.nodes[idx]
+        try:
+            old.stop()
+        except Exception:  # noqa: BLE001 — teardown best effort
+            pass
+        for link in self.fabric.links_of(idx):
+            link.drop_all()
+        if wipe:
+            shutil.rmtree(os.path.join(self.root, f"node{idx}"),
+                          ignore_errors=True)
+        cfg = self._make_config(idx, statesync_from=statesync_from)
+        pv = self.pvs[idx] if idx < len(self.pvs) else None
+        if pv is not None:
+            pv.file_path = cfg.base.priv_validator_file()
+            pv.save()
+        node = default_new_node(cfg)
+        node.start()
+        self.nodes[idx] = node
+        if any(
+            (link.wan_profile_name() or "lan") != "lan"
+            for link in self.fabric.links().values()
+        ):
+            # the replacement boots with the test preset's tight
+            # timeouts; if the net is WAN-shaped it needs the floor too
+            self._wan_timeouts(True)
+        port = self._listener_port(idx)
+        seeds = []
+        for (i, j), link in self.fabric.links().items():
+            if j == idx:
+                link.retarget(("127.0.0.1", port))
+            elif i == idx:
+                seeds.append(link.laddr)
+        if seeds:
+            node.sw.dial_seeds(seeds)
+        return node
+
+    # -- soak instrumentation (round 18) -------------------------------------
+
+    @staticmethod
+    def rss_kb() -> int:
+        """This process's resident set (VmRSS), in KiB."""
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+        raise RuntimeError("no VmRSS in /proc/self/status")
+
+    def disk_bytes(self) -> int:
+        """Total bytes under every node home (WALs, stores, snapshots)."""
+        total = 0
+        for dirpath, _dirs, files in os.walk(self.root):
+            for fn in files:
+                try:
+                    total += os.path.getsize(os.path.join(dirpath, fn))
+                except OSError:
+                    pass
+        return total
+
+    def flight_dump_counts(self) -> list[int]:
+        """Per-node flight-recorder auto-dump episode counts — the
+        healthy-soak quietness assert (round 17's recorder)."""
+        return [n.flightrec.stats()["dumps"] for n in self.nodes]
 
     def churn_listener(self, idx: int, down_s: float = 0.5) -> None:
         """The peer-churn arm: kill node idx's listener, reset every
@@ -248,17 +404,27 @@ class ChaosNet:
         shutil.rmtree(self.root, ignore_errors=True)
 
 
-# -- the hostile-but-fluent peer: byzantine vote injection --------------------
+# -- the hostile-but-fluent peer family (round 18 adversary catalog) ----------
 
 
-class VoteInjector:
-    """Dials a node over the REAL encrypted transport (TCP ->
-    SecretConnection -> NodeInfo handshake -> MConnection) and pushes
-    crafted consensus votes — the double-signer of the byzantine
-    scenario. It speaks enough protocol to be admitted as a peer; it
-    never runs a consensus state of its own."""
+class HostilePeer:
+    """Protocol-fluent adversary base: dials a node over the REAL
+    encrypted transport (TCP -> SecretConnection -> NodeInfo handshake
+    -> MConnection) and is admitted as an ordinary peer; it never runs
+    a consensus state of its own. Subclasses are the adversary catalog
+    (docs/netchaos.md): vote injection, mempool flooding, oversized
+    frames, eclipse identities, frame corruption.
 
-    def __init__(self, target_host: str, target_port: int, chain_id: str):
+    `corrupt_prob` wires the p2p/fuzz.py FuzzedStream UNDER the
+    SecretConnection — the frame-corruption peer: a seeded fraction of
+    this adversary's encrypted frames arrive tampered, which the
+    target's AEAD must flag loudly (auth failure + peer dropped)."""
+
+    moniker = "hostile"
+
+    def __init__(self, target_host: str, target_port: int, chain_id: str,
+                 corrupt_prob: float = 0.0, corrupt_seed: int = 7,
+                 commit_format: str = "full", key=None):
         from tendermint_tpu.blockchain.reactor import BLOCKCHAIN_CHANNEL
         from tendermint_tpu.consensus.reactor import (
             DATA_CHANNEL,
@@ -268,6 +434,7 @@ class VoteInjector:
         )
         from tendermint_tpu.mempool.reactor import MEMPOOL_CHANNEL
         from tendermint_tpu.p2p.conn import ChannelDescriptor, MConnection
+        from tendermint_tpu.p2p.fuzz import FuzzedStream
         from tendermint_tpu.p2p.node_info import NodeInfo, default_version
         from tendermint_tpu.p2p.peer import exchange_node_info
         from tendermint_tpu.p2p.secret_connection import SecretConnection
@@ -276,6 +443,7 @@ class VoteInjector:
         from tendermint_tpu.version import VERSION
 
         self.vote_channel = VOTE_CHANNEL
+        self.mempool_channel = MEMPOOL_CHANNEL
         # every channel the node's reactors gossip on: an unknown inbound
         # channel is a fatal mconn error, and the consensus/mempool
         # reactors start pushing to a fresh peer immediately
@@ -284,13 +452,23 @@ class VoteInjector:
             MEMPOOL_CHANNEL, BLOCKCHAIN_CHANNEL, STATESYNC_CHANNEL,
         )
         sock = socket.create_connection((target_host, target_port), timeout=10)
-        self._key = gen_priv_key_ed25519()
-        self.conn = SecretConnection(SocketStream(sock), self._key)
+        self._key = key if key is not None else gen_priv_key_ed25519()
+        stream = SocketStream(sock)
+        self.fuzz = None
+        if corrupt_prob > 0:
+            # handshake CLEAN (a corrupted key exchange would just fail
+            # admission), then arm corruption once the mconn runs — the
+            # adversary is a fluent peer whose frames tamper in flight
+            stream = FuzzedStream(stream, prob_corrupt=0.0,
+                                  seed=corrupt_seed)
+            self.fuzz = stream
+        self.conn = SecretConnection(stream, self._key)
         info = NodeInfo(
             pub_key=self._key.pub_key(),
-            moniker="byz-injector",
+            moniker=self.moniker,
             network=chain_id,
             version=default_version(VERSION),
+            other=[f"commit_format={commit_format}"],
         )
         info.channels = bytes(channels)
         self.remote_info = exchange_node_info(self.conn, info, timeout=10)
@@ -302,19 +480,155 @@ class VoteInjector:
             on_error=self._err.append,
         )
         self.mconn.start()
+        if self.fuzz is not None:
+            self.fuzz.prob_corrupt = corrupt_prob
 
-    def send_vote(self, vote) -> bool:
-        from tendermint_tpu.consensus import messages as msgs
-        from tendermint_tpu.consensus.reactor import _enc
+    def send_msg(self, ch_id: int, payload: bytes) -> bool:
+        return self.mconn.send(ch_id, payload)
 
-        return self.mconn.send(self.vote_channel, _enc(msgs.VoteMessage(vote)))
+    def errors(self) -> list:
+        return list(self._err)
+
+    def dropped(self) -> bool:
+        """Did the target (or the wire) kill this adversary's link?"""
+        return bool(self._err) or not self.mconn.is_running()
 
     def close(self) -> None:
         try:
             self.mconn.stop()
         except Exception:  # noqa: BLE001 — teardown best effort
             pass
-        self.conn.close()
+        try:
+            self.conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class VoteInjector(HostilePeer):
+    """Pushes crafted consensus votes — the double-signer of the
+    byzantine scenario."""
+
+    moniker = "byz-injector"
+
+    def send_vote(self, vote) -> bool:
+        from tendermint_tpu.consensus import messages as msgs
+        from tendermint_tpu.consensus.reactor import _enc
+
+        return self.send_msg(self.vote_channel, _enc(msgs.VoteMessage(vote)))
+
+
+class MempoolFlooder(HostilePeer):
+    """Floods the target's mempool over the gossip channel: garbage
+    signed-shaped txs (structurally parseable, signatures junk — shed
+    at the batched sig gate without ever reaching the app) and
+    valid-but-duplicate txs (shed at the dedup cache). The scenario
+    asserts consensus liveness stays flat while the flood is shed and
+    visible in p2p_adversary_flood_txs_rejected."""
+
+    moniker = "mempool-flooder"
+
+    @staticmethod
+    def _encode_tx(tx: bytes) -> bytes:
+        # the REAL gossip envelope: the flood must exercise the sig
+        # gate, not the unknown-message reject path
+        from tendermint_tpu.mempool.reactor import _encode_tx
+
+        return _encode_tx(tx)
+
+    def flood_garbage(self, n: int, payload_size: int = 24,
+                      seed: int = 1) -> int:
+        """n unique garbage txs shaped like signedkv envelopes
+        (32B pubkey + 64B sig + payload) whose signatures are noise;
+        returns how many were handed to the wire."""
+        import random as _random
+
+        rng = _random.Random(seed)
+        sent = 0
+        for i in range(n):
+            tx = rng.randbytes(96) + b"flood-%d-" % i + rng.randbytes(
+                payload_size
+            )
+            if self.send_msg(self.mempool_channel, self._encode_tx(tx)):
+                sent += 1
+        return sent
+
+    def flood_duplicates(self, tx: bytes, n: int) -> int:
+        """The same VALID tx n times — every copy past the first is
+        dedup-cache shed on the target."""
+        sent = 0
+        for _ in range(n):
+            if self.send_msg(self.mempool_channel, self._encode_tx(tx)):
+                sent += 1
+        return sent
+
+
+class OversizedFramePeer(HostilePeer):
+    """Streams one message past a channel's recv ceiling: the target
+    must error the reassembly at the right-sized bound (round-18 caps)
+    and drop this peer for cause."""
+
+    moniker = "oversized-framer"
+
+    def send_oversized(self, total_bytes: int = 200_000) -> bool:
+        # the mconn send side chops any length; the TARGET's vote
+        # channel caps reassembly at 64 KiB and must kill the link
+        return self.send_msg(self.vote_channel, b"\x00" * total_bytes)
+
+
+def slow_loris_handshake(target_host: str, target_port: int,
+                         byte_interval_s: float = 0.4,
+                         max_s: float = 60.0) -> float | None:
+    """The slow-loris adversary: connect and dribble one random byte at
+    a time into the secret-connection handshake, never completing it.
+    Returns seconds until the TARGET closed the socket (its handshake
+    deadline firing), or None if it tolerated the loris for max_s —
+    the failure the scenario asserts against."""
+    import random as _random
+
+    rng = _random.Random(11)  # deterministic dribble
+    sock = socket.create_connection((target_host, target_port), timeout=10)
+    sock.settimeout(byte_interval_s)
+    t0 = time.monotonic()
+    try:
+        while time.monotonic() - t0 < max_s:
+            try:
+                sock.sendall(rng.randbytes(1))
+            except OSError:
+                return time.monotonic() - t0
+            try:
+                if sock.recv(4096) == b"":
+                    return time.monotonic() - t0
+            except socket.timeout:
+                continue
+            except OSError:
+                return time.monotonic() - t0
+        return None
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def eclipse_dials(target_host: str, target_port: int, chain_id: str,
+                  n: int) -> tuple[list[HostilePeer], int]:
+    """The eclipse adversary: n distinct identities (fresh Ed25519 keys)
+    dialed from ONE address range (loopback — exactly the shape
+    IPRangeCounter dampens). Returns (admitted peers, refused count);
+    the caller closes the admitted ones."""
+    admitted: list[HostilePeer] = []
+    refused = 0
+    for i in range(n):
+        try:
+            admitted.append(
+                HostilePeer(target_host, target_port, chain_id,
+                            key=gen_priv_key_ed25519(
+                                f"{chain_id}-eclipse-{i}".encode()))
+            )
+        except Exception:  # noqa: BLE001 — refusal shapes vary (reset,
+            # EOF mid-handshake, timeout): all count as the dial shed
+            refused += 1
+    return admitted, refused
 
 
 def make_conflicting_votes(pv, validators, height: int, round_: int,

@@ -498,3 +498,420 @@ def test_partition_wedge_diagnosable_from_artifacts_alone(net4, monkeypatch):
     net4.heal()
     stalled = max(net4.heights())
     assert net4.wait_height(stalled + 2, timeout=90), net4.heights()
+
+
+# -- round 18: the internet-scale adversarial tier ----------------------------
+#
+# WAN profiles / geo clusters over the same fault fabric, the
+# hostile-peer family (protocol-fluent adversaries, not socket faults),
+# mixed-version nets, and the rolling-restart + soak discipline. Every
+# scenario keeps the per-height byte-identity assert; every attack must
+# be SHED (honest net keeps committing within the stated bound) and
+# VISIBLE (p2p_adversary_* / netfaults_wan_* telemetry moves). Full
+# catalog: docs/netchaos.md.
+
+
+def _heights_per_s(net, window_s: float) -> float:
+    h0 = min(net.heights())
+    time.sleep(window_s)
+    return (min(net.heights()) - h0) / window_s
+
+
+@pytest.mark.slow
+def test_geo_cluster_wan_converges(net4):
+    """2 clusters x 2 nodes: lan latency inside a cluster, a sampled
+    continental distribution between them (seeded per link — no
+    hand-set delays). Consensus rides the WAN-shaped quorum path and
+    every node stays byte-identical; the shaping is scrape-visible in
+    netfaults_wan_*."""
+    clusters = net4.apply_geo_clusters(k=2, intra="lan",
+                                       inter="continental", seed=7)
+    assert clusters == [[0, 1], [2, 3]]
+    h = max(net4.heights())
+    assert net4.wait_height(h + 4, timeout=150), net4.heights()
+    net4.clear_wan()
+    net4.assert_converged(h + 4)
+    from tendermint_tpu.ops import netfaults
+
+    scraped = netfaults.telemetry_counters()
+    assert scraped["netfaults_wan_delays_applied"] > 0
+    assert scraped["netfaults_wan_delay_seconds"] > 0
+    # inter-cluster links carry the heavy profile, intra stay lan
+    assert net4.fabric.link(2, 0).wan_profile_name() is None  # cleared
+    net4.apply_geo_clusters(k=2, seed=7)
+    assert net4.fabric.link(1, 0).wan_profile_name() == "lan"
+    assert net4.fabric.link(2, 0).wan_profile_name() == "intercontinental"
+    net4.clear_wan()
+
+
+@pytest.mark.slow
+def test_mempool_flood_is_shed_liveness_flat(tmp_path):
+    """The mempool-flood adversary against the batched sig gate: a
+    hostile peer pushes garbage-signature txs (structurally valid
+    envelopes, junk signatures) plus a duplicate storm at a signedkv
+    net. The garbage must be shed at the gate (never admitted, never
+    app-dispatched) and counted in p2p_adversary_flood_txs_rejected;
+    the duplicates shed at the dedup cache and counted in
+    mempool_cache_dups — while consensus liveness stays flat within
+    the stated bound (flood-window heights/s >= 1/3 of the pre-flood
+    rate) and an honest tx still commits."""
+    from tendermint_tpu.abci.apps.signedkv import make_sig_tx
+    from tendermint_tpu.ops import fleet
+    from tests.netchaos_common import MempoolFlooder
+
+    net = ChaosNet(4, str(tmp_path / "flood"), app="signedkv")
+    net.start()
+    try:
+        assert net.wait_height(2, timeout=150), net.heights()
+        url1 = f"127.0.0.1:{net.nodes[1].rpc_port()}"
+
+        base_hps = _heights_per_s(net, 6.0)
+        m1_pre = fleet.fetch_metrics(url1)
+        rejected0 = fleet.metric_value(
+            m1_pre, "p2p_adversary_flood_txs_rejected", default=0.0,
+        )
+        dups0 = fleet.metric_value(
+            m1_pre, "mempool_cache_dups", default=0.0,
+        )
+
+        target = net.nodes[1]
+        flooder = MempoolFlooder(
+            "127.0.0.1", target.listener.internal_address().port, "netchaos"
+        )
+        dup_tx = make_sig_tx(b"\x11" * 32, b"dupkey=dupval")
+        try:
+            h0 = min(net.heights())
+            t0 = time.monotonic()
+            sent_garbage = flooder.flood_garbage(2000, seed=5)
+            sent_dups = flooder.flood_duplicates(dup_tx, 400)
+            # keep the flood window honest: measure until the shed shows
+            assert wait_until(
+                lambda: fleet.metric_value(
+                    fleet.fetch_metrics(url1),
+                    "p2p_adversary_flood_txs_rejected", default=0.0,
+                ) - rejected0 >= 0.8 * sent_garbage,
+                timeout=60,
+            ), "flood not shed/visible in p2p_adversary_flood_txs_rejected"
+            flood_wall = time.monotonic() - t0
+            flood_hps = (min(net.heights()) - h0) / flood_wall
+        finally:
+            flooder.close()
+        assert sent_garbage >= 1900 and sent_dups >= 390
+        # the duplicate storm shed at the dedup cache (first copy
+        # admits; gossip redundancy adds a little on top — hence >=)
+        assert wait_until(
+            lambda: fleet.metric_value(
+                fleet.fetch_metrics(url1), "mempool_cache_dups",
+                default=0.0,
+            ) - dups0 >= sent_dups - 10,
+            timeout=30,
+        ), "duplicate storm not visible in mempool_cache_dups"
+
+        # liveness flat within the stated bound
+        if base_hps > 0.3:
+            assert flood_hps >= base_hps / 3.0, (base_hps, flood_hps)
+        else:
+            assert min(net.heights()) - h0 >= 1, net.heights()
+        m1 = fleet.fetch_metrics(url1)
+        # the commit cadence never degenerated (scraped liveness gauge)
+        assert fleet.metric_value(
+            m1, "consensus_height_seconds_last", default=0.0
+        ) < 30.0
+        # nothing hostile reached the pool: garbage died at the gate,
+        # dups at the cache (pool only ever holds honest traffic)
+        assert fleet.metric_value(m1, "mempool_size", default=0.0) < 100
+        assert fleet.metric_value(
+            m1, "mempool_sig_gate_dropped", default=0.0
+        ) + fleet.metric_value(
+            m1, "p2p_adversary_flood_txs_rejected", default=0.0
+        ) - rejected0 >= sent_garbage * 0.8
+
+        # an honest tx still commits through the flooded node
+        probe = make_sig_tx(b"\x22" * 32, b"honest=survives")
+        net.broadcast_tx(probe, via=1)
+        top0 = max(net.heights())
+        assert net.wait_height(top0 + 2, timeout=90), net.heights()
+        committed = []
+        store = net.nodes[0].block_store
+        for hh in range(1, max(net.heights()) + 1):
+            committed += store.load_block(hh).data.txs
+        assert probe in committed, "honest tx starved by the flood"
+        net.assert_converged(min(net.heights()))
+    finally:
+        net.stop()
+
+
+@pytest.mark.slow
+def test_slow_loris_oversized_and_corrupting_peers_dropped(net4, monkeypatch):
+    """Three framing-layer adversaries against one live net:
+
+    - slow-loris: dribbles the secret handshake one byte at a beat —
+      the ABSOLUTE handshake deadline (not per-read) must cut it off;
+    - oversized-frame: a fluent admitted peer streams 128 KiB at the
+      vote channel's 64 KiB reassembly ceiling — dropped for cause;
+    - frame corruptor: a fluent peer whose encrypted frames tamper in
+      flight — the AEAD flags every one loudly.
+
+    Each is shed (counted in handshake timeouts / frame violations /
+    auth failures), none moves consensus off its cadence, and the net
+    stays byte-identical."""
+    from tendermint_tpu.libs import telemetry
+    from tests.netchaos_common import (
+        HostilePeer,
+        OversizedFramePeer,
+        slow_loris_handshake,
+    )
+
+    monkeypatch.setenv("TENDERMINT_SECRETCONN_HANDSHAKE_S", "2")
+    target = net4.nodes[2]
+    port = target.listener.internal_address().port
+    reg = telemetry.default_registry()
+
+    # -- slow loris ----------------------------------------------------
+    hs_timeouts0 = reg.counter("p2p_secretconn_handshake_timeouts_total").value
+    took = slow_loris_handshake("127.0.0.1", port, byte_interval_s=0.3,
+                                max_s=20.0)
+    assert took is not None, "target tolerated the loris for 20 s"
+    assert took < 10.0, f"loris held the handshake {took:.1f}s"
+    assert wait_until(
+        lambda: reg.counter(
+            "p2p_secretconn_handshake_timeouts_total"
+        ).value > hs_timeouts0,
+        timeout=10,
+    )
+    assert wait_until(
+        lambda: target.sw.adversary_stats()["handshake_rejects"] >= 1,
+        timeout=10,
+    )
+
+    # -- oversized frame ----------------------------------------------
+    ofp = OversizedFramePeer("127.0.0.1", port, "netchaos")
+    try:
+        assert ofp.send_oversized(1 << 17)
+        assert wait_until(ofp.dropped, timeout=15), (
+            "target never dropped the oversized framer"
+        )
+        assert wait_until(
+            lambda: target.sw.adversary_stats()["frame_violations"] >= 1,
+            timeout=10,
+        ), target.sw.adversary_stats()
+    finally:
+        ofp.close()
+
+    # -- frame corruptor (the round-18 home for p2p/fuzz.py) -----------
+    af0 = reg.counter("p2p_secretconn_auth_failures_total").value
+    cp = HostilePeer("127.0.0.1", port, "netchaos", corrupt_prob=1.0)
+    try:
+        cp.send_msg(cp.vote_channel, b"this frame tampers in flight")
+        assert wait_until(
+            lambda: reg.counter(
+                "p2p_secretconn_auth_failures_total"
+            ).value > af0,
+            timeout=15,
+        ), "corrupted frame never flagged by the AEAD"
+        assert wait_until(cp.dropped, timeout=15)
+        assert cp.fuzz.corrupted_writes >= 1
+    finally:
+        cp.close()
+
+    # the honest net rode through all three
+    h = max(net4.heights())
+    assert net4.wait_height(h + 2, timeout=90), net4.heights()
+    net4.assert_converged(h + 2)
+
+
+@pytest.mark.slow
+def test_eclipse_pressure_honest_minority_keeps_node_live(net4):
+    """The eclipse adversary: 30 distinct identities dialed from ONE
+    address range at node 0 (whose honest links also ride that range —
+    loopback is exactly the worst case). The IP-range counter must shed
+    the surplus (scrape-visible), the honest minority of links stays
+    connected, the node keeps committing, and when the attacker leaves
+    the range counts drain back (the round-12 leak would have bricked
+    inbound forever)."""
+    from tendermint_tpu.ops import fleet
+    from tests.netchaos_common import eclipse_dials
+
+    target = net4.nodes[0]
+    port = target.listener.internal_address().port
+    url0 = f"127.0.0.1:{target.rpc_port()}"
+    honest_range = target.sw.ip_ranges.count("127.0.0")
+    assert honest_range >= 1  # the honest inbound links ride the range
+
+    peers, refused = eclipse_dials("127.0.0.1", port, "netchaos", 30)
+    try:
+        # limits (64,32,16): the /24 budget caps total admissions; with
+        # the honest links inside it, >= 14 of 30 dials must be shed
+        assert refused >= 10, (len(peers), refused)
+        assert len(peers) + honest_range <= 16
+        assert wait_until(
+            lambda: fleet.metric_value(
+                fleet.fetch_metrics(url0),
+                "p2p_adversary_eclipse_dials_refused", default=0.0,
+            ) >= refused,
+            timeout=30,
+        ), fleet.fetch_metrics(url0).get("p2p_adversary_eclipse_dials_refused")
+
+        # honest links survived the pressure: the eclipsed-at node still
+        # commits with the rest of the net while the attacker holds its
+        # admitted connections
+        h = max(net4.heights())
+        assert net4.wait_height(h + 2, timeout=90), net4.heights()
+    finally:
+        for p in peers:
+            p.close()
+    # the attacker leaves: its range counts DRAIN (wrapper-chain
+    # uncount), so the node's inbound budget recovers for honest churn
+    assert wait_until(
+        lambda: target.sw.ip_ranges.count("127.0.0") <= honest_range + 1,
+        timeout=60,
+    ), target.sw.ip_ranges.count("127.0.0")
+    net4.assert_converged(min(net4.heights()))
+
+
+@pytest.mark.slow
+def test_mixed_commit_format_net_refuses_loudly(tmp_path, monkeypatch):
+    """Mixed-version net: node 3 boots under genesis
+    commit_format="aggregate" while {0,1,2} run "full". The refusal is
+    LOUD and at the handshake (NodeInfo.compatible_with names the flag;
+    p2p_adversary_handshake_rejects moves on the majority; the odd node
+    reads degraded on /health with zero peers) and the homogeneous
+    majority keeps committing byte-identical blocks — no wedge, no
+    silent mixed net."""
+    from tendermint_tpu.ops import fleet
+
+    monkeypatch.setenv("TENDERMINT_HEALTH_MIN_PEERS", "1")
+    net = ChaosNet(4, str(tmp_path / "mixed"),
+                   commit_format_of={3: "aggregate"})
+    net.start()
+    try:
+        # the majority forms and commits without node 3
+        assert net.wait_height(3, timeout=150, nodes=[0, 1, 2]), net.heights()
+        # the mismatch names the flag, both directions
+        reason = net.nodes[0].sw.node_info.compatible_with(
+            net.nodes[3].sw.node_info
+        )
+        assert reason is not None and "commit format mismatch" in reason
+        # node 3 never peers: every dial refused at the handshake
+        assert net.nodes[3].sw.peers.size() == 0
+        assert net.nodes[3].block_store.height() == 0
+        rejects = sum(
+            net.nodes[i].sw.adversary_stats()["handshake_rejects"]
+            for i in range(3)
+        )
+        assert rejects >= 1, "refusals not counted on the majority side"
+        # ... and scrape-visible on the majority
+        assert any(
+            fleet.metric_value(
+                fleet.fetch_metrics(f"127.0.0.1:{net.nodes[i].rpc_port()}"),
+                "p2p_adversary_handshake_rejects", default=0.0,
+            ) >= 1
+            for i in range(3)
+        )
+        # the odd node's own surface says it is cut off
+        health3 = fleet.fetch_health(
+            f"127.0.0.1:{net.nodes[3].rpc_port()}"
+        )
+        assert health3["status"] != "ok", health3
+        assert health3["checks"]["peers"]["status"] != "ok", health3
+        # majority byte-identity
+        net.assert_converged(3, nodes=[0, 1, 2])
+    finally:
+        net.stop()
+
+
+@pytest.mark.slow
+def test_rolling_restart_statesync_rejoin_under_wan(tmp_path):
+    """The rolling-upgrade arm under WAN latency: node 3 stops, its
+    home is wiped (a cold replace), and it restarts with statesync
+    while every link rides the continental profile. The majority keeps
+    committing through the restart; the replacement restores at a
+    snapshot base (never replays from genesis), tails the chain, and
+    lands byte-identical."""
+    net = ChaosNet(4, str(tmp_path / "rolling"), snapshot_interval=5)
+    net.start()
+    try:
+        assert net.wait_height(8, timeout=180), net.heights()
+        net.apply_wan("continental", seed=3)
+        h_before = max(net.heights())
+        node3 = net.restart_node(3, statesync_from=[0, 1], wipe=True)
+        # the majority never stalled behind the restart
+        assert net.wait_height(h_before + 2, timeout=120, nodes=[0, 1, 2])
+        assert wait_until(
+            lambda: node3.block_store.height() >= h_before + 2, timeout=240
+        ), (node3.block_store.height(), node3.block_store.base())
+        base = node3.block_store.base()
+        assert base > 1, "replacement replayed from genesis, not statesync"
+        net.clear_wan()
+        top = min(n.block_store.height() for n in net.nodes)
+        for hh in range(base, top + 1):
+            want = net.nodes[0].block_store.load_block_meta(hh)
+            got = node3.block_store.load_block_meta(hh)
+            assert got.block_id.key() == want.block_id.key(), hh
+            assert (
+                node3.block_store.load_block(hh).header.app_hash
+                == net.nodes[0].block_store.load_block(hh).header.app_hash
+            ), hh
+        # the restarted validator is signing again (the net includes it
+        # in fresh commits): heights keep advancing with all 4 live
+        h = max(net.heights())
+        assert net.wait_height(h + 2, timeout=90), net.heights()
+    finally:
+        net.stop()
+
+
+@pytest.mark.slow
+def test_wan_soak_rss_flat_disk_bounded(tmp_path):
+    """The soak discipline under a WAN profile (the pre-seed sqlite
+    soak, now network-shaped): a 4-node net under continental latency
+    commits NETCHAOS_SOAK_HEIGHTS (default 200) heights with light tx
+    traffic. Asserts: RSS flat after warmup (< 30% / 64 MiB growth),
+    disk growth bounded per height, the flight recorder QUIET on every
+    healthy node (zero auto-dump episodes — round 17's recorder is the
+    black box; a healthy soak must not trip it), and byte-identical
+    convergence at the end."""
+    target_heights = int(os.environ.get("NETCHAOS_SOAK_HEIGHTS", "200"))
+    warmup = min(30, target_heights // 4)
+    net = ChaosNet(4, str(tmp_path / "soak"), snapshot_interval=25)
+    net.start()
+    try:
+        net.apply_wan("continental", seed=11)
+        assert net.wait_height(warmup, timeout=300), net.heights()
+        rss0_kb = net.rss_kb()
+        disk0 = net.disk_bytes()
+        h0 = min(net.heights())
+
+        i = 0
+        while min(net.heights()) < target_heights:
+            net.broadcast_tx(f"soak-{i}=v{i}".encode(), via=i % 4)
+            i += 1
+            assert net.wait_height(
+                min(net.heights()) + 1, timeout=120
+            ), net.heights()
+
+        rss1_kb = net.rss_kb()
+        disk1 = net.disk_bytes()
+        grew_kb = rss1_kb - rss0_kb
+        assert grew_kb < max(65536, rss0_kb * 0.30), (
+            f"RSS not flat: {rss0_kb} -> {rss1_kb} KiB over "
+            f"{target_heights - h0} heights"
+        )
+        per_height = (disk1 - disk0) / max(1, min(net.heights()) - h0)
+        assert per_height < 200 * 1024, (
+            f"disk unbounded: {per_height:.0f} B/height "
+            f"({disk0} -> {disk1})"
+        )
+        # the black box stayed quiet: no health-failing / wedge / crash
+        # auto-dump episodes on any node through the whole soak
+        assert net.flight_dump_counts() == [0, 0, 0, 0], (
+            net.flight_dump_counts()
+        )
+        # the WAN shaping really ran the whole time
+        from tendermint_tpu.ops import netfaults
+
+        scraped = netfaults.telemetry_counters()
+        assert scraped["netfaults_wan_delays_applied"] > 1000
+        net.clear_wan()
+        net.assert_converged(min(net.heights()))
+    finally:
+        net.stop()

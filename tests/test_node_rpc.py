@@ -262,14 +262,21 @@ METRICS_REQUIRED_KEYS = (
     # WAL durability plane (present once consensus started)
     "wal_format", "wal_records", "wal_fsyncs", "wal_pending",
     "wal_group_size", "wal_repairs", "wal_sync_age_s",
-    # evidence + mempool
-    "evidence_count", "mempool_size",
+    # evidence + mempool (cache_dups: round-18 dup-flood shed counter)
+    "evidence_count", "mempool_size", "mempool_cache_dups",
     # p2p (round 15 adds the flat aggregates over the labeled
     # p2p_peer_* gossip families — the wedge signal on the legacy dict)
     "p2p_peers_outbound", "p2p_peers_inbound", "p2p_peers_dialing",
     "p2p_peer_send_failures", "p2p_peer_vote_gossip_picks",
     "p2p_peer_vote_gossip_sends", "p2p_peer_vote_gossip_send_failures",
     "p2p_peer_catchup_commits", "p2p_peer_vote_duplicates",
+    # adversarial-tier defense accounting (round 18): hostile pressure
+    # shed at the eclipse gates / admission handshake / framing
+    # contract / mempool flood path
+    "p2p_adversary_eclipse_dials_refused",
+    "p2p_adversary_handshake_rejects",
+    "p2p_adversary_frame_violations",
+    "p2p_adversary_flood_txs_rejected",
     # tx-lifecycle tracing + flight recorder (round 17)
     "txtrace_sampled", "txtrace_completed", "txtrace_active",
     "flightrec_events", "flightrec_dumps",
@@ -344,9 +351,26 @@ def test_prometheus_exposition_endpoint(node):
                 # round 17: tx-lifecycle sampling + flight recorder +
                 # the vote-gossip redundancy number
                 "txtrace_sampled", "flightrec_events",
-                "consensus_vote_duplicates"):
+                "consensus_vote_duplicates",
+                # round 18: adversary-defense accounting on the node +
+                # the WAN-shaping counters on the chaos fabric (all-zero
+                # outside a chaos harness but the family set is stable)
+                "p2p_adversary_eclipse_dials_refused",
+                "p2p_adversary_handshake_rejects",
+                "p2p_adversary_frame_violations",
+                "p2p_adversary_flood_txs_rejected",
+                "netfaults_wan_delays_applied", "netfaults_wan_loss_stalls",
+                "netfaults_wan_bytes_shaped", "netfaults_wan_resets",
+                "netfaults_links"):
         assert fam in families, fam
         assert families[fam] == "gauge"
+    # round 18: the secret-connection transport counters, incl. the
+    # oversized-frame refusal the adversarial tier asserts on
+    for fam in ("p2p_secretconn_handshakes_total",
+                "p2p_secretconn_handshake_timeouts_total",
+                "p2p_secretconn_auth_failures_total",
+                "p2p_secretconn_oversized_frames_total"):
+        assert families.get(fam) == "counter", fam
     # round 15: the labeled per-peer gossip families are present (and
     # typed) from the first scrape even with zero peers — family
     # materialization is what makes churned series collapse instead of

@@ -642,3 +642,343 @@ def test_recv_routine_never_inherits_the_admission_timeout():
     finally:
         peer.stop()
         b.close()
+
+
+# -- round-18 adversarial-tier hardening regressions --------------------------
+#
+# Each hole below was exposed by the hostile-peer family in
+# tests/netchaos_common.py (slow-loris, oversized-frame, eclipse); per
+# the issue discipline every fix gets a deterministic UNIT regression
+# here, not just a scenario.
+
+
+def test_node_info_dribble_hits_absolute_deadline():
+    """Slow-loris against the NodeInfo phase: the admission timeout used
+    to bound each socket READ, so a peer feeding one byte per
+    just-under-the-budget interval could hold the admission thread for
+    MAX_NODE_INFO_SIZE reads. exchange_node_info's deadline is now
+    ABSOLUTE — a dribbler whose every byte lands comfortably within the
+    per-read budget still trips it at the total budget."""
+    import socket as _socket
+    import struct as _struct
+
+    from tendermint_tpu.p2p.peer import exchange_node_info
+    from tendermint_tpu.p2p.stream import SocketStream
+
+    a, b = _socket.socketpair()
+    info = NodeInfo(
+        pub_key=gen_priv_key_ed25519().pub_key(),
+        moniker="m", network="n", version=default_version("t"),
+    )
+    stop = threading.Event()
+
+    def dribble():
+        try:
+            b.recv(65536)  # drain the honest side's own info
+            b.sendall(_struct.pack(">I", 512))  # plausible length claim
+            while not stop.is_set():
+                b.sendall(b"x")  # one byte per beat: every READ succeeds
+                stop.wait(0.15)
+        except OSError:
+            pass
+
+    t = threading.Thread(target=dribble, daemon=True)
+    t.start()
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(ConnectionError, match="timed out"):
+            exchange_node_info(SocketStream(a), info, timeout=0.8)
+        took = time.monotonic() - t0
+        # absolute, not per-read: the per-read budget alone would NEVER
+        # fire here (each byte arrives within 0.15 s)
+        assert took < 5.0, f"deadline not absolute: took {took:.1f}s"
+    finally:
+        stop.set()
+        for s in (a, b):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def test_secretconn_oversized_frame_claim_refused_before_buffering():
+    """Oversized-frame adversary: a frame length claim beyond the legal
+    maximum (DATA_MAX_SIZE + 16-byte tag) is refused the moment the
+    claim is read. The old path tried to BUFFER the claimed payload
+    first — an attacker claiming 64 KiB and sending nothing parked the
+    reader forever, and one sending junk cost a 64 KiB buffer per frame
+    just to fail the AEAD tag."""
+    import struct as _struct
+
+    from tendermint_tpu.libs import telemetry
+    from tendermint_tpu.p2p.secret_connection import (
+        DATA_MAX_SIZE,
+        SecretConnectionError,
+    )
+
+    a, b = pipe_pair()
+    ka, kb = gen_priv_key_ed25519(), gen_priv_key_ed25519()
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.update(conn=SecretConnection(b, kb)), daemon=True
+    )
+    t.start()
+    ca = SecretConnection(a, ka)
+    t.join(5)
+    reg = telemetry.default_registry()
+    over0 = reg.counter("p2p_secretconn_oversized_frames_total").value
+
+    # an illegal claim with NO payload behind it: pre-fix this blocked
+    # the reader; post-fix it raises immediately
+    ca.stream.write(_struct.pack(">H", DATA_MAX_SIZE + 17))
+    with pytest.raises(SecretConnectionError, match="oversized"):
+        out["conn"].read(10)
+    # poisoned forever, and counted
+    with pytest.raises(SecretConnectionError):
+        out["conn"].read(1)
+    assert reg.counter(
+        "p2p_secretconn_oversized_frames_total"
+    ).value == over0 + 1
+    ca.close()
+
+
+def test_reactor_recv_ceilings_right_sized():
+    """The per-channel reassembly ceilings are right-sized to each
+    channel's largest LEGAL message (round 18): before, every channel
+    inherited the 21 MiB block ceiling, so an oversized-frame peer
+    could park ~147 MiB of never-delivered reassembly bytes across one
+    connection's channels."""
+    from tendermint_tpu.codec import jsonval as jv
+    from tendermint_tpu.consensus.reactor import (
+        ConsensusReactor,
+        DATA_CHANNEL,
+        STATE_CHANNEL,
+        VOTE_CHANNEL,
+        VOTE_SET_BITS_CHANNEL,
+    )
+    from tendermint_tpu.mempool.reactor import MempoolReactor
+    from tendermint_tpu.p2p.pex import PEXReactor
+
+    from tendermint_tpu.types.params import MAX_BLOCK_PART_SIZE_BYTES
+
+    caps = {
+        d.id: d.recv_message_capacity
+        for d in ConsensusReactor.get_channels(None)
+    }
+    assert caps[VOTE_CHANNEL] == 1 << 16  # a vote is ~700 B
+    assert caps[STATE_CHANNEL] == 1 << 16
+    assert caps[VOTE_SET_BITS_CHANNEL] == 1 << 16
+    # the DATA cap DERIVES from the params-validated part-size bound
+    # (hex-doubled + envelope headroom) so a legal genesis can never
+    # configure a part the channel refuses
+    assert caps[DATA_CHANNEL] == 2 * MAX_BLOCK_PART_SIZE_BYTES + (1 << 16)
+    assert caps[DATA_CHANNEL] < 1 << 20
+    [mp] = MempoolReactor.get_channels(None)
+    # ... but a MAX_TX_BYTES tx must still FIT (hex-doubled + envelope)
+    assert mp.recv_message_capacity >= 2 * jv.MAX_TX_BYTES
+    assert mp.recv_message_capacity < 10 * (1 << 20)
+    [px] = PEXReactor.get_channels(None)
+    assert px.recv_message_capacity == 1 << 16
+    # ... and genesis validation refuses a part size the channel could
+    # not carry (the binding that keeps cap and params consistent)
+    from tendermint_tpu.types.params import ConsensusParams
+
+    cp = ConsensusParams()
+    cp.block_gossip.block_part_size_bytes = MAX_BLOCK_PART_SIZE_BYTES + 1
+    err = cp.validate()
+    assert err is not None and "recv ceiling" in err
+    cp.block_gossip.block_part_size_bytes = MAX_BLOCK_PART_SIZE_BYTES
+    assert cp.validate() is None
+
+
+def test_vote_channel_reassembly_past_ceiling_drops_peer():
+    """Behavioral half of the ceiling regression: streaming a message
+    past the vote channel's 64 KiB bound errors the connection (the
+    switch then drops the peer for cause) instead of buffering toward
+    the old 21 MiB."""
+    from tendermint_tpu.consensus.reactor import ConsensusReactor, VOTE_CHANNEL
+
+    descs = ConsensusReactor.get_channels(None)
+    ma, mb, recv_a, recv_b, err = _mconn_pair(descs=descs)
+    try:
+        assert ma.send(VOTE_CHANNEL, b"\x00" * (1 << 17))  # 128 KiB
+        assert wait_until(lambda: err, timeout=5), "oversize never errored"
+        assert any("exceeds" in str(e) for e in err), err
+        assert not recv_b, "oversized message must never be delivered"
+    finally:
+        ma.stop()
+        mb.stop()
+
+
+def test_fuzzed_stream_corrupts_deterministically():
+    """The frame-corruption wrapper (p2p/fuzz.py, round-18 audit): the
+    broken-against-SecretConnection silent write-DROP mode is gone;
+    prob_corrupt XORs one byte per write, seeded-deterministic."""
+    from tendermint_tpu.p2p.fuzz import FuzzedStream
+
+    outs = []
+    for _ in range(2):
+        a, b = pipe_pair()
+        fa = FuzzedStream(a, prob_corrupt=1.0, seed=3)
+        fa.write(b"AAAABBBB")
+        got = b.read(100)
+        outs.append(got)
+        assert got != b"AAAABBBB" and len(got) == 8
+        assert sum(x != y for x, y in zip(got, b"AAAABBBB")) == 1
+        assert fa.corrupted_writes == 1
+        fa.close()
+        b.close()
+    assert outs[0] == outs[1], "same seed must corrupt identically"
+    # and the drop mode is really gone — the constructor refuses it
+    a, b = pipe_pair()
+    with pytest.raises(TypeError):
+        FuzzedStream(a, prob_drop_rw=0.5)
+    a.close()
+    b.close()
+
+
+def test_fuzz_corruption_is_loud_tamper_under_secretconn():
+    """The frame-corruption peer end to end: a FuzzedStream UNDER the
+    SecretConnection makes a corrupted write ciphertext tamper on the
+    wire — the receiving AEAD must raise (never EOF) and count it."""
+    from tendermint_tpu.libs import telemetry
+    from tendermint_tpu.p2p.fuzz import FuzzedStream
+    from tendermint_tpu.p2p.secret_connection import SecretConnectionError
+
+    a, b = pipe_pair()
+    fa = FuzzedStream(a, prob_corrupt=0.0, seed=5)  # clean handshake
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.update(
+            conn=SecretConnection(b, gen_priv_key_ed25519())
+        ),
+        daemon=True,
+    )
+    t.start()
+    ca = SecretConnection(fa, gen_priv_key_ed25519())
+    t.join(5)
+    reg = telemetry.default_registry()
+    af0 = reg.counter("p2p_secretconn_auth_failures_total").value
+    fa.prob_corrupt = 1.0  # every frame from now on arrives tampered
+    ca.write(b"this frame will not verify")
+    with pytest.raises(SecretConnectionError):
+        out["conn"].read(10)
+    assert fa.corrupted_writes >= 1
+    assert reg.counter("p2p_secretconn_auth_failures_total").value > af0
+    ca.close()
+
+
+def test_ip_range_counter_boundary_and_churn_races():
+    """Eclipse backing, unit level: the range counter at the limit
+    boundary under add/remove churn — a slot freed by a leaving peer is
+    immediately claimable, concurrent add/remove pairs never leak or
+    steal counts, and the counter lands exactly at zero."""
+    from tendermint_tpu.p2p.ip_range_counter import IPRangeCounter
+
+    # boundary: at the limit, refuse; free one slot, admit exactly one
+    c = IPRangeCounter(limits=(2, 2, 2))
+    assert c.try_add("9.9.9.1")
+    assert c.try_add("9.9.9.2")
+    assert not c.try_add("9.9.9.3")  # /24 full
+    c.remove("9.9.9.1")
+    assert c.try_add("9.9.9.3")      # freed slot claimable
+    assert not c.try_add("9.9.9.4")  # and only that one
+    # a refused add must not have half-counted any depth
+    assert c.count("9") == 2 and c.count("9.9") == 2 and c.count("9.9.9") == 2
+
+    # churn: racing add/remove pairs across threads; paired ops must
+    # cancel exactly (no leaked counts to starve later honest peers —
+    # the round-12 leak's failure shape — and no negative underflow)
+    c2 = IPRangeCounter(limits=(64, 32, 16))
+    errs = []
+
+    def churn(tid):
+        try:
+            for i in range(300):
+                ip = f"10.0.{tid % 3}.{i % 7}"
+                if c2.try_add(ip):
+                    c2.remove(ip)
+        except Exception as exc:  # noqa: BLE001
+            errs.append(exc)
+
+    threads = [
+        threading.Thread(target=churn, args=(t,), daemon=True)
+        for t in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs
+    for p in ("10", "10.0", "10.0.0", "10.0.1", "10.0.2"):
+        assert c2.count(p) == 0, (p, c2.count(p))
+
+
+def test_uncount_stream_releases_exactly_once_across_wrapper_chain():
+    """The round-12 wrapper-chain uncount under churn: the count marker
+    lives on the RAW stream under fuzz/secret wrappers; releasing twice
+    (error path + removal path racing) must not steal a still-live
+    peer's count from the same range."""
+    import socket as _socket
+
+    from tendermint_tpu.p2p.fuzz import FuzzedStream
+    from tendermint_tpu.p2p.stream import SocketStream
+
+    sw = Switch()
+    assert sw.ip_ranges.try_add("10.1.2.3")  # peer A
+    assert sw.ip_ranges.try_add("10.1.2.4")  # peer B, same /24
+
+    s1, s2 = _socket.socketpair()
+    raw = SocketStream(s1)
+    raw.counted_ip = "10.1.2.3"
+
+    class _Outer:  # a secret-connection-shaped wrapper
+        def __init__(self, stream):
+            self.stream = stream
+
+    chain = _Outer(FuzzedStream(raw))
+    sw._uncount_stream(chain)
+    assert sw.ip_ranges.count("10.1.2") == 1  # A released
+    # the double-release race: a second uncount finds the marker cleared
+    sw._uncount_stream(chain)
+    assert sw.ip_ranges.count("10.1.2") == 1, "double uncount stole B's count"
+    for s in (s1, s2):
+        s.close()
+
+
+def test_addrbook_one_slash24_cannot_dominate_the_book():
+    """Eclipse backing, addr-book level: hundreds of addresses from one
+    /24 (one attacker subnet, one source) collapse into the few buckets
+    their (group, source-group) hash allows, so they evict EACH OTHER —
+    while a handful of diverse addresses stay present and pickable."""
+    import random as _random
+
+    book = AddrBook()
+    book._rng = _random.Random(7)
+    src = NetAddress("9.9.9.1", 26656)
+    for i in range(500):
+        book.add_address(NetAddress(f"9.9.9.{i % 250}", 10000 + i), src)
+    diverse = []
+    for i in range(20):
+        a = NetAddress(f"{20 + i}.{i + 1}.0.1", 26656)
+        diverse.append(a)
+        book.add_address(a, a)
+
+    doms = [k for k in book._addrs if k.startswith("9.9.9.")]
+    # one (group, src-group) pair hashes to at most NEW_BUCKETS_PER_ADDRESS
+    # buckets of BUCKET_SIZE — the 500 dials cannot occupy more
+    from tendermint_tpu.p2p.addrbook import (
+        BUCKET_SIZE,
+        NEW_BUCKETS_PER_ADDRESS,
+    )
+
+    assert len(doms) <= NEW_BUCKETS_PER_ADDRESS * BUCKET_SIZE, len(doms)
+    # every diverse address survived the flood
+    for a in diverse:
+        assert str(a) in book._addrs
+    # and the picker still reaches them (seeded: deterministic)
+    picked_diverse = sum(
+        1 for _ in range(300)
+        if not str(book.pick_address()).startswith("9.9.9.")
+    )
+    assert picked_diverse >= 10, picked_diverse
