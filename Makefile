@@ -24,7 +24,7 @@ test:
 # hold (dots no worse than the seed) — plus the chip-free hash-stream
 # smoke (the two asserted BENCH_r07 rows: streamed hash offload >= 1.3x
 # single-shot on the sim transport, flat host builder >= 1.5x recursive).
-tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke wan-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke
+tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke wan-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke retention-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Chip-free bench smoke: every BASELINE config on the pinned CPU backend,
@@ -151,6 +151,19 @@ metrics-smoke:
 txtrace-smoke:
 	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_TXTRACE_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_txtrace.py
 
+# Retention smoke, chip-free (~60 s): bench_retention.py's reduced pass
+# — the ~200-height bounded-retention run: a live sqlite-backed node
+# with [pruning] + the statesync producer armed vs an archive twin,
+# steady-state disk bytes/height asserted bounded by retention (ratio
+# floor), then the adversarial statesync offerer burst: forged-manifest,
+# corrupt-chunk, and stalling offerers each BANNED (scrape-visible,
+# latency recorded) while a joining node's restore completes from the
+# honest source. Runs as part of `make tier1` (the slow retention soak +
+# offerer matrix under WAN live in tests/test_netchaos.py; the crash
+# tier in tests/test_retention.py).
+retention-smoke:
+	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_RETENTION_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_retention.py
+
 test_race:
 	$(PY) -m pytest tests/test_race.py -q
 
@@ -163,4 +176,4 @@ test_slow:
 native:
 	$(MAKE) -C native
 
-.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke wan-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke
+.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke wan-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke retention-smoke

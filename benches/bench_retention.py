@@ -1,0 +1,251 @@
+"""BENCH_r19: bounded-retention lifecycle (docs/state-sync.md § Retention).
+
+Rows (all chip-free):
+
+- disk-per-height (ALWAYS, asserted): two live single-validator
+  sqlite-backed nodes commit the SAME tx-carrying workload — one with
+  [pruning] armed (+ statesync producer live), one archive — and the
+  steady-state disk growth per height is compared AFTER the pruning
+  horizon engages. The pruned node's bytes/height must undercut the
+  archive node's (floor BENCH_RETENTION_MAX_RATIO, default 0.8): disk
+  bounded by retention, not chain length. This ~200-height pass is the
+  tier-1 retention smoke the ISSUE names (`make retention-smoke`).
+- offerer-ban-latency (ALWAYS, asserted): a joining node restores from
+  the pruned node while a FORGED-manifest offerer, a CORRUPT-chunk
+  offerer, and a STALLING offerer attack the statesync channel; the
+  row records seconds from attack start to each kind's scrape-visible
+  ban, asserts all three land inside the budget, and asserts the
+  restore still completes from the honest source.
+
+BENCH_RETENTION_SMOKE=1 shrinks sizes for the tier-1 gate; the smoke
+asserts but never writes BENCH_r19.json (bench_partset's convention).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+SMOKE = os.environ.get("BENCH_RETENTION_SMOKE", "") == "1"
+N_HEIGHTS = int(os.environ.get(
+    "BENCH_RETENTION_HEIGHTS", "240" if SMOKE else "400"
+))
+RETAIN = int(os.environ.get("BENCH_RETENTION_RETAIN", "30"))
+PRUNE_INTERVAL = 10
+SNAPSHOT_INTERVAL = 20
+# keep exactly 2 snapshots: the deepest retention floor is then the
+# ~40-height snapshot window, so the pruned node reaches its disk
+# equilibrium well before the measurement window opens at N/2 (a wider
+# window measured half pre-equilibrium growth and flaked the ratio)
+SNAPSHOT_KEEP = 2
+MAX_RATIO = float(os.environ.get("BENCH_RETENTION_MAX_RATIO", "0.8"))
+BAN_BUDGET_S = float(os.environ.get("BENCH_RETENTION_BAN_BUDGET_S", "90"))
+
+# retention knobs the nodes read at boot: a small tree-version window
+# (kvstore's default 64 would pin the floor far above the operator
+# target at bench scale), WAL chunks small enough to rotate (and so to
+# prune) inside the run, and fast statesync windows for the ban row
+os.environ.setdefault("TENDERMINT_STATETREE_KEEP_VERSIONS", "24")
+os.environ.setdefault("TENDERMINT_WAL_CHUNK_BYTES", "65536")
+os.environ.setdefault("TENDERMINT_STATESYNC_WINDOW", "4")
+os.environ.setdefault("TENDERMINT_STATESYNC_CHUNK_TIMEOUT_S", "2")
+os.environ.setdefault("TENDERMINT_STATESYNC_STALL_BAN", "2")
+os.environ.setdefault("TENDERMINT_STATESYNC_DISCOVERY_S", "3")
+
+from tests.netchaos_common import (  # noqa: E402
+    CHAIN_ID,
+    ChaosNet,
+    hostile_offerer_matrix,
+    wait_until,
+)
+
+
+_TX_SEQ = iter(range(1 << 30))  # unique across _drive calls (dedup cache)
+
+
+def _drive(net: "ChaosNet", target: int, label: str) -> None:
+    """Commit to `target` heights with a light tx per height so blocks
+    carry real bytes (empty blocks would flatter the archive node)."""
+    while net.nodes[0].block_store.height() < target:
+        net.broadcast_tx(
+            b"%s-%d=%s" % (label.encode(), next(_TX_SEQ), b"v" * 200), via=0
+        )
+        h = net.nodes[0].block_store.height()
+        assert wait_until(
+            lambda: net.nodes[0].block_store.height() > h, timeout=60
+        ), f"{label}: stalled at height {h}"
+
+
+def bench_disk_per_height(root: str) -> tuple[dict, "ChaosNet"]:
+    """Steady-state disk growth per height, pruned vs archive. Returns
+    the row AND the pruned net still running (the ban row reuses it)."""
+    nets = {}
+    rates = {}
+    disks = {}
+    for label, retain in (("pruned", RETAIN), ("archive", 0)):
+        net = ChaosNet(
+            1, os.path.join(root, label), db_backend="sqlite",
+            snapshot_interval=SNAPSHOT_INTERVAL, snapshot_full_every=1,
+            snapshot_chunk_size=4096, snapshot_keep=SNAPSHOT_KEEP,
+            # tx-driven cadence: blocks commit per submitted tx, idle
+            # heights tick slowly — snapshot lifetime then covers the
+            # ban row's restore (see bench_offerer_ban_latency)
+            height_throttle_s=0.25,
+            retain_blocks=retain, prune_interval=PRUNE_INTERVAL,
+        )
+        net.start()
+        # warm up past the point where the pruned node's horizon engages
+        # (operator target + tree keep + snapshot window all satisfied)
+        # AND the sqlite file reaches its free-page equilibrium, then
+        # measure the steady-state stretch
+        warmup = max(2 * RETAIN, N_HEIGHTS // 2)
+        _drive(net, warmup, label)
+        h1, d1 = net.nodes[0].block_store.height(), net.disk_bytes()
+        _drive(net, N_HEIGHTS, label)
+        h2, d2 = net.nodes[0].block_store.height(), net.disk_bytes()
+        rates[label] = (d2 - d1) / max(1, h2 - h1)
+        disks[label] = d2
+        if label == "pruned":
+            m = net.nodes[0].telemetry.flatten()
+            assert m["blockstore_pruned_heights_total"] > 0, (
+                "pruning never engaged at bench scale"
+            )
+            assert net.nodes[0].block_store.base() > 1
+            nets[label] = net  # kept running for the ban row
+        else:
+            net.stop()
+    ratio = rates["pruned"] / max(rates["archive"], 1.0)
+    row = {
+        "name": "disk_per_height",
+        "heights": N_HEIGHTS,
+        "retain_blocks": RETAIN,
+        "pruned_bytes_per_height": round(rates["pruned"]),
+        "archive_bytes_per_height": round(rates["archive"]),
+        "pruned_final_disk_bytes": disks["pruned"],
+        "archive_final_disk_bytes": disks["archive"],
+        "ratio": round(ratio, 3),
+        "max_ratio_asserted": MAX_RATIO,
+        "pruned_store_base": nets["pruned"].nodes[0].block_store.base(),
+        "wal_chunks_pruned": nets["pruned"].nodes[0].telemetry.flatten()[
+            "pruning_wal_chunks_pruned"
+        ],
+    }
+    return row, nets["pruned"]
+
+
+def bench_offerer_ban_latency(net: "ChaosNet") -> dict:
+    """Seconds from attack start to each offerer kind's ban on a live
+    restoring node. The source chain is throttled first so its producer
+    cannot race a NEWER honest snapshot past the pinned attack heights
+    mid-restore (the picker always takes the max offered height)."""
+    src = net.nodes[0]
+    ccfg = src.config.consensus
+    ccfg.timeout_commit = 1.0
+    ccfg.skip_timeout_commit = False
+    ccfg.create_empty_blocks_interval = 2.0  # idle heights every ~2-3 s
+    time.sleep(1.0)
+
+    h_s = max(src.snapshot_store.heights())
+    honest = src.snapshot_store.load_manifest(h_s)
+    chunks = [
+        src.snapshot_store.load_chunk(h_s, i) for i in range(honest.chunks)
+    ]
+    # the forged offer at h_s+1 needs header h_s+2 on chain for its
+    # light walk to SUCCEED (the binding check, not a transport miss,
+    # must be what proves the lie); idle heights tick every ~2-3 s
+    assert wait_until(
+        lambda: src.block_store.height() >= h_s + 2, timeout=60
+    ), (src.block_store.height(), h_s)
+
+    joiner = net.start_node(1, pv=None, statesync_from=[0])
+    jport = joiner.listener.internal_address().port
+    t0 = time.monotonic()
+    offerers = hostile_offerer_matrix(
+        "127.0.0.1", jport, CHAIN_ID, honest, chunks
+    )
+    reactor = joiner.statesync_reactor
+    latencies = {}
+    try:
+        deadline = time.monotonic() + BAN_BUDGET_S
+        while time.monotonic() < deadline and len(latencies) < 3:
+            for kind in ("forged", "corrupt", "stall"):
+                if kind not in latencies and getattr(
+                    reactor, f"offerer_bans_{kind}"
+                ) > 0:
+                    latencies[kind] = round(time.monotonic() - t0, 2)
+            time.sleep(0.05)
+        assert len(latencies) == 3, (
+            f"not every offerer kind banned within {BAN_BUDGET_S}s: "
+            f"{latencies}; reactor={reactor.stats()}"
+        )
+        assert wait_until(
+            lambda: joiner.block_store.base() > 1, timeout=120
+        ), "restore did not complete from the honest source"
+        assert joiner.block_store.base() == h_s
+    finally:
+        for o in offerers.values():
+            o.close()
+    return {
+        "name": "offerer_ban_latency",
+        "ban_latency_s": latencies,
+        "ban_budget_s": BAN_BUDGET_S,
+        "restored_base": joiner.block_store.base(),
+        "restore_completed": True,
+    }
+
+
+def main() -> None:
+    root = tempfile.mkdtemp(prefix="bench-retention-")
+    pruned_net = None
+    try:
+        disk_row, pruned_net = bench_disk_per_height(root)
+        ban_row = bench_offerer_ban_latency(pruned_net)
+    finally:
+        if pruned_net is not None:
+            pruned_net.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+    record = {
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "metric": (
+            "retention: disk bytes/height pruned vs archive + "
+            "adversarial statesync offerer ban latency"
+        ),
+        "smoke": SMOKE,
+        "rows": [disk_row, ban_row],
+        "note": (
+            "both rows chip-free; disk rates measured over the "
+            "steady-state stretch after the pruning horizon engages"
+        ),
+    }
+    # assert BEFORE writing (a failed run must not replace the artifact)
+    assert disk_row["ratio"] < MAX_RATIO, (
+        f"pruned node grows {disk_row['ratio']}x the archive rate "
+        f"(>{MAX_RATIO}): retention is not bounding disk"
+    )
+    if not SMOKE:
+        with open(os.path.join(ROOT, "BENCH_r19.json"), "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+
+    print(json.dumps({
+        "metric": "retention_disk_bytes_per_height",
+        "value": disk_row["pruned_bytes_per_height"],
+        "unit": "B/height",
+        "archive_bytes_per_height": disk_row["archive_bytes_per_height"],
+        "ratio": disk_row["ratio"],
+        "ban_latency_s": ban_row["ban_latency_s"],
+        "platform": "cpu",
+        "smoke": SMOKE,
+    }))
+
+
+if __name__ == "__main__":
+    main()

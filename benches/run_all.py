@@ -77,6 +77,12 @@ Configs (BASELINE.md):
                   signatures at the sig gate, shed asserted visible in
                   p2p_adversary_flood_txs_rejected (writes
                   BENCH_r18.json; chip-free)
+ 19 retention    — bounded-retention lifecycle: steady-state disk
+                  bytes/height on a pruned vs archive node (asserted
+                  bounded by retention, not chain length) + adversarial
+                  statesync offerer ban latency (forged / corrupt /
+                  stalling each banned while the restore completes from
+                  the honest source; writes BENCH_r19.json; chip-free)
  13 statetree    — authenticated app-state commitment: incremental
                   commit vs full tree rebuild, proof correctness rows,
                   delta-vs-full snapshot bytes (delta asserted <= 0.5x
@@ -118,6 +124,7 @@ BENCHES = {
     "16_committee": [sys.executable, "benches/bench_committee.py"],
     "17_txtrace": [sys.executable, "benches/bench_txtrace.py"],
     "18_wan": [sys.executable, "benches/bench_wan.py"],
+    "19_retention": [sys.executable, "benches/bench_retention.py"],
 }
 
 

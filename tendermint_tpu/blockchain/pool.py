@@ -23,9 +23,14 @@ REQUEST_RETRY_SECONDS = 5.0
 
 
 class BpPeer:
-    def __init__(self, peer_id: str, height: int):
+    def __init__(self, peer_id: str, height: int, base: int = 0):
         self.id = peer_id
         self.height = height
+        # round 19: the peer's store BASE (lowest height it can serve —
+        # >1 on pruned/snapshot-restored peers). 0 = unknown (a pre-r19
+        # peer whose status_response carries no base): treated as "can
+        # serve anything", exactly the pre-retention behavior.
+        self.base = base
         self.num_pending = 0
         self.recv_monitor = Monitor()
         self.timeout_at: float | None = None
@@ -136,18 +141,25 @@ class BlockPool(BaseService):
                 continue
             if peer.height < height:
                 continue
+            if peer.base > height:
+                # the peer PRUNED this height (round 19): asking would
+                # burn a block_request/no_block_response round trip per
+                # retry — ineligible without a wire exchange
+                continue
             return peer
         return None
 
     # -- peer management ---------------------------------------------------
 
-    def set_peer_height(self, peer_id: str, height: int) -> None:
+    def set_peer_height(self, peer_id: str, height: int,
+                        base: int = 0) -> None:
         with self._mtx:
             peer = self.peers.get(peer_id)
             if peer is None:
-                self.peers[peer_id] = BpPeer(peer_id, height)
+                self.peers[peer_id] = BpPeer(peer_id, height, base)
             else:
                 peer.height = height
+                peer.base = base
             self.max_peer_height = max(self.max_peer_height, height)
 
     def remove_peer(self, peer_id: str) -> None:
@@ -232,6 +244,24 @@ class BlockPool(BaseService):
             if bad_peer:
                 self._remove_peer_locked(bad_peer)
             return bad_peer
+
+    def below_horizon(self) -> int | None:
+        """The network's retained horizon when fast sync can NEVER make
+        progress from here (round 19): every known peer that is ahead of
+        us has pruned the next height we need (its base is above our
+        pool height). Returns the lowest such base — the height the
+        network retains back to — or None while any peer could still
+        serve. Peers that never reported a base (pre-r19) read as
+        base=0 = "serves everything", so mixed nets never false-trigger."""
+        with self._mtx:
+            ahead = [
+                p for p in self.peers.values() if p.height >= self.height
+            ]
+            if not ahead:
+                return None
+            if all(p.base > self.height for p in ahead):
+                return min(p.base for p in ahead)
+            return None
 
     # -- status ------------------------------------------------------------
 

@@ -128,6 +128,15 @@ class BlockchainReactor(Reactor, BaseService):
             "dispatch": 0.0, "part_hash": 0.0, "verify_wait": 0.0,
             "store_save": 0.0, "apply": 0.0,
         }
+        # horizon-aware catchup (round 19): when every serving peer has
+        # PRUNED the next height we need, fast sync can never converge —
+        # the node wires this to its statesync arm (node._on_below_horizon)
+        # and the pool routine calls it instead of spinning forever on
+        # no_block_response. fallback(horizon) -> bool: True = statesync
+        # armed, stop fast sync; False = keep trying (and keep logging).
+        self.horizon_fallback = None
+        self.below_horizon_fallbacks = 0
+        self._horizon_strikes = 0
 
     # -- Reactor interface -------------------------------------------------
 
@@ -141,11 +150,18 @@ class BlockchainReactor(Reactor, BaseService):
             )
         ]
 
+    def _status_response(self) -> bytes:
+        # round 19: the store BASE rides beside the height so a syncing
+        # peer learns not just how far we are but how far BACK we can
+        # serve (pruned/restored stores start above 1)
+        return _enc({
+            "type": "status_response",
+            "height": self.store.height(),
+            "base": self.store.base(),
+        })
+
     def add_peer(self, peer) -> None:
-        peer.try_send(
-            BLOCKCHAIN_CHANNEL,
-            _enc({"type": "status_response", "height": self.store.height()}),
-        )
+        peer.try_send(BLOCKCHAIN_CHANNEL, self._status_response())
         # a fast-syncing node must learn this peer's height promptly, not
         # at the next 10s status tick (the pool's 5s catch-up timeout races
         # a peer that connected at genesis height otherwise)
@@ -176,13 +192,18 @@ class BlockchainReactor(Reactor, BaseService):
                 block = Block.from_json(jv.dict_field(msg, "block"))
                 self.pool.add_block(peer.id(), block, len(msg_bytes))
             elif mtype == "status_request":
-                peer.try_send(
-                    BLOCKCHAIN_CHANNEL,
-                    _enc({"type": "status_response", "height": self.store.height()}),
-                )
+                peer.try_send(BLOCKCHAIN_CHANNEL, self._status_response())
             elif mtype == "status_response":
+                # base is round-19 optional: a pre-retention peer's
+                # status carries none, which reads as base 0 = "serves
+                # every height it has"
+                base = (
+                    jv.int_field(msg, "base", 0, jv.MAX_HEIGHT)
+                    if "base" in msg else 0
+                )
                 self.pool.set_peer_height(
-                    peer.id(), jv.int_field(msg, "height", 0, jv.MAX_HEIGHT)
+                    peer.id(), jv.int_field(msg, "height", 0, jv.MAX_HEIGHT),
+                    base=base,
                 )
             elif mtype == "no_block_response":
                 # honest "I don't have it" — free the requester for another peer
@@ -273,6 +294,8 @@ class BlockchainReactor(Reactor, BaseService):
                 self.broadcast_status_request()
             if now - last_switch_check >= SWITCH_TO_CONSENSUS_INTERVAL:
                 last_switch_check = now
+                if self._check_horizon():
+                    return
                 if self.pool.is_caught_up():
                     self.logger.info("caught up; switching to consensus")
                     if self.flightrec is not None:
@@ -449,6 +472,43 @@ class BlockchainReactor(Reactor, BaseService):
             except Exception:  # noqa: BLE001
                 self.logger.exception("post-apply hook failed at %d", first.header.height)
         return True
+
+    def _check_horizon(self) -> bool:
+        """Pool-routine tick: when every serving peer has pruned our next
+        height, hand the node over to statesync instead of spinning on
+        no_block_response forever. Two consecutive strikes (1s apart)
+        guard against a single peer's half-reported status. Returns True
+        when the routine should exit (statesync armed)."""
+        below = getattr(self.pool, "below_horizon", None)  # bare-harness
+        # pool fakes predate the round-19 horizon surface
+        horizon = below() if below is not None else None
+        if horizon is None:
+            self._horizon_strikes = 0
+            return False
+        self._horizon_strikes += 1
+        if self._horizon_strikes < 2 or self.horizon_fallback is None:
+            return False
+        self.logger.warning(
+            "fast-sync target %d is below the network's retained horizon "
+            "%d (every peer pruned it); attempting statesync fallback",
+            self.store.height() + 1, horizon,
+        )
+        if self.flightrec is not None:
+            self.flightrec.record(
+                "fastsync", event="below_horizon",
+                height=self.store.height(), horizon=horizon,
+            )
+        # deferred BEFORE the fallback arms statesync: a fast restore
+        # completing must find the reactor ready for the re-seed handoff
+        # (start_after_statesync asserts _deferred)
+        self._deferred = True
+        if self.horizon_fallback(horizon):
+            self.below_horizon_fallbacks += 1
+            self.pool.stop()
+            return True
+        self._deferred = False
+        self._horizon_strikes = 0  # re-arm; conditions may change
+        return False
 
     def broadcast_status_request(self) -> None:
         self.switch.broadcast(

@@ -41,8 +41,18 @@ class BlockStore:
     def __init__(self, db: DB):
         self.db = db
         self._mtx = threading.Lock()
+        self._prune_mtx = threading.Lock()  # serializes prune_to callers
         self._height = 0
         self._base = 0
+        # crash-safe prune bookkeeping (round 19): `clean_base` is the
+        # lowest height that may still hold data on disk. prune_to
+        # advances `base` FIRST (readers disown the range immediately),
+        # deletes, then advances clean_base — so clean_base < base marks
+        # an interrupted prune whose leftovers this open resumes deleting
+        self._clean_base = 0
+        # gauges (blockstore_* via the metrics RPC)
+        self.pruned_heights = 0
+        self.prune_runs = 0
         buf = db.get(_STORE_KEY)
         if buf:
             obj = json.loads(buf)
@@ -50,6 +60,9 @@ class BlockStore:
             # pre-round-10 stores have no base: a non-empty store starts
             # at height 1 (nothing was ever pruned before base existed)
             self._base = obj.get("base", 1 if self._height else 0)
+            self._clean_base = obj.get("clean_base", self._base)
+            if self._clean_base < self._base:
+                self._resume_prune()
 
     def height(self) -> int:
         with self._mtx:
@@ -65,7 +78,11 @@ class BlockStore:
     def _set_watermark_locked(self) -> None:
         self.db.set_sync(
             _STORE_KEY,
-            json.dumps({"height": self._height, "base": self._base}).encode(),
+            json.dumps({
+                "height": self._height,
+                "base": self._base,
+                "clean_base": self._clean_base,
+            }).encode(),
         )
 
     # -- loads -------------------------------------------------------------
@@ -133,6 +150,7 @@ class BlockStore:
             self._height = height
             if self._base == 0:
                 self._base = height  # first block this store ever held
+                self._clean_base = height
             self._set_watermark_locked()
 
     def seed_snapshot(self, meta: BlockMeta, parts: list[Part], seen_commit: Commit) -> None:
@@ -158,15 +176,53 @@ class BlockStore:
         with self._mtx:
             self._height = height
             self._base = height
+            self._clean_base = height
             self._set_watermark_locked()
+
+    def _delete_heights(self, lo: int, hi: int) -> int:
+        """Delete the data of heights [lo, hi) plus the canonical commit
+        under lo-1 (block lo's LastCommit, stored under lo-1 at save
+        time — below the new base once hi is the base). Pure deletes; no
+        watermark writes."""
+        deleted = 0
+        for h in range(lo, hi):
+            meta = self.load_block_meta(h)
+            if meta is not None:
+                for i in range(meta.block_id.parts_header.total):
+                    self.db.delete(_part_key(h, i))
+            self.db.delete(_meta_key(h))
+            self.db.delete(_commit_key(h))
+            self.db.delete(_seen_commit_key(h))
+            deleted += 1
+        self.db.delete(_commit_key(lo - 1))
+        return deleted
+
+    def _resume_prune(self) -> None:
+        """Open-time recovery: a crash mid-prune left clean_base < base —
+        the heights in between are already disowned (readers treat them
+        as pruned) but may still hold partial data. Finish their deletes
+        and advance clean_base. Runs from __init__, single-threaded."""
+        self._delete_heights(self._clean_base, self._base)
+        self._clean_base = self._base
+        self._set_watermark_locked()
 
     def prune_to(self, retain_height: int) -> int:
         """Delete everything below `retain_height`; returns the number of
         heights pruned. The watermark (with the new base) is flushed
         FIRST, so a crash mid-prune leaves heights the store already
         disowned — readers see base and treat them as pruned — never a
-        base claiming heights whose data is half-deleted."""
-        pruned = 0
+        base claiming heights whose data is half-deleted. The old base
+        persists as `clean_base` until the deletes finish, so the next
+        open resumes an interrupted prune instead of leaking the
+        half-deleted range forever (tests/test_retention.py SIGKILLs a
+        pruning subprocess mid-delete to hold this). Concurrent callers
+        serialize on a dedicated lock — overlapping delete ranges would
+        let the faster caller's clean_base claim cover the slower one's
+        unfinished deletes."""
+        with self._prune_mtx:
+            return self._prune_to_serialized(retain_height)
+
+    def _prune_to_serialized(self, retain_height: int) -> int:
         with self._mtx:
             if retain_height <= self._base:
                 return 0
@@ -175,17 +231,13 @@ class BlockStore:
                     f"cannot prune to {retain_height} past head {self._height}"
                 )
             old_base, self._base = self._base, retain_height
+            # clean_base stays at old_base: the watermark now says
+            # "[old_base, retain) is disowned but possibly on disk"
             self._set_watermark_locked()
-        for h in range(old_base, retain_height):
-            meta = self.load_block_meta(h)
-            if meta is not None:
-                for i in range(meta.block_id.parts_header.total):
-                    self.db.delete(_part_key(h, i))
-            self.db.delete(_meta_key(h))
-            self.db.delete(_commit_key(h))
-            self.db.delete(_seen_commit_key(h))
-            pruned += 1
-        # the canonical commit for height base-1 is block base's
-        # LastCommit, stored under base-1 at save time — below base now
-        self.db.delete(_commit_key(old_base - 1))
+        pruned = self._delete_heights(old_base, retain_height)
+        with self._mtx:
+            self._clean_base = retain_height
+            self._set_watermark_locked()
+            self.pruned_heights += pruned
+            self.prune_runs += 1
         return pruned

@@ -195,6 +195,31 @@ class StateSyncConfig:
 
 
 @dataclass
+class PruningConfig:
+    """Bounded-retention lifecycle (round 19, docs/state-sync.md §
+    Retention): automatic block-store + WAL pruning so disk is bounded
+    by retention, not chain length. Off by default — archive nodes keep
+    everything.
+
+    The configured `retain_blocks` is an OPERATOR TARGET, not the
+    effective retention: the coordinator (node/retention.py) prunes to
+    the MINIMUM of this target, the oldest published snapshot height
+    (the statesync producer must stay serviceable), the oldest pending
+    evidence height, and the app state tree's oldest retained version —
+    whichever plane needs the deepest history wins."""
+
+    root_dir: str = ""
+    # keep at least the newest N blocks (0 = pruning disabled). Values
+    # below 2 are clamped: consensus always needs the head block's seen
+    # commit and last-commit linkage.
+    retain_blocks: int = 0
+    # run the retention check every N committed heights (the prune
+    # itself rides the apply executor's tail, off the consensus
+    # critical path)
+    interval_heights: int = 10
+
+
+@dataclass
 class Config:
     base: BaseConfig = field(default_factory=BaseConfig)
     rpc: RPCConfig = field(default_factory=RPCConfig)
@@ -202,6 +227,7 @@ class Config:
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    pruning: PruningConfig = field(default_factory=PruningConfig)
 
     def set_root(self, root: str) -> "Config":
         self.base.root_dir = root
@@ -210,6 +236,7 @@ class Config:
         self.mempool.root_dir = root
         self.consensus.root_dir = root
         self.statesync.root_dir = root
+        self.pruning.root_dir = root
         return self
 
     def copy(self) -> "Config":
@@ -220,6 +247,7 @@ class Config:
             replace(self.mempool),
             replace(self.consensus),
             replace(self.statesync),
+            replace(self.pruning),
         )
 
 

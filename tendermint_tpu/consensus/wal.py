@@ -234,6 +234,11 @@ class WAL(BaseService):
         self._synced_records = 0  # sum of group sizes (for the avg)
         self._repairs = 0
         self._truncated_bytes = 0
+        # retention plane (round 19): whole rotated chunks dropped by
+        # prune_to, plus a per-chunk max-#ENDHEIGHT memo (rotated chunks
+        # are immutable, so one scan per chunk per process suffices)
+        self._chunks_pruned = 0
+        self._chunk_marker_cache: dict[str, int | None] = {}
         # clean-watermark plane (round 10, ROADMAP open item): chunks a
         # synced flush already covered skip the open-time CRC deep scan
         self._wm_path = wal_file + ".clean"
@@ -307,7 +312,13 @@ class WAL(BaseService):
         if os.path.exists(self._path):
             index_to_path[(indices[-1] + 1) if indices else 0] = self._path
         target = index_to_path.get(idx)
-        if target is None or any(i not in index_to_path for i in range(idx)):
+        # chunks below idx must be contiguous EXCEPT for a pruned prefix:
+        # retention (round 19, prune_to) deletes whole chunks from the
+        # front of the group, which must not invalidate the watermark —
+        # but a chunk missing from the MIDDLE means the log was mangled
+        missing = [i for i in range(idx) if i not in index_to_path]
+        prefix_pruned = missing == list(range(len(missing)))
+        if target is None or not prefix_pruned:
             logger.warning(
                 "WAL clean watermark names chunk %d which is missing; "
                 "deep-scanning the full history", idx,
@@ -566,6 +577,73 @@ class WAL(BaseService):
             return
         self._write_record(f"#ENDHEIGHT: {height}".encode(), sync=True)
 
+    # -- retention (round 19) ----------------------------------------------
+
+    def _chunk_max_marker(self, path: str) -> int | None:
+        """Largest #ENDHEIGHT height in a ROTATED chunk (None when the
+        chunk carries no marker). Memoized — rotated chunks never change."""
+        if path in self._chunk_marker_cache:
+            return self._chunk_marker_cache[path]
+        best: int | None = None
+        try:
+            with open(path, "rb") as f:
+                payloads, _bad = scan_frames(f.read())
+        except OSError:
+            # transient read failure (fd pressure, NFS blip): do NOT
+            # cache — a memoized None here could disable pruning for
+            # the process lifetime if this chunk held the anchor marker
+            return None
+        for p in payloads:
+            if p.startswith(b"#ENDHEIGHT:"):
+                try:
+                    best = int(p.split(b":", 1)[1])
+                except ValueError:
+                    continue
+        self._chunk_marker_cache[path] = best
+        return best
+
+    def prune_to(self, retain_height: int) -> int:
+        """Drop rotated chunks whose entire content precedes history the
+        node still retains; returns the number of chunk files deleted.
+
+        Replay only ever searches `#ENDHEIGHT: h` markers for heights the
+        node still holds (h >= retain_height - 1, since retention keeps
+        the head blocks). Markers are strictly increasing through the
+        group, so every chunk OLDER than the newest chunk containing a
+        marker <= retain_height - 1 can only hold records below every
+        marker replay can be asked for — deletable wholesale. Chunk
+        granularity keeps this a pure unlink of immutable files: the
+        head and any chunk at/after the anchor are never touched, and
+        the clean watermark stays valid across a pruned prefix
+        (_load_watermark tolerates missing LEADING chunks)."""
+        if self._legacy:
+            return 0  # pre-framed logs predate retention; leave them be
+        paths = self.group.chunk_paths()
+        rotated = paths[:-1]  # head (last) is live, never pruned
+        anchor = None
+        for k in range(len(rotated) - 1, -1, -1):
+            m = self._chunk_max_marker(rotated[k])
+            if m is not None and m <= retain_height - 1:
+                anchor = k
+                break
+        if anchor is None or anchor == 0:
+            return 0
+        pruned = 0
+        for p in rotated[:anchor]:
+            try:
+                os.unlink(p)
+            except OSError:
+                # STOP at the first failure: deleting newer chunks past
+                # a surviving older one would punch a mid-log hole that
+                # permanently invalidates the clean watermark (its
+                # pruned-prefix tolerance requires the missing indices
+                # to be a LEADING run); the stuck chunk retries next pass
+                break
+            pruned += 1
+            self._chunk_marker_cache.pop(p, None)
+        self._chunks_pruned += pruned
+        return pruned
+
     # -- replay reads ------------------------------------------------------
 
     def _chunk_payload_lists(self) -> list[tuple[str, list[bytes]]]:
@@ -643,6 +721,9 @@ class WAL(BaseService):
                 "group_size_avg": round(self._synced_records / synced_groups, 2),
                 "repairs": self._repairs,
                 "truncated_bytes": self._truncated_bytes,
+                # retention plane (round 19): rotated chunks dropped
+                # below the retain horizon
+                "chunks_pruned": self._chunks_pruned,
                 # clean-watermark plane (round 10): how much history the
                 # last open trusted without re-reading — skipped bytes at 0
                 # on a long-lived home means the watermark is not landing

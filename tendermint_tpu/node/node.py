@@ -15,6 +15,7 @@ one batching point.
 from __future__ import annotations
 
 import logging
+import os
 
 from tendermint_tpu.blockchain.reactor import BlockchainReactor
 from tendermint_tpu.blockchain.store import BlockStore
@@ -264,6 +265,12 @@ class Node(BaseService):
                 "(store height %d); using fast sync", self.block_store.height(),
             )
 
+        # kept for statesync wiring: the runtime horizon fallback
+        # (below-horizon laggard -> statesync, round 19) rebuilds a
+        # Restorer with exactly what _make_restorer needs
+        self._local_app = local_app
+        self._state_db = state_db
+
         # -- consensus ----------------------------------------------------
         self.consensus_state = ConsensusState(
             config.consensus,
@@ -278,8 +285,29 @@ class Node(BaseService):
         self.consensus_state.txtrace = self.txtrace
         self.consensus_state.flightrec = self.flightrec
         self.consensus_state.set_event_switch(self.evsw)
-        if self.snapshot_producer is not None:
-            self.consensus_state.post_apply_hook = self.snapshot_producer.maybe_snapshot
+
+        # -- retention coordinator (round 19, docs/state-sync.md §
+        # Retention): [pruning] arms automatic block-store + WAL pruning
+        # on the apply executor's tail, AFTER the snapshot producer in
+        # the hook chain so a snapshot published at H is on disk before
+        # the prune computes its snapshot floor. Constructed always
+        # (stable pruning_* metric family); inert when retain_blocks=0.
+        from tendermint_tpu.node.retention import RetentionCoordinator
+
+        self.retention = RetentionCoordinator(
+            config.pruning,
+            self.block_store,
+            snapshot_store=self.snapshot_store,
+            wal_fn=lambda: self.consensus_state.wal,
+            evidence_pool=self.consensus_state.evidence_pool,
+            tree_app=self.app_state_tree_app,
+            db_dir=config.base.db_dir(),
+            wal_dir=os.path.dirname(config.consensus.wal_file()),
+            snapshot_dir=sc.snapshot_dir(),
+        )
+        post_apply_hook = self._compose_post_apply_hooks()
+        if post_apply_hook is not None:
+            self.consensus_state.post_apply_hook = post_apply_hook
         self.consensus_reactor = ConsensusReactor(self.consensus_state, fast_sync)
         self.consensus_reactor.set_event_switch(self.evsw)
 
@@ -294,10 +322,7 @@ class Node(BaseService):
             async_batch_verifier=self.verifier.verify_batch_async,
             part_hasher=self.hasher.part_leaf_hashes,
             part_tree_hasher=self.hasher.part_set_tree,
-            post_apply_hook=(
-                self.snapshot_producer.maybe_snapshot
-                if self.snapshot_producer is not None else None
-            ),
+            post_apply_hook=post_apply_hook,
             defer_for_statesync=statesync_restore,
             evidence_pool=self.consensus_state.evidence_pool,
         )
@@ -326,6 +351,10 @@ class Node(BaseService):
                 "statesync: restore armed (light verify via %s, trust height %d)",
                 sc.rpc_servers or "genesis", sc.trust_height,
             )
+        # horizon-aware catchup (round 19): a fast-syncing node whose
+        # next height EVERY peer has pruned switches to statesync at
+        # runtime instead of spinning on no_block_response forever
+        self.blockchain_reactor.horizon_fallback = self._on_below_horizon
 
         # -- p2p switch (node.go:231-245) ---------------------------------
         peer_config = PeerConfig(
@@ -404,7 +433,67 @@ class Node(BaseService):
 
         self.flightrec.counters_fn = _flight_counters
 
+    # -- retention wiring --------------------------------------------------
+
+    def _compose_post_apply_hooks(self):
+        """The apply executor's tail chain: snapshot producer first (a
+        snapshot at H must publish before retention reads its floor),
+        then the retention coordinator. Each link keeps its own
+        never-raises contract; the composition preserves it. Returns
+        None when neither is armed (the pre-hook fast path)."""
+        hooks = []
+        if self.snapshot_producer is not None:
+            hooks.append(self.snapshot_producer.maybe_snapshot)
+        if self.retention.enabled:
+            hooks.append(self.retention.maybe_prune)
+        if not hooks:
+            return None
+        if len(hooks) == 1:
+            return hooks[0]
+
+        def chained(state, block=None):
+            for hook in hooks:
+                hook(state, block)
+
+        return chained
+
     # -- statesync wiring --------------------------------------------------
+
+    def _on_below_horizon(self, horizon: int) -> bool:
+        """Blockchain-reactor fallback (round 19): fast sync proved the
+        network pruned past our target. Arm a runtime statesync restore
+        when this node can actually take one — a fresh node (empty store,
+        app at 0) with light-client endpoints configured. Returns True
+        when statesync was armed (the reactor then stops its pool)."""
+        if self.statesync_reactor.restore_active:
+            return False
+        if self.block_store.height() != 0 or self.state.last_block_height != 0:
+            logger.error(
+                "node is below the network's retained horizon (%d) but "
+                "already holds a chain at height %d — cannot statesync in "
+                "place; wipe the home and restart with statesync, or find "
+                "an archive peer", horizon, self.block_store.height(),
+            )
+            return False
+        restorer = self._make_restorer(
+            self.config.statesync, self._local_app, self.genesis_doc,
+            self._state_db,
+        )
+        if restorer is None:
+            logger.error(
+                "node is below the network's retained horizon (%d) and "
+                "statesync cannot arm (no in-process app or no "
+                "statesync.rpc_servers configured) — fast sync will keep "
+                "retrying but cannot converge", horizon,
+            )
+            return False
+        armed = self.statesync_reactor.arm_restore(restorer)
+        if armed:
+            logger.warning(
+                "auto-switching to statesync: network retains only "
+                "heights >= %d", horizon,
+            )
+        return armed
 
     def _make_restorer(self, sc, local_app, genesis_doc, state_db):
         """Build the restore-side Restorer, or None (with a logged

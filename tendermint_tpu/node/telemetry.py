@@ -11,7 +11,10 @@ collision dance).
 Canonical plane prefixes (full catalog: docs/observability.md):
 
     consensus_*        ConsensusState position + liveness gauges
-    blockstore_*       BlockStore head/base
+    blockstore_*       BlockStore head/base + round-19 prune accounting
+    pruning_*          round-19 retention coordinator (node/retention.py):
+                       enabled/target/runs, per-plane retention floors,
+                       per-plane disk gauges
     wal_*              consensus WAL durability gauges (after start)
     evidence_*         duplicate-vote evidence pool
     mempool_*          pool depth + sig-gate accounting
@@ -146,8 +149,19 @@ def build_registry(node) -> telemetry.Registry:
         lambda: {
             "height": node.block_store.height(),
             "base": node.block_store.base(),
+            # round 19: retention accounting — base > 1 says "pruned or
+            # restored"; this says how much and how often
+            "pruned_heights_total": node.block_store.pruned_heights,
+            "prune_runs": node.block_store.prune_runs,
         },
     )
+
+    # round 19: the retention coordinator — enabled/target/runs, the
+    # per-plane floors of the last pass (WHICH plane pinned retention),
+    # and per-plane disk gauges (block store / WAL / snapshots; cached a
+    # few seconds so scrapes stay cheap). Always registered — the family
+    # set is stable whether or not [pruning] is armed.
+    reg.register_producer("pruning", node.retention.stats)
 
     def wal() -> dict:
         # host durability plane (round 9): group-commit shape + repair
@@ -288,6 +302,9 @@ def build_registry(node) -> telemetry.Registry:
             "active": int(bool(bc.fast_sync)),
             "blocks_synced": bc.blocks_synced,
             "rate_blocks_per_sec": round(bc.sync_rate, 3),
+            # round 19: times the catchup path detected the network's
+            # retained horizon above its target and armed statesync
+            "below_horizon_fallbacks": bc.below_horizon_fallbacks,
         }
         for stage, secs in bc.stage_s.items():
             out[f"{stage}_s"] = round(secs, 3)

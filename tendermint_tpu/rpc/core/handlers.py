@@ -48,6 +48,9 @@ def status(ctx) -> dict:
         "latest_block_hash": _hex(latest_hash),
         "latest_app_hash": _hex(latest_app_hash),
         "latest_block_height": latest_height,
+        # round 19: the store base — a client planning historical reads
+        # learns the retained range without probing for errors
+        "earliest_block_height": ctx.block_store.base(),
         "latest_block_time": latest_time,
     }
 
@@ -77,18 +80,26 @@ def genesis(ctx) -> dict:
 
 
 def blockchain_info(ctx, min_height: int = 0, max_height: int = 0) -> dict:
+    """Block metas for [min_height, max_height], newest first. On a
+    pruned/restored node the range CLAMPS to the store base (round 19):
+    a request reaching below base returns the retained tail (possibly
+    empty) plus the `base` so the client sees exactly what was clamped —
+    it never errors mid-range for asking about history that was
+    legitimately dropped. min > max in the CALLER's own numbers is still
+    an error."""
     store_height = ctx.block_store.height()
-    floor = max(1, ctx.block_store.base())
+    base = ctx.block_store.base()
+    floor = max(1, base)
+    if min_height and max_height and min_height > max_height:
+        raise RPCError(f"min height {min_height} > max height {max_height}")
     max_height = min(store_height, max_height) if max_height else store_height
     min_height = max(floor, min_height) if min_height else max(floor, max_height - 20 + 1)
-    if min_height > max_height:
-        raise RPCError(f"min height {min_height} > max height {max_height}")
     metas = []
     for h in range(max_height, min_height - 1, -1):
         meta = ctx.block_store.load_block_meta(h)
         if meta is not None:
             metas.append(meta.to_json())
-    return {"last_height": store_height, "block_metas": metas}
+    return {"last_height": store_height, "base": base, "block_metas": metas}
 
 
 def _check_pruned(ctx, height: int) -> None:
@@ -293,7 +304,12 @@ def tx(ctx, hash, prove: bool = False) -> dict:
     if prove:
         from tendermint_tpu.types.tx import txs_proof
 
+        # the proof needs the block itself; on a pruned store the index
+        # may outlive the block (round 19) — clear error, not a crash
+        _check_pruned(ctx, res.height)
         blk = ctx.block_store.load_block(res.height)
+        if blk is None:
+            raise RPCError(f"no block at height {res.height} for tx proof")
         proof = txs_proof(blk.data.txs, res.index)
         out["proof"] = proof.to_json()
     return out

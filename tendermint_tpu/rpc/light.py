@@ -134,7 +134,23 @@ class LightClient:
         cast by validators PRESENT IN THE OLD TRUSTED SET carry > 2/3 of
         the old set's power — i.e. the set we already trust still
         controls the chain across the transition. An attacker without
-        2/3 of the trusted keys cannot fabricate (d)."""
+        2/3 of the trusted keys cannot fabricate (d).
+
+        Pruned sources (round 19, bounded retention): a server that
+        pruned history below its store base cannot serve the sequential
+        walk's early commits. When a commit fetch fails AND the server's
+        /status attests `earliest_block_height > h`, the walk JUMPS to
+        that horizon. Across the gap, header linkage (c) is unknowable
+        and is skipped; trust transfers on the signature rules alone —
+        same set: +2/3 of the CURRENTLY trusted set on the horizon
+        commit; changed set: rules (a)/(b)/(d), i.e. the old trusted
+        set's members must still carry > 2/3 of its power among the
+        horizon commit's valid precommits (strictly stronger than
+        production Tendermint's 1/3-overlap skipping rule). A set that
+        turned over past that bound inside the pruned gap fails loudly:
+        the operator must pin statesync.trust_height inside the retained
+        window. A lying `earliest_block_height` is denial-of-service
+        only — it can widen the jump, never weaken the signature rules."""
         prev_header = self._trusted_header
         if prev_header is None and self.height >= 1:
             # trust was established out-of-band (or this object was rebuilt
@@ -147,7 +163,16 @@ class LightClient:
         # only genesis trust (no header) starts at 1
         h = self.height + 1 if prev_header is not None else 1
         while h <= to_height:
-            res = self.client.commit(height=h)
+            try:
+                res = self.client.commit(height=h)
+            except Exception:
+                jump = self._horizon_jump_target(h, to_height)
+                if jump is None:
+                    raise  # a real transport/server failure
+                prev_header = None  # linkage across the pruned gap is
+                # unknowable; the signature rules below carry the trust
+                h = jump
+                continue
             try:
                 header = Header.from_json(res.get("header"))
             except ValueError as exc:
@@ -178,6 +203,31 @@ class LightClient:
             self.height = h
             self._trusted_header = prev_header
             h += 1
+
+    def horizon_floor(self) -> int | None:
+        """The server's attested earliest servable height
+        (/status earliest_block_height, round 19) — what a caller
+        stepping the walk in its own strides (statesync's header-caching
+        loop) consults to aim past a pruned gap. None when the probe
+        fails or the server predates the field."""
+        try:
+            st = self.client.status()
+        except Exception:  # noqa: BLE001 — dead server: no attestation
+            return None
+        earliest = st.get("earliest_block_height", 0) or 0
+        if isinstance(earliest, int) and earliest > 0:
+            return earliest
+        return None
+
+    def _horizon_jump_target(self, h: int, to_height: int) -> int | None:
+        """Where the walk may legally resume when the server cannot
+        serve height `h`: the server's own attested earliest height,
+        IFF it proves a pruned gap (earliest above h, at or below the
+        target). None re-raises the original fetch failure."""
+        earliest = self.horizon_floor()
+        if earliest is not None and h < earliest <= to_height:
+            return earliest
+        return None
 
     def _check_old_set_overlap(
         self, height: int, commit: Commit, new_set: ValidatorSet

@@ -133,12 +133,29 @@ class StateSyncReactor(Reactor, BaseService):
         self._chunk_expect: set[tuple[int, int]] = set()
         self._thread: threading.Thread | None = None
 
+        # adversarial-offerer hardening (round 19): a peer whose chunk
+        # (or manifest) requests repeatedly time out unanswered is a
+        # STALLER — it costs the restore a full window timeout per
+        # strike, so after `stall_ban_after` unanswered requests it is
+        # banned like a corrupt one. Any answer (chunk, no_chunk,
+        # manifest) clears the peer's strikes.
+        self.stall_ban_after = max(
+            int(env_number("TENDERMINT_STATESYNC_STALL_BAN", 3, cast=int)), 1
+        )
+        self._stall_strikes: dict[str, int] = {}
+
         # gauges (statesync_* in the metrics RPC)
         self.restore_active = 0
         self.chunks_fetched = 0
         self.chunk_failures = 0
         self.peers_banned = 0
         self.offers_seen = 0
+        # round 19: offerer bans by proven kind (scrape-visible — the
+        # adversarial scenario matrix asserts on these)
+        self.offerers_banned = 0
+        self.offerer_bans_forged = 0
+        self.offerer_bans_corrupt = 0
+        self.offerer_bans_stall = 0
 
     # -- Reactor interface -------------------------------------------------
 
@@ -311,6 +328,29 @@ class StateSyncReactor(Reactor, BaseService):
             )
             self._thread.start()
 
+    def arm_restore(self, restorer) -> bool:
+        """Arm a restore on an ALREADY-RUNNING serve-only reactor — the
+        horizon-aware catchup fallback (round 19): a fast-syncing node
+        whose next height every peer has pruned switches to statesync at
+        runtime instead of spinning on no_block_response. Returns True
+        when the restore thread launched (False: already restoring, or
+        the reactor is not running)."""
+        if not self.is_running():
+            return False
+        with self._cv:
+            if self.restore_active or (
+                self._thread is not None and self._thread.is_alive()
+            ):
+                return False
+            self.restorer = restorer
+            self.enabled = True
+            self.restore_active = 1
+        self._thread = threading.Thread(
+            target=self._restore_routine, daemon=True, name="statesync.restore"
+        )
+        self._thread.start()
+        return True
+
     def on_stop(self) -> None:
         with self._cv:
             self._cv.notify_all()
@@ -332,14 +372,38 @@ class StateSyncReactor(Reactor, BaseService):
             peers += [p for p in self._peers_for(also_ask) if p.id() not in have]
         return peers
 
-    def _ban_peer(self, peer_id: str, reason: str) -> None:
+    def _ban_peer(self, peer_id: str, reason: str,
+                  kind: str | None = None) -> None:
         self.peers_banned += 1
+        if kind is not None:
+            self.offerers_banned += 1
+            attr = f"offerer_bans_{kind}"
+            setattr(self, attr, getattr(self, attr) + 1)
         with self._cv:
             for offers in self._offers.values():
                 offers.discard(peer_id)
+            self._stall_strikes.pop(peer_id, None)
         peer = self.switch.peers.get(peer_id)
         if peer is not None:
             self.switch.stop_peer_for_error(peer, reason)
+
+    def _note_stall(self, peer_id: str, what: str) -> None:
+        """One unanswered request from `peer_id` timed out. Bans the
+        peer after stall_ban_after strikes — a stalling offerer must not
+        cost the restore a window timeout forever."""
+        strikes = self._stall_strikes.get(peer_id, 0) + 1
+        self._stall_strikes[peer_id] = strikes
+        if strikes >= self.stall_ban_after:
+            logger.warning(
+                "peer %s stalled %d statesync request(s) (%s); banning",
+                peer_id[:8], strikes, what,
+            )
+            self._ban_peer(
+                peer_id, f"statesync stall ({what})", kind="stall"
+            )
+
+    def _clear_stall(self, peer_id: str) -> None:
+        self._stall_strikes.pop(peer_id, None)
 
     def _restore_routine(self) -> None:
         deadline = time.monotonic() + self.fallback_s
@@ -468,8 +532,18 @@ class StateSyncReactor(Reactor, BaseService):
                     and self.is_running()
                 ):
                     self._cv.wait(0.25)
+                answered = height in self._manifest_inbox
                 m = self._manifest_inbox.pop(height, None)
                 self._manifest_expect = None
+            if not answered:
+                # never answered at all: a stall strike (an honest
+                # no_manifest answered and costs nothing) — but only
+                # when the DEADLINE expired; a wait cut short by the
+                # reactor stopping proves nothing about the peer
+                if self.is_running() and time.monotonic() >= deadline:
+                    self._note_stall(peer.id(), "manifest")
+                continue
+            self._clear_stall(peer.id())
             if m is None:
                 continue
             try:
@@ -479,7 +553,10 @@ class StateSyncReactor(Reactor, BaseService):
                     "manifest %d from %s contradicts the verified chain "
                     "(%s); banning", height, peer.id()[:8], exc,
                 )
-                self._ban_peer(peer.id(), f"statesync manifest {height}: {exc}")
+                self._ban_peer(
+                    peer.id(), f"statesync manifest {height}: {exc}",
+                    kind="forged",
+                )
                 continue
             return m
         raise RestoreError(f"no peer served a usable manifest for height {height}")
@@ -628,6 +705,7 @@ class StateSyncReactor(Reactor, BaseService):
         arrived: dict[int, tuple[str, bytes]] = {}
         answered: set[int] = set()  # incl. honest no_chunk — a window
         # whose every request is answered must not sit out the timeout
+        answered_by: dict[int, str] = {}  # chunk -> actual RESPONDER
         with self._cv:
             while len(answered) < len(window) and self.is_running():
                 for i in window:
@@ -638,6 +716,7 @@ class StateSyncReactor(Reactor, BaseService):
                         continue
                     pid, payload = entry
                     answered.add(i)
+                    answered_by[i] = pid
                     if payload is None:  # honest no_chunk
                         self._offers.get(height, set()).discard(pid)
                         self.chunk_failures += 1
@@ -647,6 +726,22 @@ class StateSyncReactor(Reactor, BaseService):
                     break
                 self._cv.wait(0.25)
             self._chunk_expect = set()
+        # stall accounting (round 19): a request NOBODY answered (not
+        # even a no_chunk) by the deadline strikes the peer it was asked
+        # of; any answer clears the peer that ACTUALLY responded — never
+        # the asked peer on someone else's answer, or a staller whose
+        # chunks an accomplice keeps answering would launder its strikes
+        # forever while the window still burned its timeout. A wait cut
+        # short by the reactor STOPPING (not the deadline) strikes
+        # nobody — an honest peer must not be banned at shutdown.
+        for pid in answered_by.values():
+            self._clear_stall(pid)
+        if self.is_running() and time.monotonic() >= deadline:
+            for i, pid in asked.items():
+                if i not in answered_by:
+                    self._note_stall(
+                        pid, f"chunk {i} of snapshot {height}"
+                    )
         if not arrived:
             self.chunk_failures += len(window)
             return {}
@@ -657,15 +752,24 @@ class StateSyncReactor(Reactor, BaseService):
         self.chunks_fetched += len(items) - len(bad)
         self.chunk_failures += len(bad)
         good: dict[int, bytes] = {}
+        banned_this_pass: set[str] = set()
         for i, (pid, payload) in arrived.items():
             if i in bad:
                 # the digest PROVES the peer served corrupt bytes for
-                # the manifest it offered: penalize and refetch elsewhere
+                # the manifest it offered: penalize and refetch
+                # elsewhere — ONCE per peer per pass, so a window of N
+                # corrupt chunks counts one banned OFFERER, not N
+                # (offerers_banned counts peers, the counter's contract)
                 logger.warning(
                     "chunk %d of snapshot %d failed digest check; banning "
                     "peer %s", i, height, pid[:8],
                 )
-                self._ban_peer(pid, f"statesync chunk {i} digest mismatch")
+                if pid not in banned_this_pass:
+                    banned_this_pass.add(pid)
+                    self._ban_peer(
+                        pid, f"statesync chunk {i} digest mismatch",
+                        kind="corrupt",
+                    )
             else:
                 good[i] = payload
                 self._save_scratch(height, i, payload)
@@ -680,6 +784,11 @@ class StateSyncReactor(Reactor, BaseService):
             "chunk_failures": self.chunk_failures,
             "peers_banned": self.peers_banned,
             "offers_seen": self.offers_seen,
+            # round 19: adversarial-offerer bans by proven kind
+            "offerers_banned": self.offerers_banned,
+            "offerer_bans_forged": self.offerer_bans_forged,
+            "offerer_bans_corrupt": self.offerer_bans_corrupt,
+            "offerer_bans_stall": self.offerer_bans_stall,
             **self.store.stats(),
         }
         if self.restorer is not None:

@@ -156,8 +156,24 @@ class Restorer:
                     # itself cannot go backwards
                     while walker.height < height:
                         step = walker.height + 1
-                        walker.advance(step)
-                        self._verified_headers[step] = walker.trusted_header()
+                        try:
+                            walker.advance(step)
+                        except LightClientError:
+                            raise
+                        except Exception:
+                            # a PRUNED source (round 19) cannot serve the
+                            # one-height stride; aim the walk at its
+                            # attested horizon instead — advance()'s
+                            # pruned-gap signature rules carry the trust
+                            # across, and everything below the horizon is
+                            # uncacheable from this source regardless
+                            floor = walker.horizon_floor()
+                            if floor is None or not step < floor <= height:
+                                raise
+                            walker.advance(floor)
+                        self._verified_headers[walker.height] = (
+                            walker.trusted_header()
+                        )
                     if walker.height != height:
                         # behind the anchor (or a prior walk) AND not in
                         # the cache: permanently unverifiable

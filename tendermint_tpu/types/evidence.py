@@ -237,6 +237,20 @@ class EvidencePool:
                     break
             return out
 
+    def min_pending_height(self) -> int | None:
+        """Lowest height referenced by evidence still awaiting commit —
+        the retention coordinator's evidence floor (round 19): blocks at
+        and above a pending piece's height stay on disk so operators and
+        peers can audit the conflict it proves. None when nothing is
+        pending."""
+        with self._mtx:
+            heights = [
+                self._by_hash[h].height
+                for h in self._order
+                if h not in self._committed
+            ]
+            return min(heights) if heights else None
+
     def mark_committed(self, evidence: list) -> None:
         """A block carrying `evidence` was committed: remember each piece
         so it is never re-proposed, and adopt pieces this node had not

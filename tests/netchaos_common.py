@@ -66,11 +66,37 @@ class ChaosNet:
 
     def __init__(self, n: int, root: str, app: str = "kvstore",
                  snapshot_interval: int = 0,
-                 commit_format_of: dict[int, str] | None = None):
+                 commit_format_of: dict[int, str] | None = None,
+                 db_backend: str | None = None,
+                 retain_blocks: int = 0,
+                 prune_interval: int = 0,
+                 snapshot_chunk_size: int | None = None,
+                 snapshot_full_every: int | None = None,
+                 snapshot_keep: int | None = None,
+                 height_throttle_s: float | None = None):
         self.n = n
         self.root = root
         self.app = app
         self.snapshot_interval = snapshot_interval
+        # bounded-retention lifecycle (round 19): arm [pruning] on every
+        # node; db_backend="sqlite" puts the block store on real disk so
+        # the retention soaks measure actual bytes (the test preset's
+        # memdb keeps only WAL + snapshots on disk)
+        self.db_backend = db_backend
+        self.retain_blocks = retain_blocks
+        self.prune_interval = prune_interval
+        self.snapshot_chunk_size = snapshot_chunk_size
+        self.snapshot_full_every = snapshot_full_every
+        # snapshot LIFETIME engineering for the retention scenarios: at
+        # the test preset's cadence a node commits 5-20 heights/s, so
+        # the default keep_recent=2 rotates a snapshot out in a couple
+        # of seconds — any restore loses the race and the pruner chases
+        # past the height being fetched (real deployments snapshot
+        # hourly; lifetime >> restore time). snapshot_keep widens the
+        # window; height_throttle_s slows the commit cadence itself
+        # (a real timeout_commit instead of the preset's skipped one).
+        self.snapshot_keep = snapshot_keep
+        self.height_throttle_s = height_throttle_s
         # mixed-version nets (round 18): per-node genesis commit_format
         # override — {idx: "aggregate"} boots node idx under the other
         # flag; NodeInfo.compatible_with refuses the peering loudly
@@ -100,7 +126,8 @@ class ChaosNet:
 
     # -- boot ---------------------------------------------------------------
 
-    def _make_config(self, idx: int, statesync_from: list[int] | None = None):
+    def _make_config(self, idx: int, statesync_from: list[int] | None = None,
+                     statesync_enable: bool = True):
         cfg = test_config()
         home = os.path.join(self.root, f"node{idx}")
         ensure_root(home, cfg)
@@ -110,9 +137,28 @@ class ChaosNet:
         cfg.rpc.laddr = "tcp://127.0.0.1:0"
         cfg.p2p.laddr = "tcp://127.0.0.1:0"
         cfg.statesync.snapshot_interval = self.snapshot_interval
+        if self.db_backend is not None:
+            cfg.base.db_backend = self.db_backend
+        if self.retain_blocks:
+            cfg.pruning.retain_blocks = self.retain_blocks
+            cfg.pruning.interval_heights = max(self.prune_interval, 1)
+        if self.snapshot_chunk_size is not None:
+            cfg.statesync.chunk_size = self.snapshot_chunk_size
+        if self.snapshot_full_every is not None:
+            cfg.statesync.snapshot_full_every = self.snapshot_full_every
+        if self.snapshot_keep is not None:
+            cfg.statesync.snapshot_keep_recent = self.snapshot_keep
+        if self.height_throttle_s is not None:
+            # production semantics: wait timeout_commit after each
+            # commit before the next height (the preset skips it)
+            cfg.consensus.timeout_commit = self.height_throttle_s
+            cfg.consensus.skip_timeout_commit = False
         if statesync_from:
+            # statesync_enable=False configures the light-client
+            # endpoints WITHOUT arming a boot-time restore — the
+            # below-horizon runtime fallback (round 19) is what arms it
             cfg.base.fast_sync = True
-            cfg.statesync.enable = True
+            cfg.statesync.enable = statesync_enable
             cfg.statesync.rpc_servers = ",".join(
                 f"127.0.0.1:{self.nodes[j].rpc_port()}" for j in statesync_from
             )
@@ -137,8 +183,12 @@ class ChaosNet:
 
     def start_node(self, idx: int, pv: PrivValidatorFS | None,
                    statesync_from: list[int] | None = None,
-                   dial: list[int] | None = None) -> Node:
-        cfg = self._make_config(idx, statesync_from=statesync_from)
+                   dial: list[int] | None = None,
+                   statesync_enable: bool = True) -> Node:
+        cfg = self._make_config(
+            idx, statesync_from=statesync_from,
+            statesync_enable=statesync_enable,
+        )
         if pv is not None:
             pv.file_path = cfg.base.priv_validator_file()
             pv.save()
@@ -362,12 +412,15 @@ class ChaosNet:
             tick=0.1,
         )
 
-    def fingerprints(self, upto: int, node_idx: int) -> list[tuple]:
+    def fingerprints(self, upto: int, node_idx: int,
+                     from_height: int = 1) -> list[tuple]:
         """(height, block hash, part-set root, app hash) per height —
-        the byte-identity surface the soaks assert on."""
+        the byte-identity surface the soaks assert on. `from_height`
+        starts above 1 on pruned/restored stores (round 19), where
+        heights below base() are legitimately absent."""
         node = self.nodes[node_idx]
         out = []
-        for h in range(1, upto + 1):
+        for h in range(from_height, upto + 1):
             meta = node.block_store.load_block_meta(h)
             block = node.block_store.load_block(h)
             out.append(
@@ -381,14 +434,23 @@ class ChaosNet:
             )
         return out
 
-    def assert_converged(self, upto: int, nodes: list[int] | None = None) -> None:
+    def assert_converged(self, upto: int, nodes: list[int] | None = None,
+                         from_height: int | None = None) -> None:
+        """Byte-identity across `nodes` for heights [from_height, upto].
+        from_height=None compares from the HIGHEST base among the nodes
+        (round 19: pruned/restored stores legitimately hold different
+        prefixes; what they share must still be byte-identical)."""
         idxs = list(nodes if nodes is not None else range(len(self.nodes)))
-        want = self.fingerprints(upto, idxs[0])
+        if from_height is None:
+            from_height = max(
+                max(self.nodes[i].block_store.base(), 1) for i in idxs
+            )
+        want = self.fingerprints(upto, idxs[0], from_height=from_height)
         for i in idxs[1:]:
-            got = self.fingerprints(upto, i)
+            got = self.fingerprints(upto, i, from_height=from_height)
             assert got == want, (
-                f"node {i} diverges from node {idxs[0]} in heights 1..{upto}:"
-                f"\n{set(want) ^ set(got)}"
+                f"node {i} diverges from node {idxs[0]} in heights "
+                f"{from_height}..{upto}:\n{set(want) ^ set(got)}"
             )
 
     def broadcast_tx(self, tx: bytes, via: int = 0) -> None:
@@ -476,12 +538,19 @@ class HostilePeer:
         self.mconn = MConnection(
             self.conn,
             [ChannelDescriptor(id=c, priority=5) for c in channels],
-            on_receive=lambda ch, msg: None,
+            # round 19: subclasses that TALK BACK (the adversarial
+            # statesync offerers) override _on_receive; the base peer
+            # stays deaf like before
+            on_receive=self._on_receive,
             on_error=self._err.append,
         )
         self.mconn.start()
         if self.fuzz is not None:
             self.fuzz.prob_corrupt = corrupt_prob
+
+    def _on_receive(self, ch_id: int, msg_bytes: bytes) -> None:
+        """Inbound messages from the target; base adversaries ignore
+        them (runs on the mconn recv thread — overrides must not block)."""
 
     def send_msg(self, ch_id: int, payload: bytes) -> bool:
         return self.mconn.send(ch_id, payload)
@@ -573,6 +642,159 @@ class OversizedFramePeer(HostilePeer):
         # the mconn send side chops any length; the TARGET's vote
         # channel caps reassembly at 64 KiB and must kill the link
         return self.send_msg(self.vote_channel, b"\x00" * total_bytes)
+
+
+class HostileOfferer(HostilePeer):
+    """Adversarial statesync offerer (round 19 adversary catalog):
+    answers the target's snapshot discovery with an offer and then
+    attacks the restore path per `mode`:
+
+      "forged"  — serves a manifest whose header/app hashes contradict
+                  the light-verified chain (internally consistent, so it
+                  passes decode; the binding check proves the lie);
+      "corrupt" — offers a REAL snapshot but serves chunks whose bytes
+                  are flipped (the digest batch proves it);
+      "stall"   — answers discovery and the manifest, serves
+                  `stall_after` chunks, then goes silent mid-transfer.
+
+    The target must ban each kind (statesync_offerer_bans_* counters)
+    and complete its restore from the honest offerers. Construction:
+    attack state is set BEFORE super().__init__ because the mconn recv
+    thread (which drives _on_receive) starts inside it."""
+
+    moniker = "hostile-offerer"
+
+    def __init__(self, target_host: str, target_port: int, chain_id: str,
+                 manifest_json: dict, chunks: list[bytes] | None = None,
+                 mode: str = "forged", stall_after: int = 1, **kw):
+        assert mode in ("forged", "corrupt", "stall")
+        self.manifest_json = manifest_json
+        self.chunks = list(chunks or [])
+        self.mode = mode
+        self.stall_after = stall_after
+        self.chunks_answered = 0
+        self.requests_seen: list[str] = []
+        super().__init__(target_host, target_port, chain_id, **kw)
+
+    def _send_statesync(self, obj: dict) -> None:
+        import json as _json
+
+        from tendermint_tpu.statesync.reactor import STATESYNC_CHANNEL
+
+        self.send_msg(
+            STATESYNC_CHANNEL, _json.dumps(obj, sort_keys=True).encode()
+        )
+
+    def _lite(self) -> dict:
+        m = self.manifest_json
+        lite = {
+            "format": m["format"], "height": m["height"],
+            "chain_id": m["chain_id"], "chunks": m["chunks"],
+            "total_bytes": m["total_bytes"], "root": m["root"],
+            "header_hash": m["header_hash"],
+            "kind": m.get("kind", "full"),
+        }
+        if lite["kind"] == "delta":
+            lite["base_height"] = m["base_height"]
+        return lite
+
+    def _on_receive(self, ch_id: int, msg_bytes: bytes) -> None:
+        import json as _json
+
+        from tendermint_tpu.statesync.reactor import STATESYNC_CHANNEL
+
+        if ch_id != STATESYNC_CHANNEL:
+            return
+        try:
+            msg = _json.loads(msg_bytes.decode())
+            mtype = msg.get("type")
+        except (ValueError, UnicodeDecodeError):
+            return
+        self.requests_seen.append(str(mtype))
+        if mtype == "snapshots_request":
+            self._send_statesync(
+                {"type": "snapshots_response", "snapshots": [self._lite()]}
+            )
+        elif mtype == "manifest_request":
+            if msg.get("height") == self.manifest_json["height"]:
+                self._send_statesync(
+                    {"type": "manifest_response",
+                     "manifest": self.manifest_json}
+                )
+        elif mtype == "chunk_request":
+            if msg.get("height") != self.manifest_json["height"]:
+                return
+            if self.mode == "stall" and self.chunks_answered >= self.stall_after:
+                return  # mid-transfer silence — the attack
+            i = msg.get("index", 0)
+            if not isinstance(i, int) or not 0 <= i < len(self.chunks):
+                return
+            payload = self.chunks[i]
+            if self.mode == "corrupt" and payload:
+                payload = bytes([payload[0] ^ 0xFF]) + payload[1:]
+            self.chunks_answered += 1
+            self._send_statesync({
+                "type": "chunk_response",
+                "height": self.manifest_json["height"],
+                "index": i,
+                "chunk": payload.hex().upper(),
+            })
+
+
+def forged_manifest_json(honest_manifest, height: int, seed: int = 5) -> dict:
+    """A DECODE-VALID manifest at `height` that contradicts the verified
+    chain: chunk digests and root are internally consistent (one garbage
+    chunk), but header/app hashes are noise — the light-client binding
+    check is the only gate that can catch it, by proving the server
+    lied. Returns (manifest_json); pair it with HostileOfferer(mode=
+    "forged")."""
+    import random as _random
+
+    from tendermint_tpu.statesync.snapshot import Manifest, chunk_digest
+
+    rng = _random.Random(seed)
+    garbage = rng.randbytes(512)
+    m = Manifest(
+        height=height,
+        chain_id=honest_manifest.chain_id,
+        chunk_size=honest_manifest.chunk_size,
+        total_bytes=len(garbage),
+        chunk_digests=[chunk_digest(garbage)],
+        header_hash=rng.randbytes(20),
+        app_hash=rng.randbytes(20),
+        seen_commit=honest_manifest.seen_commit,
+    )
+    return m.to_json()
+
+
+def hostile_offerer_matrix(target_host: str, target_port: int,
+                           chain_id: str, honest_manifest,
+                           chunks: list[bytes],
+                           stall_after: int = 0) -> dict[str, HostileOfferer]:
+    """The full three-kind adversarial offerer burst against one
+    target: a FORGED manifest one height above the honest snapshot
+    (the picker takes max, so it is exercised first and its light walk
+    succeeds while the binding check proves the lie), a CORRUPT-chunk
+    offerer and a STALLING offerer both pinned at the honest height.
+    Shared by the netchaos scenario and benches/bench_retention.py —
+    callers also arm the TENDERMINT_STATESYNC_{WINDOW,CHUNK_TIMEOUT_S,
+    STALL_BAN,DISCOVERY_S} knobs for their timing budget, and must
+    close() every offerer."""
+    return {
+        "forged": HostileOfferer(
+            target_host, target_port, chain_id,
+            forged_manifest_json(honest_manifest,
+                                 honest_manifest.height + 1),
+        ),
+        "corrupt": HostileOfferer(
+            target_host, target_port, chain_id, honest_manifest.to_json(),
+            chunks=chunks, mode="corrupt",
+        ),
+        "stall": HostileOfferer(
+            target_host, target_port, chain_id, honest_manifest.to_json(),
+            chunks=chunks, mode="stall", stall_after=stall_after,
+        ),
+    }
 
 
 def slow_loris_handshake(target_host: str, target_port: int,

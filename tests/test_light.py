@@ -336,3 +336,114 @@ def test_advance_rejects_exactly_two_thirds_old_overlap():
         lc.advance(3)
     assert lc.validators.hash() == old_set.hash()
     assert lc.height == 2
+
+
+# -- pruned-source horizon jump (round 19, bounded retention) -----------------
+
+
+class PrunedStubClient(StubClient):
+    """A source that pruned history below `base`: commits below it
+    error exactly like the live RPC handler, and /status attests the
+    earliest retained height."""
+
+    def __init__(self, base: int):
+        super().__init__()
+        self.base = base
+        self.commit_calls: list[int] = []
+
+    def commit(self, height):
+        self.commit_calls.append(height)
+        if height < self.base:
+            raise RuntimeError(
+                f"height {height} is below the store's base {self.base}"
+            )
+        return super().commit(height)
+
+    def status(self):
+        return {
+            "latest_block_height": max(self.commits, default=0),
+            "earliest_block_height": self.base,
+        }
+
+
+def _pruned_chain(n: int, base: int, change_at: int | None = None,
+                  old_signs_transition: bool = True):
+    """n heights under {v1} (power 2), optionally switching sets at
+    `change_at`; the stub only SERVES heights >= base."""
+    pv1, pv2 = _pv(), _pv()
+    v1 = Validator.new(pv1.get_pub_key(), 2)
+    v2 = Validator.new(pv2.get_pub_key(), 1)
+    genesis_set = ValidatorSet([v1.copy()])
+    privs = {pv1.get_address(): pv1, pv2.get_address(): pv2}
+    stub = PrunedStubClient(base)
+    prev_id = None
+    cur_set = genesis_set
+    for h in range(1, n + 1):
+        if change_at is not None and h == change_at:
+            if old_signs_transition:
+                cur_set = ValidatorSet([v1.copy(), v2.copy()])
+            else:
+                atk = _pv()
+                privs[atk.get_address()] = atk
+                cur_set = ValidatorSet([Validator.new(atk.get_pub_key(), 5)])
+        hd = _header(h, cur_set, prev_id)
+        stub.add_height(hd, _commit_for(hd, cur_set, privs), cur_set)
+        prev_id = BlockID(hd.hash(), PartSetHeader(1, b"\x01" * 20))
+    return stub, genesis_set
+
+
+def test_advance_jumps_pruned_gap_same_set():
+    """Genesis trust against a source whose base is 8: the sequential
+    walk cannot fetch 1..7, but the trusted set's +2/3 signature on the
+    horizon commit carries trust across the gap directly."""
+    stub, genesis_set = _pruned_chain(12, base=8)
+    lc = LightClient(stub, CHAIN, genesis_set.copy())
+    lc.advance(12)
+    assert lc.height == 12
+    # exactly one failed probe below the base, then the jump
+    assert stub.commit_calls[0] == 1
+    assert 2 not in stub.commit_calls, "walk retried inside the pruned gap"
+    assert stub.commit_calls[1] == 8
+
+
+def test_advance_jumps_pruned_gap_with_overlapping_set_change():
+    """The set changed INSIDE the pruned gap but the old trusted set
+    still carries > 2/3 of its power on the horizon commit: rule (d)
+    transfers trust without the (unknowable) chain linkage."""
+    stub, genesis_set = _pruned_chain(12, base=8, change_at=5)
+    lc = LightClient(stub, CHAIN, genesis_set.copy())
+    lc.advance(12)
+    assert lc.height == 12
+    assert lc.validators.size() == 2
+
+
+def test_advance_rejects_forged_set_across_pruned_gap():
+    """A forged set past the pruned gap (zero old-set power on the
+    horizon commit) must NOT be adopted — lying about the prune horizon
+    weakens nothing."""
+    stub, genesis_set = _pruned_chain(12, base=8, change_at=5,
+                                      old_signs_transition=False)
+    lc = LightClient(stub, CHAIN, genesis_set.copy())
+    with pytest.raises(LightClientError, match="trusted set signed only"):
+        lc.advance(12)
+    assert lc.height == 0  # trust never moved
+
+
+def test_advance_reraises_when_no_pruned_gap_attested():
+    """A commit fetch failure WITHOUT a pruned-gap attestation (status
+    shows the height should exist) re-raises: real transport errors must
+    not silently skip verification."""
+    stub, genesis_set = _pruned_chain(12, base=1)
+
+    real_commit = stub.commit
+
+    def flaky(height):
+        if height == 3:
+            raise RuntimeError("connection reset")
+        return real_commit(height)
+
+    stub.commit = flaky
+    lc = LightClient(stub, CHAIN, genesis_set.copy())
+    with pytest.raises(RuntimeError, match="connection reset"):
+        lc.advance(12)
+    assert lc.height == 2  # trust stopped exactly before the failure

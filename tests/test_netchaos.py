@@ -915,3 +915,257 @@ def test_wan_soak_rss_flat_disk_bounded(tmp_path):
         net.assert_converged(min(net.heights()))
     finally:
         net.stop()
+
+
+# -- bounded-retention lifecycle (round 19, docs/state-sync.md § Retention) --
+
+
+@pytest.mark.slow
+def test_adversarial_statesync_offerers_under_wan(tmp_path, monkeypatch):
+    """The adversarial offerer matrix (round 19): a joining node's
+    restore faces a FORGED-manifest offerer (internally consistent
+    manifest whose header/app hashes contradict the verified chain), a
+    CORRUPT-chunk offerer (real manifest, flipped chunk bytes), and a
+    STALLING offerer (answers discovery + manifest, then goes silent on
+    chunks) — all under continental WAN shaping. The reactor must ban
+    each kind (scrape-visible statesync_offerer_bans_*) and complete
+    the restore from the honest offerers, landing byte-identical."""
+    from tests.netchaos_common import CHAIN_ID, hostile_offerer_matrix
+
+    # snapshot_interval LARGE and the idle cadence throttled so the
+    # honest offers stay pinned at one height for the whole restore
+    # (the picker takes max offered height; a producer racing new
+    # snapshots past the forged one would bypass the attack instead of
+    # defeating it — real networks snapshot hourly, the test preset
+    # commits 10+ heights/s)
+    net = ChaosNet(3, str(tmp_path / "advoff"), snapshot_interval=40,
+                   snapshot_chunk_size=1024, height_throttle_s=0.25)
+    net.start()
+    try:
+        # snapshot at 40 published; head comfortably past the forged
+        # height 41 so its light walk to 42 SUCCEEDS and the binding
+        # check (not a transient walk failure) is what kills it
+        assert net.wait_height(44, timeout=300), net.heights()
+        src = net.nodes[0]
+        h_s = max(src.snapshot_store.heights())
+        assert h_s == 40
+        honest = src.snapshot_store.load_manifest(h_s)
+        chunks = [
+            src.snapshot_store.load_chunk(h_s, i)
+            for i in range(honest.chunks)
+        ]
+        assert len(chunks) >= 4, "fixture needs several chunks to spread"
+
+        # restore knobs: small windows + short timeouts so the stalled
+        # windows cost seconds, and a 2-strike stall ban
+        monkeypatch.setenv("TENDERMINT_STATESYNC_WINDOW", "4")
+        monkeypatch.setenv("TENDERMINT_STATESYNC_CHUNK_TIMEOUT_S", "2")
+        monkeypatch.setenv("TENDERMINT_STATESYNC_STALL_BAN", "2")
+        monkeypatch.setenv("TENDERMINT_STATESYNC_DISCOVERY_S", "4")
+
+        net.apply_wan("continental", seed=19)
+        # dial ONE honest source: the hostile offerers then outnumber
+        # the honest side 3-to-1 (the acceptance bar's "restore
+        # completes from the honest MINORITY"), and every offerer of
+        # the honest height fits one request window so the staller is
+        # deterministically exercised
+        joiner = net.start_node(3, pv=None, statesync_from=[0], dial=[0])
+        # shape the joiner's fresh links too
+        net.apply_wan("continental", seed=19)
+        jport = joiner.listener.internal_address().port
+        offerers = hostile_offerer_matrix(
+            "127.0.0.1", jport, CHAIN_ID, honest, chunks
+        )
+        try:
+            assert wait_until(
+                lambda: joiner.block_store.base() > 1, timeout=240
+            ), (joiner.block_store.height(), joiner.block_store.base(),
+                joiner.statesync_reactor.stats())
+            assert wait_until(
+                lambda: joiner.block_store.height() >= 44, timeout=240
+            ), joiner.block_store.height()
+
+            # every adversary kind banned, visible on the flat scrape
+            m = joiner.telemetry.flatten()
+            assert m["statesync_offerer_bans_forged"] >= 1, m
+            assert m["statesync_offerer_bans_corrupt"] >= 1, m
+            assert m["statesync_offerer_bans_stall"] >= 1, m
+            assert m["statesync_offerers_banned"] >= 3, m
+            # ... and each hostile link was actually cut by the target
+            assert wait_until(
+                lambda: all(o.dropped() for o in offerers.values()),
+                timeout=30,
+            ), {k: o.dropped() for k, o in offerers.items()}
+
+            # the restore used the honest snapshot, not the forged height
+            assert joiner.block_store.base() == h_s
+            net.clear_wan()
+            top = min(
+                [n.block_store.height() for n in net.nodes[:3]]
+                + [joiner.block_store.height()]
+            )
+            for hh in range(h_s, top + 1):
+                want = src.block_store.load_block_meta(hh)
+                got = joiner.block_store.load_block_meta(hh)
+                assert got.block_id.key() == want.block_id.key(), hh
+        finally:
+            for o in offerers.values():
+                o.close()
+    finally:
+        net.stop()
+
+
+@pytest.mark.slow
+def test_laggard_below_horizon_auto_switches_to_statesync(tmp_path,
+                                                          monkeypatch):
+    """Horizon-aware catchup (round 19): a fresh node fast-syncing into
+    a PRUNED network — every peer's store base is above height 1 — has
+    no path back via block gossip. The pool detects that every serving
+    peer pruned its next height and the node auto-falls-back to
+    statesync (statesync.enable was FALSE; only rpc_servers were
+    configured), restores at a snapshot base, fast-syncs the tail, and
+    converges byte-identically instead of spinning on
+    no_block_response."""
+    # a small tree-version window so the statetree floor doesn't pin
+    # retention far above the operator target (kvstore keeps 64 by
+    # default; tree construction reads the knob at node boot)
+    monkeypatch.setenv("TENDERMINT_STATETREE_KEEP_VERSIONS", "8")
+    # snapshot lifetime engineering (netchaos_common.ChaosNet): keep 8
+    # snapshots and throttle the idle cadence, or the producers rotate
+    # snapshots out faster than any restore can fetch them
+    net = ChaosNet(3, str(tmp_path / "horizon"),
+                   snapshot_interval=8, snapshot_full_every=1,
+                   snapshot_chunk_size=2048, snapshot_keep=8,
+                   height_throttle_s=0.25,
+                   retain_blocks=10, prune_interval=5)
+    net.start()
+    try:
+        # run until every source PRUNED genesis away
+        assert net.wait_height(60, timeout=400), net.heights()
+        assert wait_until(
+            lambda: all(n.block_store.base() > 1 for n in net.nodes),
+            timeout=120,
+        ), [n.block_store.base() for n in net.nodes]
+
+        joiner = net.start_node(
+            3, pv=None, statesync_from=[0, 1], statesync_enable=False
+        )
+        # boot-time restore must NOT be armed: this is the runtime path
+        assert joiner.statesync_reactor.enabled is False
+
+        target = max(net.heights()) + 2
+        assert wait_until(
+            lambda: joiner.block_store.height() >= target, timeout=300
+        ), (joiner.block_store.height(), joiner.block_store.base(),
+            joiner.blockchain_reactor.below_horizon_fallbacks)
+
+        m = joiner.telemetry.flatten()
+        assert m["fastsync_below_horizon_fallbacks"] >= 1, m
+        assert joiner.block_store.base() > 1, (
+            "joiner fast-synced from genesis through a pruned net?!"
+        )
+        # byte identity over the range the joiner holds
+        top = min(n.block_store.height() for n in net.nodes[:3] + [joiner])
+        base = joiner.block_store.base()
+        for hh in range(base, top + 1):
+            want = net.nodes[0].block_store.load_block_meta(hh)
+            got = joiner.block_store.load_block_meta(hh)
+            assert got.block_id.key() == want.block_id.key(), hh
+            assert (
+                joiner.block_store.load_block(hh).header.app_hash
+                == net.nodes[0].block_store.load_block(hh).header.app_hash
+            ), hh
+    finally:
+        net.stop()
+
+
+@pytest.mark.slow
+def test_retention_soak_disk_bounded_and_rejoin(tmp_path, monkeypatch):
+    """The retention soak (round 19): a 4-node sqlite-backed net with
+    [pruning] armed and the statesync producer live commits
+    RETENTION_SOAK_HEIGHTS (default 300; the ROADMAP's full soak sets
+    10000) heights. Asserts per-node disk BOUNDED BY RETENTION rather
+    than chain length (steady-state bytes/height a small constant after
+    the pruning horizon engages), every store base advancing with the
+    head, prune + WAL-chunk accounting scrape-visible, a freshly WIPED
+    node re-joining via snapshot and tailing to byte-identical hashes,
+    and byte-identity across the fleet at the end. The SIGKILL-mid-prune
+    recovery claim is held by tests/test_retention.py's subprocess kill
+    test."""
+    target_heights = int(os.environ.get("RETENTION_SOAK_HEIGHTS", "300"))
+    monkeypatch.setenv("TENDERMINT_STATETREE_KEEP_VERSIONS", "24")
+    # small WAL chunks so rotation (and therefore WAL retention) is
+    # actually exercised at soak scale
+    monkeypatch.setenv("TENDERMINT_WAL_CHUNK_BYTES", "65536")
+    retain = 40
+    net = ChaosNet(4, str(tmp_path / "retsoak"), db_backend="sqlite",
+                   snapshot_interval=15, snapshot_full_every=1,
+                   snapshot_chunk_size=4096, snapshot_keep=6,
+                   height_throttle_s=0.1,
+                   retain_blocks=retain, prune_interval=10)
+    net.start()
+    try:
+        # warm up past the EQUILIBRIUM point, not merely first-prune:
+        # the deepest retention floor here is the snapshot window (6 x
+        # 15 = 90 heights), so the block stores keep absorbing new
+        # heights until the head is ~retention past it and sqlite's
+        # freed pages start recycling — measuring earlier reads archive-
+        # rate growth and calls it a retention failure
+        measure_from = max(2 * retain + 90, target_heights // 2)
+        assert net.wait_height(min(measure_from, target_heights),
+                               timeout=600), net.heights()
+        assert wait_until(
+            lambda: all(n.block_store.base() > 1 for n in net.nodes),
+            timeout=300,
+        ), [n.block_store.base() for n in net.nodes]
+        h1 = min(net.heights())
+        d1 = net.disk_bytes()
+
+        i = 0
+        while min(net.heights()) < target_heights:
+            net.broadcast_tx(f"ret-{i}=v{i}".encode(), via=i % 4)
+            i += 1
+            assert net.wait_height(
+                min(net.heights()) + 1, timeout=120
+            ), net.heights()
+        h2 = min(net.heights())
+        d2 = net.disk_bytes()
+
+        # disk bounded by retention: steady-state growth per height per
+        # NODE must be a small constant (sqlite reuses freed pages,
+        # snapshots rotate, WAL chunks prune) — NOT proportional to
+        # chain length (the pre-retention WAN soak budgeted 200 KiB per
+        # height per process and still grew linearly forever)
+        per_height_per_node = (d2 - d1) / max(1, h2 - h1) / len(net.nodes)
+        assert per_height_per_node < 30 * 1024, (
+            f"disk grows {per_height_per_node:.0f} B/height/node under "
+            f"pruning ({d1} -> {d2} over {h2 - h1} heights)"
+        )
+        for n in net.nodes:
+            m = n.telemetry.flatten()
+            head, base = n.block_store.height(), n.block_store.base()
+            assert m["blockstore_pruned_heights_total"] > 0, m
+            assert m["pruning_runs"] > 0, m
+            assert base > 1, (head, base)
+            # the base TRACKS the head: the deepest floor here is the
+            # snapshot window (keep 6 x interval 15 = 90 heights), plus
+            # interval granularity + prune-interval slack
+            assert head - base <= 90 + 15 + 10 + 15, (head, base)
+            assert m["wal_chunks_pruned"] > 0, {
+                k: v for k, v in m.items() if k.startswith("wal_")
+            }
+
+        # a wiped node re-joins via snapshot and tails byte-identically
+        h_before = max(net.heights())
+        node3 = net.restart_node(3, statesync_from=[0, 1], wipe=True)
+        assert net.wait_height(h_before + 2, timeout=120, nodes=[0, 1, 2])
+        assert wait_until(
+            lambda: node3.block_store.height() >= h_before + 2, timeout=300
+        ), (node3.block_store.height(), node3.block_store.base())
+        assert node3.block_store.base() > 1, (
+            "wiped node replayed from genesis instead of statesync"
+        )
+        top = min(n.block_store.height() for n in net.nodes)
+        net.assert_converged(top)  # from the highest base across nodes
+    finally:
+        net.stop()

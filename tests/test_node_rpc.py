@@ -257,8 +257,17 @@ METRICS_REQUIRED_KEYS = (
     "consensus_vote_singletons",
     # vote-gossip redundancy (round 17): the 2NxN before-number
     "consensus_vote_duplicates",
-    # block store
+    # block store (+ round-19 prune accounting)
     "blockstore_height", "blockstore_base",
+    "blockstore_pruned_heights_total", "blockstore_prune_runs",
+    # retention coordinator (round 19): enabled/target/runs, per-plane
+    # floors, per-plane disk gauges — stable whether or not [pruning]
+    # is armed
+    "pruning_enabled", "pruning_retain_blocks", "pruning_runs",
+    "pruning_pruned_heights", "pruning_wal_chunks_pruned",
+    "pruning_last_retain_height", "pruning_floor_operator",
+    "pruning_disk_blockstore_bytes", "pruning_disk_wal_bytes",
+    "pruning_disk_snapshots_bytes", "pruning_disk_total_bytes",
     # WAL durability plane (present once consensus started)
     "wal_format", "wal_records", "wal_fsyncs", "wal_pending",
     "wal_group_size", "wal_repairs", "wal_sync_age_s",
@@ -286,10 +295,15 @@ METRICS_REQUIRED_KEYS = (
     # fast sync
     "fastsync_active", "fastsync_blocks_synced",
     "fastsync_rate_blocks_per_sec", "fastsync_apply_s",
-    # statesync (reactor serves unconditionally)
+    # statesync (reactor serves unconditionally; round 19 adds the
+    # adversarial-offerer ban counters by proven kind)
     "statesync_restore_active", "statesync_snapshots",
     "statesync_chunks_served", "statesync_chunk_failures",
     "statesync_peers_banned", "statesync_load_failures",
+    "statesync_offerers_banned", "statesync_offerer_bans_forged",
+    "statesync_offerer_bans_corrupt", "statesync_offerer_bans_stall",
+    # horizon-aware catchup (round 19)
+    "fastsync_below_horizon_fallbacks",
     # gateway verify plane
     "gateway_verify_tpu_batches", "gateway_verify_tpu_sigs",
     "gateway_verify_cpu_sigs",
@@ -361,7 +375,17 @@ def test_prometheus_exposition_endpoint(node):
                 "p2p_adversary_flood_txs_rejected",
                 "netfaults_wan_delays_applied", "netfaults_wan_loss_stalls",
                 "netfaults_wan_bytes_shaped", "netfaults_wan_resets",
-                "netfaults_links"):
+                "netfaults_links",
+                # round 19: bounded-retention lifecycle + adversarial
+                # statesync offerer accounting + horizon-aware catchup
+                "blockstore_pruned_heights_total", "pruning_enabled",
+                "pruning_retain_blocks", "pruning_disk_total_bytes",
+                "pruning_floor_operator",
+                "statesync_offerers_banned",
+                "statesync_offerer_bans_forged",
+                "statesync_offerer_bans_corrupt",
+                "statesync_offerer_bans_stall",
+                "fastsync_below_horizon_fallbacks"):
         assert fam in families, fam
         assert families[fam] == "gauge"
     # round 18: the secret-connection transport counters, incl. the
