@@ -24,7 +24,7 @@ test:
 # hold (dots no worse than the seed) — plus the chip-free hash-stream
 # smoke (the two asserted BENCH_r07 rows: streamed hash offload >= 1.3x
 # single-shot on the sim transport, flat host builder >= 1.5x recursive).
-tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke wan-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke retention-smoke
+tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke wan-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke retention-smoke localnet-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Chip-free bench smoke: every BASELINE config on the pinned CPU backend,
@@ -164,6 +164,14 @@ txtrace-smoke:
 retention-smoke:
 	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_RETENTION_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_retention.py
 
+# — the hundreds-of-nodes localnet tier, smoke-sized: a 5-node fleet of
+# REAL node processes (ops/localnet) peered through netfaults link
+# proxies converges byte-identically and reports its duplicate-vote
+# ratio off live scrapes (~60 s; the 10/25/50-node scale ladder +
+# dedup A/B + process-scale partition-heal run on the full bench).
+localnet-smoke:
+	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_LOCALNET_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_localnet.py
+
 test_race:
 	$(PY) -m pytest tests/test_race.py -q
 
@@ -176,4 +184,4 @@ test_slow:
 native:
 	$(MAKE) -C native
 
-.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke wan-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke retention-smoke
+.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke wan-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke retention-smoke localnet-smoke

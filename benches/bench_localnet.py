@@ -1,0 +1,145 @@
+"""Localnet-at-scale bench (round 20): consensus cadence, duplicate-vote
+redundancy, and gossip bytes/height of a REAL PROCESS fleet vs node
+count (docs/localnet.md).
+
+Every prior multi-node bench ran nodes in-process (one interpreter, one
+GIL). This one boots `ops/localnet` fleets — real `tendermint_tpu.cli
+node` processes on loopback, peered through netfaults link proxies —
+and reads everything off the public scrape surface.
+
+Rows (full run):
+- scale:n=10 / n=25 / n=50: heights/s, fleet duplicate-vote ratio
+  (consensus_vote_duplicates / consensus_vote_accepted — the 2N*N
+  redundancy number the has-vote dedup engineers down), gossip
+  bytes/height, per-height byte-identity across ALL nodes. The 50-node
+  row runs under the `continental` WAN profile (seeded per-link
+  latency/loss/bandwidth) on a ring topology — the hundreds-of-nodes
+  shape on one box.
+- dedup_off:n=10: the SAME 10-node fleet with gossip_dedup=false (the
+  pre-round-20 gossip); the duplicate-vote ratio is asserted strictly
+  WORSE than the dedup-on row — the measurable the tentpole claims.
+- partition_heal:n=10: a netchaos-style fault at process scale — 1/3
+  minority severed, majority keeps committing, heal, full-fleet
+  byte-identity.
+
+Asserted floors (chip-free — this gates `make localnet-smoke` in tier1):
+- every fleet converges byte-identically (the scenario asserts it)
+- the duplicate-vote ratio is read from live scrapes (accepted > 0)
+- full run: dedup-on ratio < dedup-off ratio at n=10
+
+BENCH_LOCALNET_SMOKE=1 shrinks to one 5-node converge run (~60 s) for
+the tier-1 gate. Prints ONE JSON line like the other benches; writes
+BENCH_r20.json on full runs. Run from the repo root:
+python benches/bench_localnet.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+SMOKE = os.environ.get("BENCH_LOCALNET_SMOKE", "") == "1"
+# scale ladder is env-tunable so a crowded box can shrink it without
+# editing the bench
+SCALES = (
+    [(5, 3, "")]
+    if SMOKE
+    else [(10, 5, ""), (25, 4, ""), (50, 3, "continental")]
+)
+
+
+def main() -> None:
+    os.environ.setdefault("TENDERMINT_DEVD_SOCK", "/nonexistent/devd.sock")
+    os.environ.setdefault("TENDERMINT_TPU_PLATFORM", "cpu")
+
+    from tendermint_tpu.ops.localnet import LocalnetSpec, run_scenario
+
+    rows = []
+    port = 47400
+    ratio_at_10 = None
+
+    def spec_for(n: int, wan: str, dedup: bool = True) -> LocalnetSpec:
+        nonlocal port
+        root = tempfile.mkdtemp(prefix=f"bench-localnet-{n}-")
+        s = LocalnetSpec(
+            n=n, root=root, seed=20, base_port=port, wan=wan,
+            gossip_dedup=dedup,
+        )
+        # fleets run serially but TIME_WAIT lingers: each gets its own
+        # port range
+        port += 2 * n + 10
+        return s
+
+    # -- the scale ladder ---------------------------------------------------
+    for n, heights, wan in SCALES:
+        t0 = time.perf_counter()
+        r = run_scenario(spec_for(n, wan), "converge", heights=heights)
+        wall = time.perf_counter() - t0
+        assert r["converged_heights"] == heights, r
+        accepted_ratio = r["duplicate_vote_ratio"]
+        committed = max(r["final_heights"])
+        rows.append({
+            "mode": f"scale:n={n}" + (f":wan={wan}" if wan else ""),
+            "nodes": n,
+            "topology": r["topology"],
+            "heights_per_s": round(r["heights_per_s"], 3),
+            "duplicate_vote_ratio": round(accepted_ratio, 4),
+            "gossip_bytes_per_height": round(r["gossip_bytes"] / committed)
+            if committed else None,
+            "converged_heights": r["converged_heights"],
+            "wall_s": round(wall, 1),
+        })
+        if n == 10:
+            ratio_at_10 = accepted_ratio
+
+    if not SMOKE:
+        # -- dedup on-vs-off A/B at n=10 ------------------------------------
+        r = run_scenario(spec_for(10, "", dedup=False), "converge", heights=5)
+        off_ratio = r["duplicate_vote_ratio"]
+        assert ratio_at_10 is not None
+        assert ratio_at_10 < off_ratio, (
+            f"has-vote dedup did not reduce duplicate votes: "
+            f"on={ratio_at_10:.4f} vs off={off_ratio:.4f}"
+        )
+        rows.append({
+            "mode": "dedup_ab:n=10",
+            "ratio_dedup_on": round(ratio_at_10, 4),
+            "ratio_dedup_off": round(off_ratio, 4),
+            "reduction": round(1 - ratio_at_10 / off_ratio, 3)
+            if off_ratio else None,
+        })
+
+        # -- a netchaos fault at process scale ------------------------------
+        t0 = time.perf_counter()
+        r = run_scenario(spec_for(10, ""), "partition_heal", heights=2)
+        rows.append({
+            "mode": "partition_heal:n=10",
+            "healed_to_height": r["heights"],
+            "minority_frozen_at": r["minority_frozen_at"],
+            "converged_heights": r["converged_heights"],
+            "wall_s": round(time.perf_counter() - t0, 1),
+        })
+
+    record = {
+        "bench": "localnet",
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": "cpu",
+        "smoke": SMOKE,
+        "cores": os.cpu_count(),
+        "rows": rows,
+    }
+    if not SMOKE:
+        with open(os.path.join(ROOT, "BENCH_r20.json"), "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

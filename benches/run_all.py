@@ -83,6 +83,13 @@ Configs (BASELINE.md):
                   statesync offerer ban latency (forged / corrupt /
                   stalling each banned while the restore completes from
                   the honest source; writes BENCH_r19.json; chip-free)
+ 20 localnet     — hundreds-of-nodes process tier: 10/25/50 real node
+                  processes (ops/localnet through netfaults proxies,
+                  50 under the continental WAN profile on a ring);
+                  heights/s, duplicate-vote ratio, gossip bytes/height
+                  vs node count; has-vote dedup A/B at n=10 asserted
+                  to reduce the ratio; process-scale partition-heal
+                  (writes BENCH_r20.json; chip-free)
  13 statetree    — authenticated app-state commitment: incremental
                   commit vs full tree rebuild, proof correctness rows,
                   delta-vs-full snapshot bytes (delta asserted <= 0.5x
@@ -125,6 +132,7 @@ BENCHES = {
     "17_txtrace": [sys.executable, "benches/bench_txtrace.py"],
     "18_wan": [sys.executable, "benches/bench_wan.py"],
     "19_retention": [sys.executable, "benches/bench_retention.py"],
+    "20_localnet": [sys.executable, "benches/bench_localnet.py"],
 }
 
 

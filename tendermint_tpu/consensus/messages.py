@@ -217,6 +217,33 @@ class HasVoteMessage:
         )
 
 
+@register("has_block_part")
+@dataclass
+class HasBlockPartMessage:
+    """Tells peers our proposal part-set gained a part (beyond
+    reference): the round-20 part-gossip dedup screen. A node that just
+    assembled part `index` announces it on the STATE channel so every
+    OTHER peer's mirror marks the bit and its gossip_data loop skips
+    re-sending a part the node already holds — without this, k peers
+    holding a part all race to push it and k-1 copies are pure
+    redundancy (the part-set analogue of the 2NxN vote problem)."""
+
+    height: int
+    round_: int
+    index: int
+
+    def to_json(self):
+        return {"height": self.height, "round": self.round_, "index": self.index}
+
+    @classmethod
+    def from_json(cls, o):
+        return cls(
+            _int_field(o, "height", 0, _MAX_HEIGHT),
+            _int_field(o, "round", 0, _MAX_ROUND),
+            _int_field(o, "index", 0, _MAX_INDEX),
+        )
+
+
 @register("vote_set_maj23")
 @dataclass
 class VoteSetMaj23Message:

@@ -36,6 +36,14 @@ VOTE_SET_BITS_CHANNEL = 0x23
 
 PEER_GOSSIP_SLEEP = 0.1  # reactor.go peerGossipSleepDuration
 PEER_QUERY_MAJ23_SLEEP = 2.0
+# lazy-relay hold (round 20, gossip_dedup): a vote we RECEIVED moments
+# ago is being fanned out by its origin right now, and every recipient
+# announces it via HasVote within the same window — re-pushing it
+# immediately is how k relayers race each other into the 2NxN
+# redundancy. One gossip tick is enough for those announcements to set
+# the mirror bit (ms on loopback, ~one link RTT under WAN); after it,
+# anything still unmarked is genuinely needed and relays normally.
+VOTE_RELAY_DELAY = PEER_GOSSIP_SLEEP
 
 PEER_STATE_KEY = "ConsensusReactor.peerState"
 
@@ -144,11 +152,17 @@ class PeerState:
 
     # -- votes -------------------------------------------------------------
 
-    def set_has_vote(self, height: int, round_: int, type_: int, index: int) -> None:
+    def set_has_vote(self, height: int, round_: int, type_: int, index: int) -> bool:
+        """Mark the peer as holding a vote. Returns True when a tracking
+        array existed and the bit landed — False means the coordinates
+        matched no array (wrong height/round for this mirror) and the
+        information was dropped."""
         with self._mtx:
             ba = self._get_vote_bit_array(height, round_, type_)
             if ba is not None:
                 ba.set_index(index, True)
+                return True
+            return False
 
     def _get_vote_bit_array(self, height: int, round_: int, type_: int) -> BitArray | None:
         """reactor.go:813-850 — except the round-equal branch must not
@@ -287,11 +301,22 @@ class PeerState:
             prs.proposal_block_parts_header = msg.block_parts_header
             prs.proposal_block_parts = msg.block_parts
 
-    def apply_has_vote(self, msg: msgs.HasVoteMessage) -> None:
+    def apply_has_vote(self, msg: msgs.HasVoteMessage,
+                       allow_last_commit: bool = False) -> bool:
+        """Feed a HasVote announcement into the mirror. The strict gate
+        (peer height only) is the pre-round-20 behavior; with
+        allow_last_commit (the gossip_dedup knob) a HasVote for the
+        height BELOW the peer's also lands — _get_vote_bit_array routes
+        it to the last_commit array, which is exactly the height a node
+        keeps broadcasting HasVotes for right after committing (those
+        announcements were silently dropped before, so the laggard's
+        commit votes kept being re-pushed by everyone)."""
         with self._mtx:
-            if self.prs.height != msg.height:
-                return
-        self.set_has_vote(msg.height, msg.round_, msg.type_, msg.index)
+            if self.prs.height != msg.height and not (
+                allow_last_commit and self.prs.height == msg.height + 1
+            ):
+                return False
+        return self.set_has_vote(msg.height, msg.round_, msg.type_, msg.index)
 
     def apply_vote_set_bits(self, msg: msgs.VoteSetBitsMessage, our_votes: BitArray | None) -> None:
         """reactor.go:1126-1149. ourVotes is a MASK of what we know we
@@ -317,6 +342,22 @@ class ConsensusReactor(Reactor, BaseService):
         self._peer_threads: dict[str, list] = {}
         self._peer_stops: dict[str, threading.Event] = {}
         self._mtx = threading.Lock()
+        # has-vote-aware gossip dedup (round 20): when on, STATE-channel
+        # HasVotes ensure the tracking arrays before applying (a fresh
+        # height's first announcement window was silently dropped
+        # before), last-commit-height HasVotes land, local part adds
+        # broadcast HasBlockPart screens, and the vote pick loops hold
+        # re-pushes of just-received votes for one gossip tick so the
+        # announcements can set the mirror bits first (_relay_ready).
+        # Off restores the pre-round-20 gossip for the before/after
+        # bench.
+        self.gossip_dedup = bool(
+            getattr(consensus_state.config, "gossip_dedup", True)
+        )
+        # flat dedup accounting (consensus_gossip_* on both surfaces)
+        self.has_votes_applied = 0
+        self.part_announces_sent = 0
+        self.part_announces_applied = 0
 
     # -- wiring ------------------------------------------------------------
 
@@ -328,6 +369,11 @@ class ConsensusReactor(Reactor, BaseService):
         )
         evsw.add_listener_for_event(
             "conR", tev.EVENT_VOTE, lambda d: self._broadcast_has_vote(d.vote)
+        )
+        evsw.add_listener_for_event(
+            "conR",
+            tev.EVENT_PROPOSAL_BLOCK_PART,
+            lambda d: self._broadcast_has_part(d),
         )
         evsw.add_listener_for_event(
             "conR",
@@ -422,7 +468,27 @@ class ConsensusReactor(Reactor, BaseService):
             elif isinstance(msg, msgs.CommitStepMessage):
                 ps.apply_commit_step(msg)
             elif isinstance(msg, msgs.HasVoteMessage):
-                ps.apply_has_vote(msg)
+                if self.gossip_dedup:
+                    # ensure the tracking arrays BEFORE applying — at a
+                    # fresh height the mirror has none yet, and every
+                    # HasVote in that first window used to vanish into
+                    # the set_has_vote no-op (the biggest single source
+                    # of the 2NxN duplicate pushes: peers kept picking
+                    # votes the neighbor had announced long ago)
+                    rs = self.con_s.get_round_state()
+                    size = rs.validators.size() if rs.validators else 0
+                    last_size = rs.last_commit.size() if rs.last_commit else 0
+                    ps.ensure_vote_bit_arrays(rs.height, size)
+                    ps.ensure_vote_bit_arrays(rs.height - 1, last_size)
+                if ps.apply_has_vote(msg, allow_last_commit=self.gossip_dedup):
+                    self.has_votes_applied += 1
+            elif isinstance(msg, msgs.HasBlockPartMessage):
+                # round 20 part dedup screen: the peer announced a part
+                # it holds — mark the mirror so gossip_data skips it
+                # (applied regardless of our own knob: the information
+                # is free and only ever REDUCES redundant sends)
+                ps.set_has_proposal_block_part(msg.height, msg.round_, msg.index)
+                self.part_announces_applied += 1
             elif isinstance(msg, msgs.ProposalHeartbeatMessage):
                 self.con_s._fire(
                     tev.EVENT_PROPOSAL_HEARTBEAT,
@@ -570,6 +636,23 @@ class ConsensusReactor(Reactor, BaseService):
         )
         self.switch.broadcast(STATE_CHANNEL, _enc(msg))
 
+    def _broadcast_has_part(self, data) -> None:
+        """Round 20: a part landed in OUR part-set — announce it so
+        peers' mirrors mark the bit and their gossip_data loops stop
+        picking it for us. try_send like the maj23 path: a full STATE
+        queue drops the announcement (the part relay itself still dedups
+        the hard way), it must never block the consensus thread firing
+        the event."""
+        if not self.gossip_dedup:
+            return
+        if not hasattr(self, "switch") or self.switch is None:
+            return
+        msg = msgs.HasBlockPartMessage(
+            height=data.height, round_=data.round_, index=data.index
+        )
+        self.switch.broadcast(STATE_CHANNEL, _enc(msg))
+        self.part_announces_sent += 1
+
     def _broadcast_heartbeat(self, heartbeat) -> None:
         if not hasattr(self, "switch") or self.switch is None:
             return
@@ -709,6 +792,19 @@ class ConsensusReactor(Reactor, BaseService):
             fr.record("gossip_send_fail", peer=_peer_label(peer))
         return False
 
+    def _relay_ready(self, vote) -> bool:
+        """The lazy-relay screen: hold re-pushes of a vote we received
+        less than VOTE_RELAY_DELAY ago (see the constant). Unstamped
+        votes — our own, and store-backed catchup commits — relay
+        immediately; a held vote stays pickable and goes out on a later
+        tick if the peer's mirror bit is still clear then."""
+        if not self.gossip_dedup:
+            return True
+        t = self.con_s.vote_recv_mono.get(
+            (vote.height, vote.round_, vote.type_, vote.validator_index)
+        )
+        return t is None or time.monotonic() - t >= VOTE_RELAY_DELAY
+
     def _pick_and_send_vote(self, peer, ps: PeerState, rs, prs: PeerRoundState) -> bool:
         """One needed vote, if any (reactor.go:609-645 gossipVotesForHeight
         + same-height/lastCommit/catchup cases)."""
@@ -719,22 +815,22 @@ class ConsensusReactor(Reactor, BaseService):
                prs.round_ <= rs.round_ and prs.proposal_pol_round != -1:
                 pol = rs.votes.prevotes(prs.proposal_pol_round)
                 vote = ps.pick_vote_to_send(pol) if pol else None
-                if vote is not None:
+                if vote is not None and self._relay_ready(vote):
                     return self._send_vote(peer, ps, vote)
             if prs.step <= RoundStep.PREVOTE_WAIT and prs.round_ != -1 and \
                prs.round_ <= rs.round_:
                 vote = ps.pick_vote_to_send(rs.votes.prevotes(prs.round_))
-                if vote is not None:
+                if vote is not None and self._relay_ready(vote):
                     return self._send_vote(peer, ps, vote)
             if prs.step <= RoundStep.PRECOMMIT_WAIT and prs.round_ != -1 and \
                prs.round_ <= rs.round_:
                 vote = ps.pick_vote_to_send(rs.votes.precommits(prs.round_))
-                if vote is not None:
+                if vote is not None and self._relay_ready(vote):
                     return self._send_vote(peer, ps, vote)
             if prs.proposal_pol_round != -1:
                 pol = rs.votes.prevotes(prs.proposal_pol_round)
                 vote = ps.pick_vote_to_send(pol) if pol else None
-                if vote is not None:
+                if vote is not None and self._relay_ready(vote):
                     return self._send_vote(peer, ps, vote)
         # peer is at our last height: send from our last commit. The
         # peer's CURRENT round usually raced past the commit round (it
@@ -754,7 +850,7 @@ class ConsensusReactor(Reactor, BaseService):
                 )
                 prs = ps.get_round_state()
             vote = ps.pick_vote_to_send(rs.last_commit)
-            if vote is not None:
+            if vote is not None and self._relay_ready(vote):
                 return self._send_vote(peer, ps, vote)
         # peer is far behind: catch up with the stored seen-commit
         if rs.height >= prs.height + 2 and prs.height > 0:

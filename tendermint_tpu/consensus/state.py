@@ -150,6 +150,18 @@ class ConsensusState(BaseService):
         # redundancy number the queued dedup PR needs a before for
         # (per-peer attribution rides p2p_peer_vote_duplicates_total)
         self.vote_duplicates = 0
+        # gossiped votes genuinely ADDED (round 20): the denominator of
+        # the duplicate-vote ratio duplicates/accepted that BENCH_r20
+        # reads off scrapes — own re-delivered votes stay uncounted like
+        # the duplicate side
+        self.vote_accepted = 0
+        # when each gossiped vote was ACCEPTED, by coordinates (round
+        # 20): the reactor's lazy-relay screen holds re-pushes of a
+        # just-received vote for one gossip tick so the origin's own
+        # fan-out + the recipients' HasVote announcements win the race
+        # (reactor._relay_ready). Own votes are never stamped — they
+        # relay immediately.
+        self.vote_recv_mono: dict[tuple, float] = {}
 
         # pipelined execution plane (round 14): stage-2 (apply) rides an
         # ordered executor; the consensus thread holds at most ONE
@@ -1474,6 +1486,13 @@ class ConsensusState(BaseService):
             # cross-node spread of this instant IS the proposer->peer
             # propagation lag (mark_arrival keeps the first only)
             self.trace.mark_arrival("first_block_part")
+            # round 20: announce the part so peers stop re-sending it —
+            # the reactor broadcasts a HasBlockPart off this event (the
+            # part-set analogue of the EVENT_VOTE -> HasVote broadcast)
+            self._fire(
+                tev.EVENT_PROPOSAL_BLOCK_PART,
+                tev.EventDataBlockPart(height, rs.round_, part.index),
+            )
         if added and rs.proposal_block_parts.is_complete():
             block_bytes = rs.proposal_block_parts.get_data()
             rs.proposal_block = Block.from_bytes(block_bytes)
@@ -1625,7 +1644,26 @@ class ConsensusState(BaseService):
             if peer_id:
                 self._note_vote_duplicate(peer_id)
             return False  # exact duplicate (add_vote's False)
-        return pending.commit(self.vote_batcher.verdict(pending.item()))
+        added = pending.commit(self.vote_batcher.verdict(pending.item()))
+        if added and peer_id:
+            self.vote_accepted += 1
+            self._stamp_vote_recv(vote)
+        return added
+
+    def _stamp_vote_recv(self, vote: Vote) -> None:
+        """Record when a gossiped vote landed (the reactor's lazy-relay
+        screen reads it). Bounded: entries only matter for one gossip
+        tick, so on overflow everything older than a couple seconds is
+        dropped in one sweep."""
+        now = time.monotonic()
+        self.vote_recv_mono[
+            (vote.height, vote.round_, vote.type_, vote.validator_index)
+        ] = now
+        if len(self.vote_recv_mono) > 4096:
+            cutoff = now - 2.0
+            self.vote_recv_mono = {
+                k: t for k, t in self.vote_recv_mono.items() if t >= cutoff
+            }
 
     def _note_vote_duplicate(self, peer_id: str) -> None:
         """Count one already-seen gossiped vote: the flat gauge, the

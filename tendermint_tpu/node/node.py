@@ -301,6 +301,7 @@ class Node(BaseService):
             wal_fn=lambda: self.consensus_state.wal,
             evidence_pool=self.consensus_state.evidence_pool,
             tree_app=self.app_state_tree_app,
+            tx_indexer=self.tx_indexer,
             db_dir=config.base.db_dir(),
             wal_dir=os.path.dirname(config.consensus.wal_file()),
             snapshot_dir=sc.snapshot_dir(),
@@ -522,14 +523,29 @@ class Node(BaseService):
         vs = ValidatorSet(
             [Validator.new(v.pub_key, v.power) for v in genesis_doc.validators]
         )
+        trust_height = sc.trust_height
+        trusted_header = None
+        # round 20: resume from the deepest trust this home ever verified
+        # — a prior restore's persisted anchor beats the configured pin
+        # (never the other way: an operator pin ABOVE the anchor wins)
+        from tendermint_tpu.node.light_anchor import load_anchor
+
+        anchor = load_anchor(self.config.base.root_dir, genesis_doc.chain_id)
+        if anchor is not None and anchor[0] > trust_height:
+            trust_height, vs, trusted_header = anchor
+            logger.info(
+                "light client resuming from persisted trust anchor at "
+                "height %d", trust_height,
+            )
         clients = [HTTPClient(s) for s in servers]
         light_client = LightClient(
             clients[0] if len(clients) == 1 else _FailoverRPC(clients),
             genesis_doc.chain_id,
             vs,
-            trusted_height=sc.trust_height,
+            trusted_height=trust_height,
             batch_verifier=self.verifier.commit_batch_verifier(),
         )
+        light_client._trusted_header = trusted_header
         return Restorer(
             genesis_doc,
             local_app,
@@ -550,6 +566,19 @@ class Node(BaseService):
             # seeds it with the fast-synced state, which now starts at
             # the restored height
             self.state = restored_state
+            # round 20: the restorer's adopted walker holds the deepest
+            # verified trust this home has ever reached — persist it so
+            # a wipe-and-restore restart resumes there instead of
+            # re-walking (and re-trusting) from the configured pin
+            from tendermint_tpu.node.light_anchor import save_anchor
+
+            restorer = getattr(self.statesync_reactor, "restorer", None)
+            lc = getattr(restorer, "light_client", None)
+            if lc is not None and save_anchor(self.config.base.root_dir, lc):
+                logger.info(
+                    "persisted light-client trust anchor at height %d",
+                    lc.height,
+                )
             logger.info(
                 "statesync restore complete at height %d; fast-syncing the tail",
                 restored_state.last_block_height,

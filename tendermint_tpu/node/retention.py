@@ -84,6 +84,7 @@ class RetentionCoordinator:
         wal_fn=None,
         evidence_pool=None,
         tree_app=None,
+        tx_indexer=None,
         db_dir: str = "",
         wal_dir: str = "",
         snapshot_dir: str = "",
@@ -91,7 +92,9 @@ class RetentionCoordinator:
         """cfg is a config.PruningConfig. wal_fn() returns the consensus
         WAL (None before consensus starts). tree_app is the in-process
         app carrying a VersionedTree, or None — read per run, since a
-        statesync restore rebinds app.tree."""
+        statesync restore rebinds app.tree. tx_indexer is the kv tx
+        index (round 20: the last per-height disk term on a pruned
+        node), pruned on the same pass; Null/absent indexers no-op."""
         from tendermint_tpu.libs.envknob import env_number
 
         self.enabled = cfg.retain_blocks > 0
@@ -106,6 +109,7 @@ class RetentionCoordinator:
         self.wal_fn = wal_fn
         self.evidence_pool = evidence_pool
         self.tree_app = tree_app
+        self.tx_indexer = tx_indexer
         self._db_dir = db_dir
         self._wal_dir = wal_dir
         self._snapshot_dir = snapshot_dir
@@ -114,6 +118,7 @@ class RetentionCoordinator:
         self.runs = 0
         self.pruned_heights = 0
         self.wal_chunks_pruned = 0
+        self.tx_index_pruned = 0
         self.last_retain_height = 0
         self.prune_failures = 0
         self._last_floors: dict[str, int] = {}
@@ -187,14 +192,19 @@ class RetentionCoordinator:
         wal_pruned = 0
         if wal is not None and hasattr(wal, "prune_to"):
             wal_pruned = wal.prune_to(safe)
+        tx_pruned = 0
+        if self.tx_indexer is not None and hasattr(self.tx_indexer, "prune_to"):
+            tx_pruned = self.tx_indexer.prune_to(safe)
         self.runs += 1
         self.pruned_heights += pruned
         self.wal_chunks_pruned += wal_pruned
+        self.tx_index_pruned += tx_pruned
         self.last_retain_height = max(self.last_retain_height, safe)
-        if pruned or wal_pruned:
+        if pruned or wal_pruned or tx_pruned:
             logger.info(
-                "retention: pruned %d height(s) + %d WAL chunk(s) below %d "
-                "(floors: %s)", pruned, wal_pruned, safe,
+                "retention: pruned %d height(s) + %d WAL chunk(s) + %d "
+                "indexed tx(s) below %d "
+                "(floors: %s)", pruned, wal_pruned, tx_pruned, safe,
                 {k: v for k, v in sorted(floors.items())},
             )
         return pruned
@@ -215,6 +225,9 @@ class RetentionCoordinator:
             "disk_blockstore_bytes": dir_bytes(
                 self._db_dir, prefix="blockstore."
             ),
+            "disk_txindex_bytes": dir_bytes(
+                self._db_dir, prefix="tx_index."
+            ),
             "disk_wal_bytes": dir_bytes(self._wal_dir),
             "disk_snapshots_bytes": dir_bytes(self._snapshot_dir),
         }
@@ -230,6 +243,7 @@ class RetentionCoordinator:
             "runs": self.runs,
             "pruned_heights": self.pruned_heights,
             "wal_chunks_pruned": self.wal_chunks_pruned,
+            "tx_index_pruned": self.tx_index_pruned,
             "last_retain_height": self.last_retain_height,
             "prune_failures": self.prune_failures,
         }

@@ -140,6 +140,18 @@ def build_registry(node) -> telemetry.Registry:
             # 2NxN redundancy before-number for the gossip-dedup work
             # (per-peer attribution: p2p_peer_vote_duplicates_total)
             "vote_duplicates": cs.vote_duplicates,
+            # round 20: gossiped votes genuinely added — the ratio
+            # vote_duplicates/vote_accepted is the duplicate-vote ratio
+            # BENCH_r20 reads off scrapes — plus the dedup plane's own
+            # accounting: HasVotes that landed in a peer mirror, and
+            # HasBlockPart announcements sent/applied
+            "vote_accepted": cs.vote_accepted,
+            "gossip_has_votes_applied":
+                node.consensus_reactor.has_votes_applied,
+            "gossip_part_announces_sent":
+                node.consensus_reactor.part_announces_sent,
+            "gossip_part_announces_applied":
+                node.consensus_reactor.part_announces_applied,
         }
 
     reg.register_producer("consensus", consensus)

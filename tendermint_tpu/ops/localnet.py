@@ -1,0 +1,627 @@
+"""Hundreds-of-nodes localnet tier (round 20, docs/localnet.md).
+
+The netchaos harness (tests/netchaos_common.py) runs N full nodes
+IN-PROCESS — perfect for white-box assertions, but every node shares
+one interpreter, one GIL, one crash domain. This module is the same
+scenario vocabulary one tier up: N real node PROCESSES (the existing
+CLI node, `python -m tendermint_tpu.cli node`) on loopback, each with
+its own home/keys/DBs/WAL, peered through `ops/netfaults` LinkProxy
+relays so the WHOLE chaos vocabulary — partitions, seeded WAN profiles,
+geo-cluster topologies, rolling restarts — applies unchanged to a
+process fleet. Everything is read back through the public scrape
+surface (`ops/fleet`: GET /metrics + /health + consensus_trace), never
+by reaching into harness objects: what a scenario asserts here is what
+an operator of a real deployment could assert.
+
+One seeded `LocalnetSpec` generates the entire net: N homes under one
+root (privval keys derived from `(chain_id, index)`, one shared
+genesis, per-home config.toml written through the real TOML round-trip
+so the CLI node loads EXACTLY what a production home would carry).
+Ports are explicit (`base_port + 2i` p2p, `+2i+1` RPC) — the fabric's
+links can be strung before any process exists.
+
+Topology is part of the spec, because a single box cannot carry a
+50-node FULL mesh (1225 proxied links ≈ 5k fds and 2.5k relay
+threads): `full` (node i dials every j < i — the netchaos shape,
+default up to 16 nodes), `ring` (i dials (i+1..i+k) mod n — bounded
+degree, diameter n/2k; the default beyond 16), `star` (everyone dials
+node 0 — the seeds-node shape). Every directed dial edge gets its own
+LinkProxy, so group chaos maps exactly as in the in-process tier.
+
+Scheduling reality check: the nodes are Python processes sharing this
+box's cores. The consensus timeout schedule baked into each config.toml
+scales with fleet size (a 50-process net on few cores needs wider
+propose windows than a 4-process one) and with the WAN profile (the
+netchaos lesson: a 100 ms propose window can never cover a 40-90 ms
+per-chunk link). Baked in — not mutated live — because these are real
+processes: there is no shared config object to poke.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+from dataclasses import dataclass, field
+
+from tendermint_tpu.ops import fleet
+from tendermint_tpu.ops.netfaults import NetFabric, geo_clusters, wan_profile
+
+logger = logging.getLogger("ops.localnet")
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# dial-degree ceiling where full mesh hands over to the ring (links grow
+# O(n^2) vs O(n*k); at 16 the mesh is 120 links — still one box's worth)
+FULL_MESH_MAX = 16
+DEFAULT_RING_K = 4
+
+
+@dataclass
+class LocalnetSpec:
+    """Everything that defines one localnet, seeded: two runs from the
+    same spec generate identical keys, genesis (bar the timestamp),
+    configs, and link fabric."""
+
+    n: int = 4
+    root: str = ""
+    chain_id: str = "localnet"
+    seed: int = 0
+    # full | ring | star | "" (auto: full up to FULL_MESH_MAX, then ring)
+    topology: str = ""
+    ring_k: int = DEFAULT_RING_K
+    base_port: int = 47100
+    proxy_app: str = "kvstore"
+    db_backend: str = "memdb"
+    tx_index: str = "kv"
+    gossip_dedup: bool = True
+    # netfaults WAN profile name baked into the timeout schedule and
+    # applied to every link at start ("" = clean loopback)
+    wan: str = ""
+    # >0: geo-cluster net (k clusters, lan inside / `wan` — or
+    # intercontinental — between)
+    geo: int = 0
+    log_level: str = "error"
+    # commit pacing: real timeout_commit (not the test preset's skipped
+    # one) so the fleet's skew/byte-per-height readouts are meaningful
+    timeout_commit: float = 0.1
+    extra_args: list = field(default_factory=list)
+
+    def resolved_topology(self) -> str:
+        if self.topology:
+            return self.topology
+        return "full" if self.n <= FULL_MESH_MAX else "ring"
+
+    def p2p_port(self, i: int) -> int:
+        return self.base_port + 2 * i
+
+    def rpc_port(self, i: int) -> int:
+        return self.base_port + 2 * i + 1
+
+    def home(self, i: int) -> str:
+        return os.path.join(self.root, f"node{i}")
+
+    def dial_edges(self) -> list[tuple[int, int]]:
+        """The directed dial edges (i dials j) of the topology. One
+        direction per pair everywhere — inbound/outbound dedup never
+        races, exactly the netchaos invariant."""
+        topo = self.resolved_topology()
+        n = self.n
+        if topo == "full":
+            return [(i, j) for i in range(n) for j in range(i)]
+        if topo == "star":
+            return [(i, 0) for i in range(1, n)]
+        if topo == "ring":
+            k = max(1, min(self.ring_k, n - 1))
+            edges = set()
+            for i in range(n):
+                for d in range(1, k + 1):
+                    j = (i + d) % n
+                    if (j, i) not in edges and i != j:
+                        edges.add((i, j))
+            return sorted(edges)
+        raise ValueError(
+            f"unknown topology {topo!r}; known: full, ring, star"
+        )
+
+    def consensus_timeouts(self) -> dict:
+        """The schedule baked into every config.toml: sized for N
+        Python processes sharing this box's cores, floored for the WAN
+        profile when one is armed (the netchaos _WAN_TIMEOUT_FLOOR
+        lesson, applied at generation time because processes can't be
+        poked live)."""
+        cores = os.cpu_count() or 1
+        # how oversubscribed the box is: 50 processes on 1 core need
+        # ~their whole schedule stretched; 4 on 8 cores need nothing
+        crowd = max(1.0, self.n / max(cores, 1) / 4.0)
+        t = {
+            "timeout_propose": 0.5 * crowd,
+            "timeout_propose_delta": 0.25,
+            "timeout_prevote": 0.1 * crowd,
+            "timeout_prevote_delta": 0.1,
+            "timeout_precommit": 0.1 * crowd,
+            "timeout_precommit_delta": 0.1,
+            "timeout_commit": self.timeout_commit,
+        }
+        heavy = self.wan and wan_profile(self.wan).name != "lan"
+        if heavy or self.geo > 0:
+            floors = {
+                "timeout_propose": 1.0, "timeout_propose_delta": 0.25,
+                "timeout_prevote": 0.4, "timeout_prevote_delta": 0.2,
+                "timeout_precommit": 0.4, "timeout_precommit_delta": 0.2,
+            }
+            for k, floor in floors.items():
+                t[k] = max(t[k], floor)
+        return t
+
+
+class LocalNode:
+    """One node process of the fleet. RPC/metrics via loopback HTTP —
+    the same surface ops/fleet scrapes."""
+
+    def __init__(self, spec: LocalnetSpec, index: int):
+        self.spec = spec
+        self.index = index
+        self.home = spec.home(index)
+        self.p2p_port = spec.p2p_port(index)
+        self.rpc_port = spec.rpc_port(index)
+        self.proc: subprocess.Popen | None = None
+
+    @property
+    def rpc_url(self) -> str:
+        return f"127.0.0.1:{self.rpc_port}"
+
+    def start(self, seeds: str = "") -> None:
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.setdefault("TENDERMINT_TPU_DISABLE", "1")
+        # never probe a live devd daemon from a fleet member: 50 nodes
+        # hammering one accelerator socket is not this tier's scenario
+        env.setdefault("TENDERMINT_DEVD_SOCK", "/nonexistent/devd.sock")
+        # tight reconnect cadence (the netchaos value): a healed
+        # partition must re-peer in ~a second, and a rolling restart's
+        # peers must survive the whole outage window
+        env.setdefault("TENDERMINT_P2P_RECONNECT_INTERVAL_S", "0.5")
+        env.setdefault("TENDERMINT_P2P_RECONNECT_ATTEMPTS", "600")
+        env["PYTHONPATH"] = REPO
+        cmd = [
+            sys.executable, "-m", "tendermint_tpu.cli",
+            "--home", self.home, "node",
+            "--p2p.laddr", f"tcp://127.0.0.1:{self.p2p_port}",
+            "--rpc.laddr", f"tcp://127.0.0.1:{self.rpc_port}",
+            "--p2p.addr_book_strict", "false",
+            "--log_level", self.spec.log_level,
+        ]
+        if seeds:
+            cmd += ["--seeds", seeds]
+        cmd += list(self.spec.extra_args)
+        self.proc = subprocess.Popen(
+            cmd, cwd=REPO, env=env,
+            stdout=open(os.path.join(self.home, "node.log"), "ab"),
+            stderr=subprocess.STDOUT,
+        )
+
+    def rpc(self, method: str, params: dict | None = None,
+            timeout: float = 10.0):
+        body = json.dumps({
+            "jsonrpc": "2.0", "id": "localnet", "method": method,
+            "params": params or {},
+        }).encode()
+        req = urllib.request.Request(
+            f"http://{self.rpc_url}/", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            out = json.loads(resp.read())
+        if out.get("error"):
+            raise RuntimeError(f"node{self.index} {method}: {out['error']}")
+        return out["result"]
+
+    def height(self) -> int:
+        try:
+            return int(self.rpc("status", timeout=5)["latest_block_height"])
+        except Exception:  # noqa: BLE001 — down/starting counts as -1
+            return -1
+
+    def metrics(self) -> dict:
+        return fleet.fetch_metrics(self.rpc_url)
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill(self, sig=signal.SIGTERM) -> None:
+        if self.proc is None:
+            return
+        try:
+            self.proc.send_signal(sig)
+            self.proc.wait(timeout=15)
+        except Exception:  # noqa: BLE001 — a wedged shutdown escalates:
+            # dropping the handle would orphan a process on bound ports
+            try:
+                self.proc.kill()
+                self.proc.wait(timeout=15)
+            except Exception:  # noqa: BLE001
+                pass
+        self.proc = None
+
+
+class Localnet:
+    """The process fleet: generate -> start -> drive/chaos -> read."""
+
+    def __init__(self, spec: LocalnetSpec):
+        if not spec.root:
+            raise ValueError("LocalnetSpec.root is required")
+        self.spec = spec
+        self.fabric = NetFabric(
+            name=f"localnet-{os.path.basename(spec.root)}"
+        )
+        self.nodes = [LocalNode(spec, i) for i in range(spec.n)]
+        self._edges = spec.dial_edges()
+
+    # -- generation ---------------------------------------------------------
+
+    def generate(self) -> "Localnet":
+        """N homes + shared genesis + per-home config.toml, all from
+        the spec. Keys are seeded from (chain_id, seed, index) so two
+        runs of the same spec produce the same validator set."""
+        from tendermint_tpu.config import load_config
+        from tendermint_tpu.config.toml import config_to_toml, ensure_root
+        from tendermint_tpu.crypto.keys import gen_priv_key_ed25519
+        from tendermint_tpu.types import (
+            GenesisDoc,
+            GenesisValidator,
+            PrivValidatorFS,
+        )
+
+        spec = self.spec
+        os.makedirs(spec.root, exist_ok=True)
+        pvs = []
+        for i in range(spec.n):
+            ensure_root(spec.home(i))
+            pv = PrivValidatorFS(
+                gen_priv_key_ed25519(
+                    f"{spec.chain_id}-{spec.seed}-val-{i}".encode()
+                ),
+                None,
+            )
+            pvs.append(pv)
+        genesis = GenesisDoc(
+            genesis_time_ns=time.time_ns(),
+            chain_id=spec.chain_id,
+            validators=[
+                GenesisValidator(pv.get_pub_key(), 10, f"node{i}")
+                for i, pv in enumerate(pvs)
+            ],
+        )
+        timeouts = spec.consensus_timeouts()
+        for i, pv in enumerate(pvs):
+            home = spec.home(i)
+            cfg = load_config(home)
+            cfg.base.chain_id = spec.chain_id
+            cfg.base.moniker = f"node{i}"
+            cfg.base.proxy_app = spec.proxy_app
+            cfg.base.db_backend = spec.db_backend
+            cfg.base.tx_index = spec.tx_index
+            cfg.consensus.gossip_dedup = spec.gossip_dedup
+            for k, v in timeouts.items():
+                setattr(cfg.consensus, k, v)
+            cfg.consensus.skip_timeout_commit = False
+            with open(os.path.join(home, "config.toml"), "w") as f:
+                f.write(config_to_toml(cfg))
+            pv.file_path = cfg.base.priv_validator_file()
+            pv.save()
+            genesis.save_as(cfg.base.genesis_file())
+        return self
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _seeds_for(self, i: int) -> str:
+        """Node i's seed list: one LinkProxy laddr per outgoing dial
+        edge (created on first use, reused across restarts so armed
+        chaos — WAN shaping, delays — rides through)."""
+        seeds = []
+        for (a, b) in self._edges:
+            if a != i:
+                continue
+            link = self.fabric.link(a, b)
+            if link is None:
+                link = self.fabric.add_link(
+                    a, b, ("127.0.0.1", self.spec.p2p_port(b))
+                )
+            seeds.append(link.laddr)
+        return ",".join(seeds)
+
+    def start(self) -> "Localnet":
+        for node in self.nodes:
+            node.start(seeds=self._seeds_for(node.index))
+        if self.spec.geo > 0:
+            self.apply_geo(self.spec.geo)
+        elif self.spec.wan:
+            self.apply_wan(self.spec.wan)
+        return self
+
+    def restart_node(self, idx: int, sig=signal.SIGKILL) -> None:
+        """Kill node idx (SIGKILL by default — the crash arm; pass
+        SIGTERM for a graceful roll) and boot it again on the SAME
+        ports and home. Its links drop live connections so peers see a
+        dead node immediately; their persistent reconnect loops re-peer
+        through the same proxies once it's back."""
+        node = self.nodes[idx]
+        node.kill(sig)
+        for link in self.fabric.links_of(idx):
+            link.drop_all()
+        node.start(seeds=self._seeds_for(idx))
+
+    def stop(self, keep_root: bool = False) -> None:
+        for node in self.nodes:
+            node.kill(signal.SIGTERM)
+        self.fabric.stop()
+        if not keep_root:
+            shutil.rmtree(self.spec.root, ignore_errors=True)
+
+    # -- chaos verbs (the netchaos vocabulary, process tier) ----------------
+
+    def partition(self, group_a) -> None:
+        self.fabric.partition_groups(set(group_a))
+
+    def heal(self) -> None:
+        self.fabric.heal_all()
+
+    def apply_wan(self, profile, seed: int | None = None) -> None:
+        self.fabric.apply_wan(
+            profile, seed=self.spec.seed if seed is None else seed
+        )
+
+    def apply_geo(self, k: int, intra="lan", inter=None,
+                  seed: int | None = None) -> list[list[int]]:
+        clusters = geo_clusters(self.spec.n, k)
+        self.fabric.apply_geo(
+            clusters, intra=intra,
+            inter=inter or (self.spec.wan or "intercontinental"),
+            seed=self.spec.seed if seed is None else seed,
+        )
+        return clusters
+
+    def clear_wan(self) -> None:
+        self.fabric.clear_wan()
+
+    # -- readout (the public scrape surface only) ---------------------------
+
+    def fleet_urls(self, nodes: list[int] | None = None) -> list[str]:
+        idxs = nodes if nodes is not None else range(len(self.nodes))
+        return [self.nodes[i].rpc_url for i in idxs]
+
+    def heights(self) -> list[int]:
+        return [n.height() for n in self.nodes]
+
+    def wait_height(self, h: int, timeout: float = 180.0,
+                    nodes: list[int] | None = None) -> bool:
+        idxs = list(nodes if nodes is not None else range(len(self.nodes)))
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(self.nodes[i].height() >= h for i in idxs):
+                return True
+            time.sleep(0.5)
+        return all(self.nodes[i].height() >= h for i in idxs)
+
+    def timeline(self, last: int = 10, nodes: list[int] | None = None):
+        """ops/fleet cross-node height rows (propagation lag, quorum
+        formation, commit skew) off live scrapes."""
+        snapshot = fleet.collect(self.fleet_urls(nodes), last=last)
+        return fleet.build_timeline(
+            {u: e.get("traces", []) for u, e in snapshot.items()}, last=last
+        )
+
+    def scrape_totals(self, names: list[str],
+                      nodes: list[int] | None = None) -> dict:
+        """Sum each metric across the fleet (label series summed per
+        node by fleet.metric_value). A dead node contributes nothing."""
+        out = {name: 0.0 for name in names}
+        for url in self.fleet_urls(nodes):
+            try:
+                m = fleet.fetch_metrics(url)
+            except Exception:  # noqa: BLE001 — partial fleets still read
+                continue
+            for name in names:
+                out[name] += fleet.metric_value(m, name, default=0) or 0
+        return out
+
+    def duplicate_vote_ratio(self, nodes: list[int] | None = None) -> float:
+        """The redundancy number this round engineers down: fleet-wide
+        duplicate votes per accepted vote (PR-17/20 counters; the
+        2N*N-redundancy literature's measurable)."""
+        t = self.scrape_totals(
+            ["consensus_vote_duplicates", "consensus_vote_accepted"], nodes
+        )
+        accepted = t["consensus_vote_accepted"]
+        return (t["consensus_vote_duplicates"] / accepted) if accepted else 0.0
+
+    def gossip_bytes(self, nodes: list[int] | None = None) -> float:
+        """Fleet-total p2p bytes written (all channels)."""
+        return self.scrape_totals(
+            ["p2p_peer_send_bytes_total"], nodes
+        )["p2p_peer_send_bytes_total"]
+
+    # -- convergence --------------------------------------------------------
+
+    def fingerprint(self, idx: int, height: int) -> tuple:
+        """(block hash, part-set root, app hash) at `height` via RPC —
+        the byte-identity surface, read as an operator would."""
+        res = self.nodes[idx].rpc("block", {"height": height})
+        meta, block = res["block_meta"], res["block"]
+        return (
+            meta["block_id"]["hash"],
+            meta["block_id"]["parts"]["hash"],
+            block["header"]["app_hash"],
+        )
+
+    def assert_converged(self, upto: int, from_height: int = 1,
+                         nodes: list[int] | None = None) -> int:
+        """Per-height byte identity across `nodes` for every height in
+        [from_height, upto]. Returns heights compared."""
+        idxs = list(nodes if nodes is not None else range(len(self.nodes)))
+        compared = 0
+        for h in range(from_height, upto + 1):
+            prints = {i: self.fingerprint(i, h) for i in idxs}
+            distinct = set(prints.values())
+            assert len(distinct) == 1, (
+                f"fleet diverges at height {h}: {prints}"
+            )
+            compared += 1
+        return compared
+
+
+# -- the scenario matrix ------------------------------------------------------
+
+
+def run_scenario(spec: LocalnetSpec, scenario: str = "converge",
+                 heights: int = 5, keep_root: bool = False) -> dict:
+    """One named netchaos-style scenario against a process fleet.
+
+    converge        — boot, reach `heights`, assert per-height byte
+                      identity across ALL nodes (under the spec's WAN /
+                      geo shaping, if any)
+    partition_heal  — converge, sever a 1/3 minority, prove the 2/3
+                      majority keeps committing while the minority is
+                      frozen, heal, prove the minority catches up and
+                      the whole fleet is byte-identical
+    rolling_restart — converge, SIGKILL-and-restart a third of the
+                      fleet one node at a time, prove each rejoins and
+                      the fleet converges byte-identically
+
+    Returns a flat JSON-able result row (heights/s, duplicate-vote
+    ratio, fleet bytes — the bench's raw material)."""
+    net = Localnet(spec)
+    try:
+        net.generate()
+        t0 = time.monotonic()
+        net.start()
+        if not net.wait_height(1, timeout=180.0):
+            raise AssertionError(
+                f"fleet never reached height 1: {net.heights()}"
+            )
+        result: dict = {
+            "scenario": scenario,
+            "n": spec.n,
+            "topology": spec.resolved_topology(),
+            "wan": spec.wan or None,
+            "geo": spec.geo or None,
+            "gossip_dedup": spec.gossip_dedup,
+        }
+        if scenario == "converge":
+            ok = net.wait_height(heights, timeout=60.0 * heights)
+            assert ok, f"no convergence at {heights}: {net.heights()}"
+            elapsed = time.monotonic() - t0
+            result["heights"] = heights
+            result["heights_per_s"] = heights / elapsed
+            result["converged_heights"] = net.assert_converged(heights)
+        elif scenario == "partition_heal":
+            assert spec.n >= 4, "partition_heal needs n >= 4"
+            ok = net.wait_height(heights, timeout=60.0 * heights)
+            assert ok, f"no convergence at {heights}: {net.heights()}"
+            minority = list(range(spec.n // 3))
+            majority = [i for i in range(spec.n) if i not in minority]
+            net.partition(minority)
+            h0 = max(net.heights())
+            ok = net.wait_height(h0 + 3, timeout=120.0, nodes=majority)
+            assert ok, (
+                f"majority stalled during partition: {net.heights()}"
+            )
+            frozen = [net.nodes[i].height() for i in minority]
+            net.heal()
+            target = max(net.heights()) + 2
+            ok = net.wait_height(target, timeout=180.0)
+            assert ok, f"minority never healed: {net.heights()}"
+            result["heights"] = target
+            result["minority_frozen_at"] = frozen
+            result["converged_heights"] = net.assert_converged(target)
+        elif scenario == "rolling_restart":
+            ok = net.wait_height(heights, timeout=60.0 * heights)
+            assert ok, f"no convergence at {heights}: {net.heights()}"
+            victims = list(range(max(1, spec.n // 3)))
+            for idx in victims:
+                net.restart_node(idx)
+                back = net.wait_height(
+                    max(net.heights()) + 1, timeout=180.0, nodes=[idx]
+                )
+                assert back, f"node{idx} never rejoined: {net.heights()}"
+            target = max(net.heights())
+            ok = net.wait_height(target, timeout=120.0)
+            assert ok, f"fleet lost a node after the roll: {net.heights()}"
+            result["heights"] = target
+            result["restarted"] = victims
+            result["converged_heights"] = net.assert_converged(target)
+        else:
+            raise ValueError(
+                f"unknown scenario {scenario!r}; known: converge, "
+                "partition_heal, rolling_restart"
+            )
+        result["duplicate_vote_ratio"] = net.duplicate_vote_ratio()
+        result["gossip_bytes"] = net.gossip_bytes()
+        result["final_heights"] = net.heights()
+        return result
+    finally:
+        net.stop(keep_root=keep_root)
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="N-process localnet: generate homes, boot real CLI "
+                    "nodes through netfaults link proxies, run a chaos "
+                    "scenario, read convergence off the scrape surface",
+    )
+    ap.add_argument("--n", type=int, default=10)
+    ap.add_argument("--root", default="",
+                    help="net root dir (default: a temp dir, removed "
+                         "unless --keep)")
+    ap.add_argument("--scenario", default="converge",
+                    choices=["converge", "partition_heal", "rolling_restart"])
+    ap.add_argument("--heights", type=int, default=5)
+    ap.add_argument("--topology", default="",
+                    choices=["", "full", "ring", "star"])
+    ap.add_argument("--ring-k", type=int, default=DEFAULT_RING_K)
+    ap.add_argument("--wan", default="",
+                    help="netfaults WAN profile (lan, continental, "
+                         "intercontinental, lossy-mobile)")
+    ap.add_argument("--geo", type=int, default=0,
+                    help="geo-cluster count (0 = off)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--base-port", type=int, default=47100)
+    ap.add_argument("--no-dedup", action="store_true",
+                    help="boot with gossip_dedup=false (the pre-round-20 "
+                         "gossip baseline)")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep homes + logs after the run")
+    args = ap.parse_args(argv)
+
+    import tempfile
+
+    logging.basicConfig(level=logging.INFO)
+    root = args.root or tempfile.mkdtemp(prefix="localnet-")
+    spec = LocalnetSpec(
+        n=args.n, root=root, seed=args.seed, topology=args.topology,
+        ring_k=args.ring_k, base_port=args.base_port, wan=args.wan,
+        geo=args.geo, gossip_dedup=not args.no_dedup,
+    )
+    result = run_scenario(
+        spec, scenario=args.scenario, heights=args.heights,
+        keep_root=args.keep,
+    )
+    if args.keep:
+        result["root"] = root
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

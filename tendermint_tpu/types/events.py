@@ -26,6 +26,11 @@ EVENT_RELOCK = "Relock"
 EVENT_TIMEOUT_WAIT = "TimeoutWait"
 EVENT_VOTE = "Vote"
 EVENT_PROPOSAL_HEARTBEAT = "ProposalHeartbeat"
+# beyond reference: fired when the proposal part-set gains a part
+# (build or gossip) — the consensus reactor broadcasts a HasBlockPart
+# announcement off it so peers stop re-sending parts we already hold
+# (the round-20 part-gossip dedup screen)
+EVENT_PROPOSAL_BLOCK_PART = "ProposalBlockPart"
 # beyond reference: fired when duplicate-vote evidence is validated and
 # pooled (types/evidence.py; the reference detects conflicts and punts,
 # consensus/state.go:1438-1447)
@@ -94,6 +99,16 @@ class EventDataVote:
 
     def to_json(self):
         return {"vote": self.vote.to_json()}
+
+
+@dataclass
+class EventDataBlockPart:
+    height: int
+    round_: int
+    index: int
+
+    def to_json(self):
+        return {"height": self.height, "round": self.round_, "index": self.index}
 
 
 @dataclass

@@ -73,7 +73,8 @@ class ChaosNet:
                  snapshot_chunk_size: int | None = None,
                  snapshot_full_every: int | None = None,
                  snapshot_keep: int | None = None,
-                 height_throttle_s: float | None = None):
+                 height_throttle_s: float | None = None,
+                 gossip_dedup: bool | None = None):
         self.n = n
         self.root = root
         self.app = app
@@ -97,6 +98,10 @@ class ChaosNet:
         # (a real timeout_commit instead of the preset's skipped one).
         self.snapshot_keep = snapshot_keep
         self.height_throttle_s = height_throttle_s
+        # round 20: None = config default (dedup on); False boots the
+        # whole net with the pre-round-20 gossip, the A/B baseline the
+        # duplicate-ratio assertions compare against
+        self.gossip_dedup = gossip_dedup
         # mixed-version nets (round 18): per-node genesis commit_format
         # override — {idx: "aggregate"} boots node idx under the other
         # flag; NodeInfo.compatible_with refuses the peering loudly
@@ -153,6 +158,8 @@ class ChaosNet:
             # commit before the next height (the preset skips it)
             cfg.consensus.timeout_commit = self.height_throttle_s
             cfg.consensus.skip_timeout_commit = False
+        if self.gossip_dedup is not None:
+            cfg.consensus.gossip_dedup = self.gossip_dedup
         if statesync_from:
             # statesync_enable=False configures the light-client
             # endpoints WITHOUT arming a boot-time restore — the

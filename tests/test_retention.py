@@ -816,3 +816,81 @@ class TestCoordinatorDrivesPlanes:
         assert s["runs"] == 1 and s["pruned_heights"] == 22
         assert s["disk_wal_bytes"] > 0
         wal.stop()
+
+
+class TestTxIndexRetention:
+    """Round 20: the kv tx index was the last per-height disk term a
+    pruned node kept growing forever — it now rides the same retention
+    pass as the block store and WAL."""
+
+    @staticmethod
+    def _indexer_with(heights):
+        from tendermint_tpu.state.txindex import Batch, KVTxIndexer
+        from tendermint_tpu.types.tx import TxResult, tx_hash
+
+        ix = KVTxIndexer(MemDB())
+        hashes = {}
+        for h in heights:
+            b = Batch()
+            for i in range(3):
+                tx = f"tx-{h}-{i}".encode()
+                b.add(TxResult(height=h, index=i, tx=tx, result=None))
+                hashes[(h, i)] = tx_hash(tx)
+            ix.add_batch(b)
+        return ix, hashes
+
+    def test_prune_to_drops_below_and_keeps_rest(self):
+        ix, hashes = self._indexer_with(range(1, 11))
+        assert ix.prune_to(6) == 5 * 3  # heights 1..5, 3 txs each
+        assert ix.pruned_txs == 15
+        for (h, i), hsh in hashes.items():
+            got = ix.get(hsh)
+            if h < 6:
+                assert got is None, (h, i)
+            else:
+                assert got is not None and got.height == h
+        # idempotent: nothing left below the safe height
+        assert ix.prune_to(6) == 0
+        # and the height keys went with the primaries (no orphan scan
+        # debt): a later deeper pass only counts the still-live txs
+        assert ix.prune_to(11) == 5 * 3
+        assert ix.pruned_txs == 30
+
+    def test_pre_round_20_records_survive(self):
+        """Txs indexed before the height keys existed have no secondary
+        key — pruning must leave them alone (the safe failure direction
+        for an index), not guess at their heights."""
+        from tendermint_tpu.state.txindex import KVTxIndexer
+        from tendermint_tpu.types.tx import tx_hash
+
+        ix = KVTxIndexer(MemDB())
+        old = b"pre-round-20-tx"
+        ix.db.set(tx_hash(old), b'{"height": 2, "index": 0, "tx": "", "result": null}')
+        assert ix.prune_to(100) == 0
+        assert ix.db.get(tx_hash(old)) is not None
+
+    def test_coordinator_drives_tx_indexer_and_stats(self, tmp_path):
+        ix, _ = self._indexer_with(range(1, 21))
+        chain = build_kvstore_chain(20)
+        cfg = PruningConfig(retain_blocks=5, interval_heights=1)
+        c = RetentionCoordinator(
+            cfg, chain.block_store, tx_indexer=ix, db_dir=str(tmp_path),
+        )
+
+        class _S:
+            last_block_height = 20
+
+        assert c.maybe_prune(_S()) == 15  # safe height 16
+        assert ix.pruned_txs == 15 * 3
+        s = c.stats()
+        assert s["tx_index_pruned"] == 45
+        assert "disk_txindex_bytes" in s
+        # an indexer without prune_to (the null impl) is simply skipped
+        from tendermint_tpu.state.txindex import NullTxIndexer
+
+        c2 = RetentionCoordinator(
+            cfg, build_kvstore_chain(20).block_store,
+            tx_indexer=NullTxIndexer(),
+        )
+        assert c2.maybe_prune(_S()) == 15
+        assert c2.stats()["tx_index_pruned"] == 0
