@@ -24,7 +24,7 @@ test:
 # hold (dots no worse than the seed) — plus the chip-free hash-stream
 # smoke (the two asserted BENCH_r07 rows: streamed hash offload >= 1.3x
 # single-shot on the sim transport, flat host builder >= 1.5x recursive).
-tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke wan-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke retention-smoke localnet-smoke
+tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke wan-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke retention-smoke localnet-smoke shard-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Chip-free bench smoke: every BASELINE config on the pinned CPU backend,
@@ -172,6 +172,18 @@ retention-smoke:
 localnet-smoke:
 	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_LOCALNET_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_localnet.py
 
+# Sharded-device-plane smoke, chip-free (~30 s): bench_devd_shard.py's
+# reduced pass — 1-vs-2 sim daemon fleets behind ops/devd_shard with the
+# aggregate sigs/s scaling floor asserted (>= 1.6x at 2 daemons), digest
+# parity across fleet sizes, and the kill-one-mid-burst failover row:
+# SIGKILL one of two daemons with a batch in flight, every lane keeps
+# its exact verdict through re-dispatch, the dead endpoint's breaker
+# opens and re-closes after restart. Runs as part of `make tier1` (the
+# 1/2/4 ladder writes BENCH_r21.json; the chaos matrix lives in
+# tests/test_chaos_devd.py + tests/test_devd_shard.py).
+shard-smoke:
+	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_DEVD_SHARD_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_devd_shard.py
+
 test_race:
 	$(PY) -m pytest tests/test_race.py -q
 
@@ -184,4 +196,4 @@ test_slow:
 native:
 	$(MAKE) -C native
 
-.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke wan-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke retention-smoke localnet-smoke
+.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke wan-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke retention-smoke localnet-smoke shard-smoke

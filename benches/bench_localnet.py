@@ -18,6 +18,9 @@ Rows (full run):
 - dedup_off:n=10: the SAME 10-node fleet with gossip_dedup=false (the
   pre-round-20 gossip); the duplicate-vote ratio is asserted strictly
   WORSE than the dedup-on row — the measurable the tentpole claims.
+- dedup_ab:ring:n=10 (round 21): the same A/B on an explicit RING —
+  the sparse hundreds-of-nodes shape where votes arrive mostly by
+  relay; dedup-on asserted strictly better there too.
 - partition_heal:n=10: a netchaos-style fault at process scale — 1/3
   minority severed, majority keeps committing, heal, full-fleet
   byte-identity.
@@ -64,12 +67,13 @@ def main() -> None:
     port = 47400
     ratio_at_10 = None
 
-    def spec_for(n: int, wan: str, dedup: bool = True) -> LocalnetSpec:
+    def spec_for(n: int, wan: str, dedup: bool = True,
+                 topology: str = "") -> LocalnetSpec:
         nonlocal port
         root = tempfile.mkdtemp(prefix=f"bench-localnet-{n}-")
         s = LocalnetSpec(
             n=n, root=root, seed=20, base_port=port, wan=wan,
-            gossip_dedup=dedup,
+            gossip_dedup=dedup, topology=topology,
         )
         # fleets run serially but TIME_WAIT lingers: each gets its own
         # port range
@@ -113,6 +117,29 @@ def main() -> None:
             "ratio_dedup_off": round(off_ratio, 4),
             "reduction": round(1 - ratio_at_10 / off_ratio, 3)
             if off_ratio else None,
+        })
+
+        # -- the same A/B on a RING at n=10 (round 21): 10 nodes would
+        # auto-mesh full, but the hundreds-of-nodes shape is sparse —
+        # votes arrive mostly by RELAY, where the has-vote gate (not the
+        # receiver's dup counter alone) earns its keep ------------------
+        r = run_scenario(
+            spec_for(10, "", topology="ring"), "converge", heights=5)
+        ring_on = r["duplicate_vote_ratio"]
+        r = run_scenario(
+            spec_for(10, "", dedup=False, topology="ring"),
+            "converge", heights=5)
+        ring_off = r["duplicate_vote_ratio"]
+        assert ring_on < ring_off, (
+            f"has-vote dedup did not reduce duplicate votes on the ring: "
+            f"on={ring_on:.4f} vs off={ring_off:.4f}"
+        )
+        rows.append({
+            "mode": "dedup_ab:ring:n=10",
+            "ratio_dedup_on": round(ring_on, 4),
+            "ratio_dedup_off": round(ring_off, 4),
+            "reduction": round(1 - ring_on / ring_off, 3)
+            if ring_off else None,
         })
 
         # -- a netchaos fault at process scale ------------------------------

@@ -90,6 +90,13 @@ Configs (BASELINE.md):
                   vs node count; has-vote dedup A/B at n=10 asserted
                   to reduce the ratio; process-scale partition-heal
                   (writes BENCH_r20.json; chip-free)
+ 21 devd_shard   — sharded device plane: aggregate verify sigs/s + hash
+                  MB/s through ops/devd_shard vs 1/2/4 sim daemon
+                  fleets (>= 1.6x at 2 daemons asserted, digests
+                  byte-identical across fleet sizes) + the
+                  kill-one-mid-burst failover row: exact per-lane
+                  verdicts through re-dispatch, breaker open/recovery
+                  latencies (writes BENCH_r21.json; chip-free)
  13 statetree    — authenticated app-state commitment: incremental
                   commit vs full tree rebuild, proof correctness rows,
                   delta-vs-full snapshot bytes (delta asserted <= 0.5x
@@ -133,6 +140,7 @@ BENCHES = {
     "18_wan": [sys.executable, "benches/bench_wan.py"],
     "19_retention": [sys.executable, "benches/bench_retention.py"],
     "20_localnet": [sys.executable, "benches/bench_localnet.py"],
+    "21_devd_shard": [sys.executable, "benches/bench_devd_shard.py"],
 }
 
 

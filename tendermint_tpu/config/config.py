@@ -231,6 +231,23 @@ class PruningConfig:
 
 
 @dataclass
+class DeviceConfig:
+    """Device plane topology (round 21, docs/device-daemon.md § Sharded
+    device plane): which devd daemon socket(s) the gateway dispatches
+    verify/hash batches to. Empty = the TENDERMINT_DEVD_SOCK/default
+    single-socket behavior, unchanged."""
+
+    root_dir: str = ""
+    # comma-separated devd socket paths. One entry behaves byte-for-byte
+    # like setting TENDERMINT_DEVD_SOCK; two or more arm the sharded
+    # dispatcher (ops/devd_shard: slice sharding, work stealing,
+    # per-endpoint circuit breakers). Node assembly exports this as
+    # TENDERMINT_DEVD_SOCKS unless the env var is already set (the env
+    # wins — it is the operator's per-process override).
+    socks: str = ""
+
+
+@dataclass
 class Config:
     base: BaseConfig = field(default_factory=BaseConfig)
     rpc: RPCConfig = field(default_factory=RPCConfig)
@@ -239,6 +256,7 @@ class Config:
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     pruning: PruningConfig = field(default_factory=PruningConfig)
+    device: DeviceConfig = field(default_factory=DeviceConfig)
 
     def set_root(self, root: str) -> "Config":
         self.base.root_dir = root
@@ -248,6 +266,7 @@ class Config:
         self.consensus.root_dir = root
         self.statesync.root_dir = root
         self.pruning.root_dir = root
+        self.device.root_dir = root
         return self
 
     def copy(self) -> "Config":
@@ -259,6 +278,7 @@ class Config:
             replace(self.consensus),
             replace(self.statesync),
             replace(self.pruning),
+            replace(self.device),
         )
 
 

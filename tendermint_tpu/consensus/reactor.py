@@ -44,8 +44,28 @@ PEER_QUERY_MAJ23_SLEEP = 2.0
 # the mirror bit (ms on loopback, ~one link RTT under WAN); after it,
 # anything still unmarked is genuinely needed and relays normally.
 VOTE_RELAY_DELAY = PEER_GOSSIP_SLEEP
+# RTT-adaptive hold (round 21): the window that lets HasVote
+# announcements win the relay race is ~one link RTT — on a fast LAN the
+# 0.1 s constant over-holds (announcements land in ms), under a slow WAN
+# it under-holds (re-pushes fire before the announcement arrives). When
+# ping RTT samples exist (the p2p ping_rtt EWMA), the hold tracks 2x the
+# smoothed RTT (ping->pong is a full round trip; the announcement needs
+# one leg each way too), clamped to [0.5x, 4x] of the constant so a
+# garbage sample can neither disable the hold nor stall relays. The
+# constant remains the exact no-sample fallback.
+VOTE_RELAY_DELAY_MIN = 0.5 * VOTE_RELAY_DELAY
+VOTE_RELAY_DELAY_MAX = 4.0 * VOTE_RELAY_DELAY
 
 PEER_STATE_KEY = "ConsensusReactor.peerState"
+
+
+def adaptive_relay_delay(rtt_s: float | None) -> float:
+    """The lazy-relay hold for a smoothed peer RTT: None (no samples
+    yet) keeps the VOTE_RELAY_DELAY constant; otherwise 2x the RTT
+    clamped into [VOTE_RELAY_DELAY_MIN, VOTE_RELAY_DELAY_MAX]."""
+    if rtt_s is None:
+        return VOTE_RELAY_DELAY
+    return min(VOTE_RELAY_DELAY_MAX, max(VOTE_RELAY_DELAY_MIN, 2.0 * rtt_s))
 
 
 def _enc(msg) -> bytes:
@@ -792,18 +812,32 @@ class ConsensusReactor(Reactor, BaseService):
             fr.record("gossip_send_fail", peer=_peer_label(peer))
         return False
 
+    def _relay_delay(self) -> float:
+        """The current lazy-relay hold: RTT-adaptive when the switch's
+        registry carries ping RTT samples (adaptive_relay_delay), the
+        VOTE_RELAY_DELAY constant otherwise — including for harness
+        reactors with no switch at all."""
+        reg = getattr(getattr(self, "switch", None), "metrics_registry",
+                      None)
+        if reg is None:
+            return VOTE_RELAY_DELAY
+        from tendermint_tpu.p2p.telemetry import peer_metrics
+
+        return adaptive_relay_delay(peer_metrics(reg)["ping_rtt_ewma"].value())
+
     def _relay_ready(self, vote) -> bool:
         """The lazy-relay screen: hold re-pushes of a vote we received
-        less than VOTE_RELAY_DELAY ago (see the constant). Unstamped
-        votes — our own, and store-backed catchup commits — relay
-        immediately; a held vote stays pickable and goes out on a later
-        tick if the peer's mirror bit is still clear then."""
+        less than _relay_delay() ago (VOTE_RELAY_DELAY, RTT-adapted when
+        samples exist). Unstamped votes — our own, and store-backed
+        catchup commits — relay immediately; a held vote stays pickable
+        and goes out on a later tick if the peer's mirror bit is still
+        clear then."""
         if not self.gossip_dedup:
             return True
         t = self.con_s.vote_recv_mono.get(
             (vote.height, vote.round_, vote.type_, vote.validator_index)
         )
-        return t is None or time.monotonic() - t >= VOTE_RELAY_DELAY
+        return t is None or time.monotonic() - t >= self._relay_delay()
 
     def _pick_and_send_vote(self, peer, ps: PeerState, rs, prs: PeerRoundState) -> bool:
         """One needed vote, if any (reactor.go:609-645 gossipVotesForHeight

@@ -98,7 +98,18 @@ WRITER_REAP_S = 5.0
 
 
 def sock_path() -> str:
-    return os.environ.get("TENDERMINT_DEVD_SOCK", DEFAULT_SOCK)
+    """The PRIMARY daemon socket. TENDERMINT_DEVD_SOCK pins it; without
+    one, the first entry of TENDERMINT_DEVD_SOCKS (the round-21 sharded
+    device plane's endpoint list, ops/devd_shard) is the primary — so a
+    one-entry SOCKS deployment behaves byte-for-byte like a SOCK one."""
+    explicit = os.environ.get("TENDERMINT_DEVD_SOCK")
+    if explicit:
+        return explicit
+    for p in os.environ.get("TENDERMINT_DEVD_SOCKS", "").split(","):
+        p = p.strip()
+        if p:
+            return p
+    return DEFAULT_SOCK
 
 
 # -- framing ------------------------------------------------------------------
@@ -1827,26 +1838,37 @@ class DevdClient:
             self._discard(c)
 
 
-_avail_cache: dict = {"t": 0.0, "path": None, "rep": None}
+# per-path probe cache: the sharded plane (ops/devd_shard) probes every
+# endpoint independently, so one entry per socket path
+_avail_cache: dict[str, tuple[float, dict | None]] = {}
+_avail_mtx = threading.Lock()
 _AVAIL_TTL = 15.0
 
 
-def bust_avail_cache() -> None:
+def bust_avail_cache(path: str | None = None) -> None:
     """Force the next available() to ping fresh — failure paths must not
-    trust a TTL-cached 'held' from a daemon that just died."""
-    _avail_cache["t"] = 0.0
+    trust a TTL-cached 'held' from a daemon that just died. No-arg busts
+    every endpoint's entry; a path busts just that endpoint's."""
+    with _avail_mtx:
+        if path is None:
+            _avail_cache.clear()
+        else:
+            _avail_cache.pop(path, None)
 
 
-def available(timeout: float = 1.0) -> dict | None:
+def available(timeout: float = 1.0, path: str | None = None) -> dict | None:
     """Liveness probe: the daemon's ping reply if a daemon is serving AND
     holds the device, else None. Never raises. Positive AND negative
-    results are cached ~15s — the gateway consults this per batch on its
-    kernel-selection default, and a ping (or a failed connect) per batch
-    would dominate small-batch latency."""
-    path = sock_path()
+    results are cached ~15s per socket path — the gateway consults this
+    per batch on its kernel-selection default, and a ping (or a failed
+    connect) per batch would dominate small-batch latency. `path` probes
+    one sharded-plane endpoint; default is the primary socket."""
+    path = path or sock_path()
     now = time.monotonic()
-    if _avail_cache["path"] == path and now - _avail_cache["t"] < _AVAIL_TTL:
-        return _avail_cache["rep"]
+    with _avail_mtx:
+        hit = _avail_cache.get(path)
+        if hit is not None and now - hit[0] < _AVAIL_TTL:
+            return hit[1]
     rep = None
     if os.path.exists(path):
         try:
@@ -1856,7 +1878,8 @@ def available(timeout: float = 1.0) -> dict | None:
             rep = r if r.get("held") else None
         except Exception:
             rep = None
-    _avail_cache.update(t=now, path=path, rep=rep)
+    with _avail_mtx:
+        _avail_cache[path] = (now, rep)
     return rep
 
 

@@ -89,6 +89,7 @@ class FlightRecorder:
         self._latched: set[str] = set()
         self._last_health: str | None = None
         self._last_breaker: int | None = None
+        self._last_endpoint_breaker: dict[str, int] = {}
         self._dup_sample = 0
         # optional counter-snapshot provider for dumps (node/node.py
         # wires p2p gossip totals + consensus position)
@@ -144,6 +145,21 @@ class FlightRecorder:
         if self._last_breaker is not None:
             self.record("breaker", state=int(state))
         self._last_breaker = state
+
+    def note_endpoint_breaker(self, endpoint: str, state: int) -> None:
+        """Per-endpoint breaker transition (round 21 sharded device
+        plane): change-driven like note_breaker, keyed by socket path —
+        a sick chip's open/half-open/close sequence reads straight off
+        the ring (kind ``endpoint_breaker``)."""
+        if not self._enabled:
+            return
+        last = self._last_endpoint_breaker.get(endpoint)
+        if state == last:
+            return
+        if last is not None:
+            self.record("endpoint_breaker", endpoint=endpoint,
+                        state=int(state))
+        self._last_endpoint_breaker[endpoint] = state
 
     def note_height_age(self, age_s: float, wedge_s: float,
                         waived: bool = False) -> None:
@@ -274,6 +290,14 @@ class FlightRecorder:
                     self.note_breaker(
                         gateway.devd_breaker().stats()["breaker_state"]
                     )
+                except Exception:  # noqa: BLE001
+                    pass
+                try:
+                    # sharded plane: every endpoint breaker that EXISTS
+                    # (never instantiates one — a single-socket node has
+                    # only the primary above)
+                    for path, st in gateway.devd_breaker_states().items():
+                        self.note_endpoint_breaker(path, st)
                 except Exception:  # noqa: BLE001
                     pass
                 try:

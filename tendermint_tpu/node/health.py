@@ -117,8 +117,18 @@ def health_report(node) -> dict:
 
     br = gateway.devd_breaker().stats()
     bstatus = DEGRADED if br["breaker_state"] == 2 else OK
+    # sharded device plane (round 21): any OPEN endpoint breaker means
+    # the fleet runs at reduced capacity — degraded, alive. Reads only
+    # breakers that EXIST (devd_breaker_states never instantiates), so a
+    # single-socket node sees exactly the primary-breaker check above.
+    ep_states = gateway.devd_breaker_states()
+    ep_open = sum(1 for s in ep_states.values() if s == 2)
+    if ep_open and len(ep_states) > 1:
+        bstatus = _worst(bstatus, DEGRADED)
     checks["breaker"] = {"status": bstatus, "state": br["breaker_state"],
-                         "opens": br["breaker_opens"]}
+                         "opens": br["breaker_opens"],
+                         "device_endpoints": len(ep_states),
+                         "device_endpoints_open": ep_open}
     status = _worst(status, bstatus)
 
     # -- WAL flusher -------------------------------------------------------

@@ -107,6 +107,22 @@ class Node(BaseService):
         # one hashing gateway for the part/tx Merkle hot paths. The tx-tree
         # hook routes every Data.hash (block build + validate) through the
         # batched kernel (ref types/tx.go:33-46).
+        # [device] config feeds the endpoint list BEFORE the gateway
+        # resolves its kernel (the verifier's devd detection and the
+        # sharded dispatcher both read the env). The env var wins when
+        # already set — it is the operator's per-process override.
+        dev_cfg = getattr(config, "device", None)
+        if dev_cfg is not None and dev_cfg.socks and \
+                not os.environ.get("TENDERMINT_DEVD_SOCKS"):
+            os.environ["TENDERMINT_DEVD_SOCKS"] = dev_cfg.socks
+        from tendermint_tpu.ops import devd_shard
+
+        if devd_shard.enabled():
+            logger.info(
+                "sharded device plane: %d devd endpoints (%s)",
+                len(devd_shard.endpoint_paths()),
+                ", ".join(devd_shard.endpoint_paths()),
+            )
         self.verifier = gateway.default_verifier()
         self.hasher = gateway.default_hasher()
         tx_types.set_batch_tx_root(self.hasher.tx_merkle_root)

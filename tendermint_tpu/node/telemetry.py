@@ -361,4 +361,63 @@ def build_registry(node) -> telemetry.Registry:
         "gateway", lambda: gateway.devd_breaker().stats(), legacy=False
     )
 
+    # round 21: the sharded device plane — flat fleet aggregates on both
+    # surfaces (stable key set even in single-socket mode: count=1,
+    # dispatch counters at zero), plus labeled per-endpoint families
+    # refreshed at collect time like the peer ages above. Counters carry
+    # the repo's _total suffix; the dispatcher keeps monotonic totals
+    # per endpoint, so children advance by delta-inc (an endpoint reset
+    # — devd_shard.reset() in tests — restarts at zero, and a negative
+    # delta is simply not applied: Prometheus counter semantics).
+    from tendermint_tpu.ops import devd_shard
+
+    reg.register_producer("gateway_endpoints", devd_shard.plane_stats)
+
+    ep_gauges = {
+        "outstanding": reg.gauge(
+            "gateway_endpoint_outstanding",
+            "Slices in flight on this devd endpoint right now",
+            labelnames=("endpoint",),
+        ),
+        "breaker_state": reg.gauge(
+            "gateway_endpoint_breaker_state",
+            "Endpoint circuit breaker: 0 closed / 1 half-open / 2 open",
+            labelnames=("endpoint",),
+        ),
+        "sigs_per_s": reg.gauge(
+            "gateway_endpoint_sigs_per_s",
+            "EWMA verify throughput of this endpoint (signature lanes/s)",
+            labelnames=("endpoint",),
+        ),
+    }
+    ep_counters = {
+        "dispatched_slices": reg.counter(
+            "gateway_endpoint_dispatched_slices_total",
+            "Verify/hash slices this endpoint completed",
+            labelnames=("endpoint",),
+        ),
+        "stolen_slices": reg.counter(
+            "gateway_endpoint_stolen_slices_total",
+            "Completed slices this endpoint stole from another's queue",
+            labelnames=("endpoint",),
+        ),
+        "redispatches": reg.counter(
+            "gateway_endpoint_redispatches_total",
+            "Slices that failed on this endpoint and re-queued elsewhere",
+            labelnames=("endpoint",),
+        ),
+    }
+
+    def refresh_endpoint_families() -> None:
+        for path, st in devd_shard.endpoint_stats().items():
+            for key, fam in ep_gauges.items():
+                fam.labels(endpoint=path).set(st[key])
+            for key, fam in ep_counters.items():
+                child = fam.labels(endpoint=path)
+                delta = st[key] - child.value
+                if delta > 0:
+                    child.inc(delta)
+
+    reg.on_collect(refresh_endpoint_families)
+
     return reg

@@ -21,6 +21,12 @@ returns an array-like of bools; verify_batch_async returns a zero-arg
 resolver. Failures raise — the gateway's existing CPU-fallback handling
 (ops/gateway.Verifier.verify_batch) treats a dead daemon exactly like a
 dead device.
+
+Sharded plane (round 21): when TENDERMINT_DEVD_SOCKS names two or more
+endpoints, every entry point delegates to ops/devd_shard — the same
+contracts, dispatched across the fleet with work-stealing and
+per-endpoint breakers. With one endpoint the single-client path below
+runs unchanged.
 """
 
 from __future__ import annotations
@@ -58,8 +64,18 @@ def _use_stream(n: int) -> bool:
     return _stream_ok and n >= _stream_min()
 
 
+def _shard():
+    """The sharded dispatcher, when >= 2 endpoints are configured."""
+    from tendermint_tpu.ops import devd_shard
+
+    return devd_shard if devd_shard.enabled() else None
+
+
 def verify_batch(items) -> np.ndarray:
     items = list(items)
+    shard = _shard()
+    if shard is not None:
+        return np.asarray(shard.verify_batch(items), dtype=bool)
     c = _get_client()
     if _use_stream(len(items)):
         try:
@@ -73,6 +89,10 @@ def verify_batch(items) -> np.ndarray:
 
 def verify_batch_async(items):
     items = list(items)
+    shard = _shard()
+    if shard is not None:
+        resolve_shard = shard.verify_batch_async(items)
+        return lambda: np.asarray(resolve_shard(), dtype=bool)
     c = _get_client()
     if _use_stream(len(items)):
         resolve = c.verify_stream_async(items)
@@ -110,7 +130,11 @@ def reset_stream_latches() -> None:
 
 def stream_stats() -> dict:
     """Client-side streamed-transport counters; Verifier.stats() exposes
-    them so the serving path is observable from the node process too."""
+    them so the serving path is observable from the node process too.
+    Sharded: summed across every endpoint's client."""
+    shard = _shard()
+    if shard is not None:
+        return shard.stream_stats()
     return _get_client().stream_stats()
 
 
@@ -171,6 +195,9 @@ def hash_batch(items, mode: str = "part") -> list[bytes]:
     single-shot pickle op otherwise. Digests byte-identical to
     crypto.hashing.ripemd160 / merkle.simple.leaf_hash."""
     items = [bytes(b) for b in items]
+    shard = _shard()
+    if shard is not None:
+        return shard.hash_batch(items, mode)
     c = _get_client()
     if _use_hash_stream(len(items), sum(len(b) for b in items)):
         try:
@@ -186,8 +213,13 @@ def hash_tree(items, mode: str = "part") -> tuple[list, list]:
     """(leaf digests, postorder internal tree nodes) — the proof-free
     part-set path: one streamed pass hashes every leaf AND the whole
     Merkle tree daemon-side (merkle.simple.FlatTree.from_nodes
-    rehydrates host proofs with zero host hashing)."""
+    rehydrates host proofs with zero host hashing). Sharded: leaves
+    hash across the fleet, the internal nodes build host-side from the
+    gathered digests (devd_shard.hash_tree — byte-identical buffer)."""
     items = [bytes(b) for b in items]
+    shard = _shard()
+    if shard is not None:
+        return shard.hash_tree(items, mode)
     c = _get_client()
     if _use_hash_stream(len(items), sum(len(b) for b in items)):
         try:
@@ -203,5 +235,9 @@ def hash_tree(items, mode: str = "part") -> tuple[list, list]:
 
 def hash_stream_stats() -> dict:
     """Client-side hash-transport counters; gateway.Hasher.stats() folds
-    them in as flat stream_* gauges for the metrics RPC."""
+    them in as flat stream_* gauges for the metrics RPC. Sharded:
+    summed across every endpoint's client."""
+    shard = _shard()
+    if shard is not None:
+        return shard.hash_stream_stats()
     return _get_client().hash_stream_stats()

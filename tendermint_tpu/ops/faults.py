@@ -659,6 +659,10 @@ class DaemonSupervisor:
             "TENDERMINT_DEVD_EXIT_ON_TERM": "1",
             **self.extra_env,
         }
+        # a sharded-plane harness exports the fleet's endpoint list; the
+        # daemon itself must bind exactly ITS socket, never consult the
+        # fleet topology
+        env.pop("TENDERMINT_DEVD_SOCKS", None)
         with open(self.log_path, "ab") as log:
             self.proc = subprocess.Popen(
                 [sys.executable, "-m", "tendermint_tpu.devd"],
@@ -782,6 +786,57 @@ class DaemonSupervisor:
             self.proc = None
         if self.plan is not None:
             unregister(self.plan)
+
+
+class DaemonFleet:
+    """N supervised sim daemons on distinct sockets — the sharded device
+    plane's chaos/bench substrate (round 21). Same ACCEPT_CPU-only rule
+    as DaemonSupervisor (which it composes); `sock_paths` joins directly
+    into TENDERMINT_DEVD_SOCKS."""
+
+    def __init__(self, n: int, sock_dir: str | None = None,
+                 extra_env: dict | None = None):
+        base = sock_dir or tempfile.gettempdir()
+        self.supervisors = [
+            DaemonSupervisor(
+                os.path.join(
+                    base, f"devd-fleet-{os.getpid()}-{id(self):x}-{i}.sock"
+                ),
+                extra_env=dict(extra_env or {}),
+            )
+            for i in range(n)
+        ]
+
+    @property
+    def sock_paths(self) -> list[str]:
+        return [s.sock_path for s in self.supervisors]
+
+    @property
+    def socks_env(self) -> str:
+        """The TENDERMINT_DEVD_SOCKS value for this fleet."""
+        return ",".join(self.sock_paths)
+
+    def start(self, wait_held_s: float = 30.0) -> "DaemonFleet":
+        started = []
+        try:
+            for s in self.supervisors:
+                s.start(wait_held_s=wait_held_s)
+                started.append(s)
+        except BaseException:
+            for s in started:
+                s.stop()
+            raise
+        return self
+
+    def kill(self, i: int) -> None:
+        self.supervisors[i].kill()
+
+    def restart(self, i: int, wait_held_s: float = 30.0) -> None:
+        self.supervisors[i].restart(wait_held_s=wait_held_s)
+
+    def stop(self) -> None:
+        for s in self.supervisors:
+            s.stop()
 
 
 # -- standalone shim process --------------------------------------------------

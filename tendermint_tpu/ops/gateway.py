@@ -487,45 +487,116 @@ class CircuitBreaker:
             }
 
 
-_devd_breaker: CircuitBreaker | None = None
+_devd_breakers: dict[str, CircuitBreaker] = {}
 _breaker_mtx = threading.Lock()
 
 
-def _devd_probe() -> bool:
+def _devd_probe(path: str | None = None) -> bool:
     """The breaker's half-open health probe: ONE fresh ping (never the
     TTL cache — it may predate the daemon's death) proving a daemon is
-    serving AND holds the device."""
+    serving AND holds the device. `path` probes one sharded-plane
+    endpoint; default is the primary socket."""
     from tendermint_tpu import devd
 
-    devd.bust_avail_cache()
-    return devd.available(timeout=1.0) is not None
+    devd.bust_avail_cache(path)
+    return devd.available(timeout=1.0, path=path) is not None
 
 
-def devd_breaker() -> CircuitBreaker:
-    """The process-wide breaker every devd consumer shares — Verifier,
-    Hasher, and everything stacked on them (SigBatcher, prime_cache,
-    fast-sync) see ONE degradation state, so recovery restores every
-    plane at once."""
-    global _devd_breaker
+def devd_breaker(endpoint: str | None = None) -> CircuitBreaker:
+    """The breaker for one devd endpoint, from the keyed registry
+    (round 21: the sharded device plane holds one breaker PER daemon
+    socket, so a sick chip degrades capacity instead of the node).
+
+    The no-arg form is the pre-sharding contract every existing consumer
+    keeps using — Verifier, Hasher, node/health, node/flightrec,
+    node/telemetry: it returns the PRIMARY endpoint's breaker (the first
+    configured socket — with one daemon, the only one), so single-socket
+    deployments still share ONE degradation state and recovery restores
+    every plane at once."""
+    if endpoint is None:
+        from tendermint_tpu import devd
+
+        endpoint = devd.sock_path()
     with _breaker_mtx:
-        if _devd_breaker is None:
-            from tendermint_tpu.ops import devd_backend
-
-            _devd_breaker = CircuitBreaker(
-                probe=_devd_probe,
+        br = _devd_breakers.get(endpoint)
+        if br is None:
+            br = CircuitBreaker(
+                probe=lambda: _devd_probe(endpoint),
                 # a re-close means the daemon came BACK — possibly a
                 # different build, so the per-daemon version-skew
                 # latches must re-learn (devd_backend docstring)
-                on_close=devd_backend.reset_stream_latches,
+                on_close=lambda: _breaker_on_close(endpoint),
             )
-        return _devd_breaker
+            _devd_breakers[endpoint] = br
+        return br
+
+
+def _breaker_on_close(endpoint: str) -> None:
+    """Re-arm the version-skew latches for the endpoint whose breaker
+    just re-closed: the single-socket client's module latches when it is
+    the primary socket, and the sharded plane's per-endpoint latches
+    either way."""
+    from tendermint_tpu import devd
+    from tendermint_tpu.ops import devd_backend, devd_shard
+
+    devd_shard.reset_endpoint_latches(endpoint)
+    if endpoint == devd.sock_path():
+        devd_backend.reset_stream_latches()
+
+
+def devd_breaker_states() -> dict[str, int]:
+    """Snapshot of every REGISTERED breaker's state, keyed by endpoint
+    socket path (never instantiates one — a scrape/watchdog must not
+    spawn breakers for endpoints nothing has dispatched to)."""
+    with _breaker_mtx:
+        items = list(_devd_breakers.items())
+    return {path: br.state for path, br in items}
 
 
 def reset_devd_breaker() -> None:
-    """Drop the shared breaker (tests; also re-reads the env knobs)."""
-    global _devd_breaker
+    """Drop every registered breaker (tests; also re-reads the env
+    knobs)."""
     with _breaker_mtx:
-        _devd_breaker = None
+        _devd_breakers.clear()
+
+
+# -- devd plane gating (round 21) --------------------------------------------
+#
+# Verifier/Hasher route per BATCH through these instead of the raw
+# breaker: with one endpoint they ARE the one breaker (byte-for-byte the
+# pre-sharding behavior); with N endpoints the plane admits work while
+# ANY endpoint's breaker does, the dispatcher (ops/devd_shard) does the
+# per-endpoint accounting slice by slice, and the CPU floor engages only
+# when every breaker is open.
+
+
+def devd_plane_allow() -> bool:
+    """Admission gate for the devd route as a whole."""
+    from tendermint_tpu.ops import devd_shard
+
+    if devd_shard.enabled():
+        return devd_shard.plane_allow()
+    return devd_breaker().allow()
+
+
+def devd_plane_failure() -> None:
+    """A devd-route batch raised. Single-socket: count it on the one
+    breaker. Sharded: the dispatcher already recorded each slice failure
+    on the endpoint that failed it — a plane-level raise means no
+    healthy endpoint remained, which those breakers already show, so
+    recording it again (on the primary) would double-count."""
+    from tendermint_tpu.ops import devd_shard
+
+    if not devd_shard.enabled():
+        devd_breaker().record_failure()
+
+
+def devd_plane_success() -> None:
+    """Mirror of devd_plane_failure for the success path."""
+    from tendermint_tpu.ops import devd_shard
+
+    if not devd_shard.enabled():
+        devd_breaker().record_success()
 
 
 class _PendingBatch:
@@ -652,21 +723,21 @@ class Verifier:
         retrying it per batch would fail identically (annotated per the
         round-8 latch sweep)."""
         if self._kernel == "devd":
-            devd_breaker().record_failure()
+            devd_plane_failure()
             return
         self._tpu_ok = False
 
     def _use_device(self, n: int) -> bool:
         """Route this batch to the kernel path? Size/health gates plus,
-        on the devd route, the shared breaker (an OPEN breaker means CPU
-        fallback for this batch — never a permanent demotion)."""
+        on the devd route, the breaker plane (every breaker OPEN means
+        CPU fallback for this batch — never a permanent demotion)."""
         if not (self._tpu_ok and n >= self.min_tpu_batch):
             return False
-        return self._kernel != "devd" or devd_breaker().allow()
+        return self._kernel != "devd" or devd_plane_allow()
 
     def _note_device_success(self) -> None:
         if self._kernel == "devd":
-            devd_breaker().record_success()
+            devd_plane_success()
 
     # -- core API ----------------------------------------------------------
 
@@ -1251,27 +1322,27 @@ class Hasher:
 
     def _use_offload(self, n: int) -> bool:
         """Route this batch to the offload path? On the devd route the
-        shared breaker gates per batch (an open breaker = host hashing
+        breaker plane gates per batch (every breaker open = host hashing
         for THIS batch, devd routing restored by the next healthy
         probe — never the old permanent `_tpu_ok = False` latch)."""
         if not (self._tpu_ok and n >= self.min_tpu_batch):
             return False
-        return self._route != "devd" or devd_breaker().allow()
+        return self._route != "devd" or devd_plane_allow()
 
     def _demote_after_failure(self) -> None:
-        """A hash offload raised. devd route -> the shared breaker
+        """A hash offload raised. devd route -> the breaker plane
         (transient transport failure, recoverable). In-process kernel
         route -> permanent CPU latch, annotated per the round-8 sweep:
         a jax compile/dispatch failure in this process is deterministic
         and would recur per batch."""
         if self._route == "devd":
-            devd_breaker().record_failure()
+            devd_plane_failure()
             return
         self._tpu_ok = False
 
     def _note_offload_success(self) -> None:
         if self._route == "devd":
-            devd_breaker().record_success()
+            devd_plane_success()
 
     def _note_batch(self, n_bytes: int, dt_s: float) -> None:
         self._batch_hist.observe(dt_s)

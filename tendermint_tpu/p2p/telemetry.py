@@ -56,6 +56,37 @@ from tendermint_tpu.libs import telemetry
 _CACHE_ATTR = "_p2p_peer_family_cache"
 
 
+class RttEwma:
+    """Registry-scoped EWMA over every peer's ping RTT samples (round
+    21): the one-number RTT summary the RTT-adaptive lazy-relay hold
+    reads (consensus/reactor.adaptive_relay_delay). Not an instrument —
+    the per-peer distribution already rides the ping_rtt histogram; this
+    is the cheap cross-peer smoother the hot relay path polls."""
+
+    ALPHA = 0.2
+
+    __slots__ = ("_mtx", "_value", "_samples")
+
+    def __init__(self):
+        self._mtx = threading.Lock()
+        self._value = 0.0
+        self._samples = 0
+
+    def observe(self, rtt_s: float) -> None:
+        with self._mtx:
+            self._samples += 1
+            if self._samples == 1:
+                self._value = rtt_s
+            else:
+                self._value += self.ALPHA * (rtt_s - self._value)
+
+    def value(self) -> float | None:
+        """The smoothed RTT in seconds; None before any sample (the
+        relay hold then keeps its constant fallback)."""
+        with self._mtx:
+            return self._value if self._samples else None
+
+
 def peer_metrics(reg: "telemetry.Registry | None" = None) -> dict:
     """Create-or-get the p2p_peer_* families on `reg` (default: the
     process-wide registry). The built dict is cached on the registry
@@ -145,6 +176,9 @@ def peer_metrics(reg: "telemetry.Registry | None" = None) -> dict:
             labelnames=p,
         ),
     }
+    # not an instrument: the cross-peer RTT smoother rides the same
+    # cache so reactors sharing the registry read one EWMA (round 21)
+    fams["ping_rtt_ewma"] = RttEwma()
     setattr(reg, _CACHE_ATTR, fams)
     return fams
 
@@ -181,7 +215,7 @@ class PeerConnMetrics:
     __slots__ = ("peer_id", "_send_bytes", "_recv_bytes", "_send_msgs",
                  "_recv_msgs", "_send_failures", "_send_queue",
                  "_send_queue_hw", "_hw", "_hw_mtx", "_ping_rtt",
-                 "_ping_sent_at")
+                 "_rtt_ewma", "_ping_sent_at")
 
     def __init__(self, peer_id: str, channel_ids, reg=None):
         fams = peer_metrics(reg)
@@ -203,6 +237,7 @@ class PeerConnMetrics:
         self._hw = {ch: 0 for ch in channel_ids}
         self._hw_mtx = threading.Lock()
         self._ping_rtt = fams["ping_rtt"].labels(peer=peer_id)
+        self._rtt_ewma = fams["ping_rtt_ewma"]
         self._ping_sent_at = 0.0
 
     # -- send side ---------------------------------------------------------
@@ -251,5 +286,7 @@ class PeerConnMetrics:
 
     def pong_received(self) -> None:
         if self._ping_sent_at > 0:
-            self._ping_rtt.observe(time.monotonic() - self._ping_sent_at)
+            rtt = time.monotonic() - self._ping_sent_at
+            self._ping_rtt.observe(rtt)
+            self._rtt_ewma.observe(rtt)
             self._ping_sent_at = 0.0

@@ -38,6 +38,7 @@ from tendermint_tpu import devd
 from tendermint_tpu.crypto import ed25519 as ed
 from tendermint_tpu.ops import faults
 from tendermint_tpu.ops.faults import (
+    DaemonFleet,
     DaemonSupervisor,
     Fault,
     FaultPlan,
@@ -54,6 +55,7 @@ def chaos_env(monkeypatch, tmp_path):
     the per-test daemon socket path."""
     sock = str(tmp_path / "devd.sock")
     monkeypatch.setenv("TENDERMINT_DEVD_SOCK", sock)
+    monkeypatch.delenv("TENDERMINT_DEVD_SOCKS", raising=False)
     monkeypatch.setenv("TENDERMINT_TPU_KERNEL", "devd")
     monkeypatch.setenv("TENDERMINT_TPU_BREAKER_BACKOFF_S", "0.05")
     monkeypatch.setenv("TENDERMINT_TPU_BREAKER_BACKOFF_CAP_S", "0.25")
@@ -61,7 +63,7 @@ def chaos_env(monkeypatch, tmp_path):
     monkeypatch.setenv("TENDERMINT_DEVD_CLAIM_TIMEOUT_S", "10")
     monkeypatch.setenv("TENDERMINT_DEVD_STREAM_TIMEOUT_S", "10")
     import tendermint_tpu.ops.devd_backend as backend
-    from tendermint_tpu.ops import gateway
+    from tendermint_tpu.ops import devd_shard, gateway
 
     monkeypatch.setattr(backend, "_client", None)
     # the module-level default gateway instances are process-global;
@@ -72,10 +74,12 @@ def chaos_env(monkeypatch, tmp_path):
     monkeypatch.setattr(gateway, "_default_hasher", None)
     backend.reset_stream_latches()
     gateway.reset_devd_breaker()
+    devd_shard.reset()
     devd.bust_avail_cache()
     yield sock
     devd.set_socket_wrapper(None)
     gateway.reset_devd_breaker()
+    devd_shard.reset()
     backend.reset_stream_latches()
     devd.bust_avail_cache()
 
@@ -699,6 +703,149 @@ def test_chaos_soak_20_blocks_with_corruption(chaos_env, tmp_path):
     finally:
         proxy.stop()
         sup.stop()
+
+
+# -- sharded device plane chaos matrix (round 21 — ISSUE 17) ------------------
+#
+# Wrong-LENGTH signatures mark the forged lanes (sim daemons verify
+# structurally; the CPU fallback agrees they are invalid), and the
+# stream floor is raised so slices ride the single-shot op — the
+# streamed protocol's fixed-width frames reject malformed lanes with an
+# error instead of a verdict.
+
+
+def _forge_len(items, idx):
+    for i in idx:
+        p, m, s = items[i]
+        items[i] = (p, m, s[:10])
+    return items
+
+
+def test_shard_kill_one_of_n_mid_burst(chaos_env, tmp_path, monkeypatch):
+    """Matrix row: SIGKILL one of 3 endpoints during a verify burst.
+    Every batch in the burst answers exact per-lane verdicts (the dead
+    endpoint's slices re-dispatch to healthy ones), the redispatch
+    counter moves, and the plane never falls to the CPU floor."""
+    from tendermint_tpu.ops import devd_shard, gateway
+
+    monkeypatch.setenv("TENDERMINT_DEVD_STREAM_MIN", "100000")
+    monkeypatch.setenv("TENDERMINT_TPU_MIN_BATCH", "8")
+    fleet = DaemonFleet(3, sock_dir=str(tmp_path), extra_env=SIM_ENV)
+    fleet.start()
+    monkeypatch.setenv("TENDERMINT_DEVD_SOCKS", fleet.socks_env)
+    try:
+        items = _forge_len(_items(96, tag=b"kill1"), [13, 71])
+        want = [i not in (13, 71) for i in range(96)]
+        for _ in range(3):
+            assert devd_shard.verify_batch(items) == want
+        fleet.kill(0)
+        dead = fleet.sock_paths[0]
+        for _ in range(6):  # the burst continues across the death
+            assert devd_shard.verify_batch(items) == want
+        st = devd_shard.endpoint_stats()
+        assert st[dead]["redispatches"] >= 1, st
+        # capacity degraded, plane alive: the two healthy endpoints
+        # absorbed the work and no breaker but the dead one's moved
+        assert gateway.devd_plane_allow()
+        for path in fleet.sock_paths[1:]:
+            assert st[path]["breaker_state"] == 0, st
+            assert st[path]["dispatched_slices"] >= 1, st
+    finally:
+        fleet.stop()
+
+
+def test_shard_all_breakers_open_falls_to_host_floor(chaos_env, tmp_path,
+                                                     monkeypatch):
+    """Matrix row: the plane serves sharded, then the WHOLE fleet dies
+    -> every breaker opens -> the hash plane serves byte-identical host
+    digests and the verify plane correct CPU verdicts; counters prove
+    both the open breakers and the fallback actually happened."""
+    from tendermint_tpu.crypto.hashing import ripemd160
+    from tendermint_tpu.ops import devd_shard, gateway
+
+    monkeypatch.setenv("TENDERMINT_DEVD_STREAM_MIN", "100000")
+    monkeypatch.setenv("TENDERMINT_TPU_MIN_BATCH", "8")
+    monkeypatch.setenv("TENDERMINT_TPU_BREAKER_FAILURES", "1")
+    monkeypatch.setenv("TENDERMINT_TPU_HASHES", "1")
+    monkeypatch.delenv("TENDERMINT_DEVD_SOCK", raising=False)
+    fleet = DaemonFleet(2, sock_dir=str(tmp_path), extra_env=SIM_ENV)
+    fleet.start()
+    monkeypatch.setenv("TENDERMINT_DEVD_SOCKS", fleet.socks_env)
+    devd.bust_avail_cache()
+    try:
+        v = gateway.Verifier(min_tpu_batch=1)
+        h = gateway.Hasher(min_tpu_batch=1, use_tpu=True)
+        assert h._route == "devd"
+        items = _forge_len(_items(24, tag=b"floor"), [4])
+        parts = [bytes([i]) * 600 for i in range(20)]
+        want_digests = [ripemd160(p) for p in parts]
+        assert v.verify_batch(items) == [i != 4 for i in range(24)]
+        assert h.part_leaf_hashes(parts) == want_digests
+        assert devd_shard.plane_stats()["dispatched_slices"] >= 1
+
+        fleet.kill(0)
+        fleet.kill(1)
+        # first post-death batches eat the endpoint failures (threshold
+        # 1 -> both breakers open) and fall back; verdicts stay exact
+        assert v.verify_batch(items) == [i != 4 for i in range(24)]
+        assert h.part_leaf_hashes(parts) == want_digests
+        states = gateway.devd_breaker_states()
+        assert all(states[s] == 2 for s in fleet.sock_paths), states
+        assert not gateway.devd_plane_allow()
+        # the floor is the steady state now — still correct, still counted
+        assert v.verify_batch(items) == [i != 4 for i in range(24)]
+        assert v.stats()["cpu_sigs"] >= 24
+        assert h.part_leaf_hashes(parts) == want_digests
+        assert h.stats()["cpu_leaves"] >= len(parts)
+    finally:
+        fleet.stop()
+
+
+def test_shard_flapping_endpoint_breaker_storm(chaos_env, tmp_path,
+                                               monkeypatch):
+    """Matrix row: one endpoint flaps (kill/restart churn) beside a
+    healthy one, with tight breaker windows forcing a half-open probe
+    storm. Verdicts stay exact through every flap; the flapper's breaker
+    demonstrably opened AND probed; once the flapping stops the breaker
+    re-closes and the endpoint serves slices again."""
+    from tendermint_tpu.ops import devd_shard, gateway
+
+    monkeypatch.setenv("TENDERMINT_DEVD_STREAM_MIN", "100000")
+    monkeypatch.setenv("TENDERMINT_TPU_MIN_BATCH", "8")
+    monkeypatch.setenv("TENDERMINT_TPU_BREAKER_FAILURES", "1")
+    fleet = DaemonFleet(2, sock_dir=str(tmp_path), extra_env=SIM_ENV)
+    fleet.start()
+    monkeypatch.setenv("TENDERMINT_DEVD_SOCKS", fleet.socks_env)
+    flapper = fleet.sock_paths[0]
+    try:
+        items = _forge_len(_items(64, tag=b"flap"), [31])
+        want = [i != 31 for i in range(64)]
+        assert devd_shard.verify_batch(items) == want
+        fleet.supervisors[0].churn(down_s=0.15, up_s=0.25, cycles=3)
+        deadline = time.monotonic() + 20.0
+        br = gateway.devd_breaker(flapper)
+        while fleet.supervisors[0].kills < 3:
+            assert time.monotonic() < deadline, "churn never completed"
+            assert devd_shard.verify_batch(items) == want
+            time.sleep(0.02)
+        fleet.supervisors[0].stop_churn(ensure_up=True)
+        st = br.stats()
+        assert st["breaker_opens"] >= 1, st
+        assert st["breaker_probes"] >= 1, st
+        # recovery: the flapper re-closes and takes work again
+        deadline = time.monotonic() + 10.0
+        while br.state != br.CLOSED:
+            assert time.monotonic() < deadline, "flapper never re-closed"
+            assert devd_shard.verify_batch(items) == want
+            time.sleep(0.05)
+        before = devd_shard.endpoint_stats()[flapper]["dispatched_slices"]
+        deadline = time.monotonic() + 10.0
+        while devd_shard.endpoint_stats()[flapper][
+                "dispatched_slices"] == before:
+            assert time.monotonic() < deadline, "flapper never re-served"
+            assert devd_shard.verify_batch(items) == want
+    finally:
+        fleet.stop()
 
 
 def test_labeled_reconnect_counters_split_paths(chaos_env):

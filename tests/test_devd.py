@@ -117,7 +117,7 @@ def test_gateway_default_routes_through_daemon(daemon, monkeypatch):
     from tendermint_tpu.ops import gateway
 
     monkeypatch.setattr(backend, "_client", None)
-    devd._avail_cache.update(t=0.0)  # bust the TTL cache for the new path
+    devd.bust_avail_cache()  # bust the TTL cache for the new path
     assert gateway.kernel_name() == "devd"
 
     before = client.stats().get("tpu_sigs", 0) + client.stats().get("cpu_sigs", 0)
@@ -343,12 +343,12 @@ def test_second_daemon_refuses_live_socket(daemon):
 def test_available_requires_held_device(daemon, monkeypatch, tmp_path):
     sock, _ = daemon
     monkeypatch.setenv("TENDERMINT_DEVD_SOCK", sock)
-    devd._avail_cache.update(t=0.0)
+    devd.bust_avail_cache()
     rep = devd.available()
     assert rep is not None and rep["held"]
     # no socket -> unavailable (and the gateway default falls back)
     monkeypatch.setenv("TENDERMINT_DEVD_SOCK", str(tmp_path / "absent.sock"))
-    devd._avail_cache.update(t=0.0)
+    devd.bust_avail_cache()
     assert devd.available() is None
 
 
@@ -399,7 +399,7 @@ def test_resolve_platform_waits_out_claiming_daemon(monkeypatch, tmp_path):
     monkeypatch.delenv("TENDERMINT_TPU_PLATFORM", raising=False)
     monkeypatch.setitem(gateway._platform_cache, "v", None)
     gateway._platform_cache.pop("v")
-    devd._avail_cache.update(t=0.0)
+    devd.bust_avail_cache()
     assert gateway.resolve_platform() == "tpu"
     assert state["pings"] >= 3  # it actually polled through "warming"
     gateway._platform_cache.pop("v", None)

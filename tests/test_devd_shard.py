@@ -1,0 +1,293 @@
+"""Sharded device plane (round 21 — ISSUE 17): N devd daemons behind one
+gateway, work-stealing dispatch, per-endpoint circuit breakers.
+
+Unit rows cover the pure pieces (endpoint parsing, slice planning, the
+keyed breaker registry's single-socket back-compat); the process rows
+run REAL sim-rate daemons (ops/faults.DaemonFleet — separate processes,
+real sockets) and assert the tentpole's contracts: per-lane verdict
+attribution survives slicing AND re-dispatch, a slow endpoint's residue
+is stolen by fast ones, digests stay byte-identical to host hashing,
+and the gateway's prime/pop plane rides sharded dispatch unchanged.
+
+Sim daemons verify STRUCTURALLY (len(pk)==32 and len(sig)==64 —
+devd._SimVerifier), so forged lanes here are wrong-LENGTH lanes: the
+CPU ed25519 fallback agrees they are invalid, making every assertion
+fallback-proof. Sim hashing is REAL digests, so hash parity is real.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from tendermint_tpu import devd
+from tendermint_tpu.crypto import ed25519 as ed
+from tendermint_tpu.ops.faults import DaemonFleet
+
+SIM_ENV = {"TENDERMINT_DEVD_SIM_RATE": "200000"}
+
+
+@pytest.fixture()
+def shard_env(monkeypatch, tmp_path):
+    """Clean sharded-plane state: fast breaker windows, low slice floor,
+    no inherited endpoint config; resets the endpoint table + keyed
+    breaker registry around the test."""
+    monkeypatch.delenv("TENDERMINT_DEVD_SOCKS", raising=False)
+    monkeypatch.delenv("TENDERMINT_DEVD_SOCK", raising=False)
+    monkeypatch.setenv("TENDERMINT_TPU_KERNEL", "devd")
+    monkeypatch.setenv("TENDERMINT_TPU_MIN_BATCH", "8")
+    monkeypatch.setenv("TENDERMINT_TPU_BREAKER_BACKOFF_S", "0.05")
+    monkeypatch.setenv("TENDERMINT_TPU_BREAKER_BACKOFF_CAP_S", "0.25")
+    # leave TENDERMINT_DEVD_STREAM_MIN at its 256 default: slices here
+    # are narrower, so they ride the single-shot op — whose sim verdicts
+    # are structural, letting wrong-LENGTH lanes mark forgeries (the
+    # streamed protocol's fixed-width frames reject those lanes outright)
+    monkeypatch.delenv("TENDERMINT_DEVD_STREAM_MIN", raising=False)
+    import tendermint_tpu.ops.devd_backend as backend
+    from tendermint_tpu.ops import devd_shard, gateway
+
+    monkeypatch.setattr(backend, "_client", None)
+    monkeypatch.setattr(gateway, "_default_verifier", None)
+    monkeypatch.setattr(gateway, "_default_hasher", None)
+    backend.reset_stream_latches()
+    gateway.reset_devd_breaker()
+    devd_shard.reset()
+    devd.bust_avail_cache()
+    yield monkeypatch
+    gateway.reset_devd_breaker()
+    devd_shard.reset()
+    backend.reset_stream_latches()
+    devd.bust_avail_cache()
+
+
+def _items(n: int, tag: bytes = b"shard"):
+    seed = b"\x2a" * 32
+    pub = ed.public_key(seed)
+    return [
+        (pub, tag + b"-%d" % i, ed.sign(seed, tag + b"-%d" % i))
+        for i in range(n)
+    ]
+
+
+def _forge(items, idx):
+    """Wrong-length signature: structurally invalid to the sim verifier
+    AND cryptographically invalid to the CPU fallback."""
+    for i in idx:
+        p, m, s = items[i]
+        items[i] = (p, m, s[:10])
+    return items
+
+
+# -- pure units ---------------------------------------------------------------
+
+
+def test_endpoint_paths_parsing(shard_env):
+    from tendermint_tpu.ops import devd_shard
+
+    mp = shard_env
+    mp.setenv("TENDERMINT_DEVD_SOCKS", " /a.sock , /b.sock,/a.sock,, ")
+    assert devd_shard.endpoint_paths() == ["/a.sock", "/b.sock"]
+    assert devd_shard.enabled()
+    # one entry: byte-for-byte the single-socket plane — not enabled
+    mp.setenv("TENDERMINT_DEVD_SOCKS", "/only.sock")
+    assert devd_shard.endpoint_paths() == ["/only.sock"]
+    assert not devd_shard.enabled()
+    # and sock_path() itself resolves the single SOCKS entry
+    mp.delenv("TENDERMINT_DEVD_SOCK", raising=False)
+    assert devd.sock_path() == "/only.sock"
+    # explicit SOCK wins over the fleet list
+    mp.setenv("TENDERMINT_DEVD_SOCK", "/pinned.sock")
+    assert devd.sock_path() == "/pinned.sock"
+    # unset: the default fallback
+    mp.delenv("TENDERMINT_DEVD_SOCKS", raising=False)
+    mp.delenv("TENDERMINT_DEVD_SOCK", raising=False)
+    assert devd_shard.endpoint_paths() == [devd.DEFAULT_SOCK]
+    assert not devd_shard.enabled()
+
+
+def test_plan_slices_respects_floor_and_balance():
+    from tendermint_tpu.ops.devd_shard import _plan_slices
+
+    # wide batch, 2 workers: ~2 slices each
+    assert _plan_slices(64, 2, 8) == [(0, 16), (16, 32), (32, 48), (48, 64)]
+    # the floor caps slice count: 20 lanes / floor 8 -> 2 slices, not 4
+    assert _plan_slices(20, 2, 8) == [(0, 10), (10, 20)]
+    # narrower than the floor: one slice, never zero
+    assert _plan_slices(5, 4, 8) == [(0, 5)]
+    # uneven remainder spreads one lane at a time, coverage exact
+    slices = _plan_slices(67, 3, 4)
+    assert slices[0] == (0, 12) and slices[-1][1] == 67
+    assert all(b == c for (_, b), (c, _) in zip(slices, slices[1:]))
+    assert all(stop - start >= 4 for start, stop in slices)
+
+
+def test_breaker_registry_keyed_and_backcompat(shard_env):
+    from tendermint_tpu.ops import gateway
+
+    # no-arg call == primary-socket call: the five legacy import sites
+    # keep observing the same breaker object
+    assert gateway.devd_breaker() is gateway.devd_breaker(devd.sock_path())
+    a = gateway.devd_breaker("/a.sock")
+    b = gateway.devd_breaker("/b.sock")
+    assert a is not b and a is gateway.devd_breaker("/a.sock")
+    a.record_failure()
+    states = gateway.devd_breaker_states()
+    assert set(states) >= {"/a.sock", "/b.sock"}
+    assert states["/b.sock"] == 0
+    gateway.reset_devd_breaker()
+    assert gateway.devd_breaker_states() == {}
+
+
+# -- real fleet rows ----------------------------------------------------------
+
+
+@pytest.fixture()
+def fleet2(shard_env, tmp_path):
+    fleet = DaemonFleet(2, sock_dir=str(tmp_path), extra_env=SIM_ENV)
+    fleet.start()
+    shard_env.setenv("TENDERMINT_DEVD_SOCKS", fleet.socks_env)
+    yield fleet
+    fleet.stop()
+
+
+def test_sharded_verify_per_lane_attribution(fleet2):
+    from tendermint_tpu.ops import devd_shard
+
+    assert devd_shard.enabled()
+    items = _forge(_items(64), [5, 17, 40, 63])
+    got = devd_shard.verify_batch(items)
+    assert [i for i, ok in enumerate(got) if not ok] == [5, 17, 40, 63]
+    st = devd_shard.endpoint_stats()
+    assert len(st) == 2
+    assert sum(d["dispatched_slices"] for d in st.values()) >= 2
+    assert sum(d["sigs"] for d in st.values()) == 64
+    # both endpoints actually participated
+    assert all(d["dispatched_slices"] >= 1 for d in st.values())
+
+
+def test_work_stealing_from_slow_endpoint(shard_env, tmp_path):
+    """Asymmetric fleet — one endpoint 4000x slower than the other. The
+    fast endpoint must finish its own slices and STEAL the slow one's
+    residue; the batch completes at fleet speed and the stolen-slice
+    counter moves on the fast endpoint."""
+    from tendermint_tpu.ops import devd_shard
+
+    slow = DaemonFleet(1, sock_dir=str(tmp_path),
+                       extra_env={"TENDERMINT_DEVD_SIM_RATE": "50"})
+    fast = DaemonFleet(1, sock_dir=str(tmp_path),
+                       extra_env={"TENDERMINT_DEVD_SIM_RATE": "200000"})
+    slow.start()
+    fast.start()
+    try:
+        shard_env.setenv(
+            "TENDERMINT_DEVD_SOCKS",
+            ",".join([slow.sock_paths[0], fast.sock_paths[0]]),
+        )
+        # floor 8, 64 lanes, 2 workers -> 4 slices of 16: the slow
+        # endpoint's first slice alone takes 16/50 = 0.32 s, so the fast
+        # one drains its own two and steals at least one
+        items = _forge(_items(64), [9])
+        t0 = time.monotonic()
+        got = devd_shard.verify_batch(items)
+        dt = time.monotonic() - t0
+        assert [i for i, ok in enumerate(got) if not ok] == [9]
+        st = devd_shard.endpoint_stats()
+        assert st[fast.sock_paths[0]]["stolen_slices"] >= 1, st
+        # fleet speed, not slowest-member speed: 64 lanes at rate 50
+        # would be 1.28 s on the slow chip alone
+        assert dt < 1.2, f"batch gated on the slow endpoint ({dt:.2f}s)"
+        assert devd_shard.plane_stats()["stolen_slices"] >= 1
+    finally:
+        slow.stop()
+        fast.stop()
+
+
+def test_sharded_hash_parity_and_tree(fleet2):
+    from tendermint_tpu.crypto.hashing import ripemd160
+    from tendermint_tpu.merkle.simple import flat_tree_from_leaf_digests
+    from tendermint_tpu.ops import devd_shard
+
+    parts = [bytes([i % 251]) * 700 for i in range(32)]
+    assert devd_shard.hash_batch(parts) == [ripemd160(p) for p in parts]
+    digests, internal = devd_shard.hash_tree(parts)
+    want = flat_tree_from_leaf_digests([ripemd160(p) for p in parts])
+    assert digests == [ripemd160(p) for p in parts]
+    assert internal == want.internal_nodes()
+
+
+def test_gateway_verifier_rides_sharded_plane(fleet2):
+    """The production entry point: a devd-routed Verifier's batches shard
+    across the fleet (both endpoints' counters move), verdict order is
+    preserved, and the prime/pop pipeline works unchanged."""
+    from tendermint_tpu.ops import devd_shard, gateway
+
+    v = gateway.Verifier(min_tpu_batch=1)
+    assert v._kernel == "devd"
+    items = _forge(_items(48, tag=b"gw"), [7, 33])
+    assert v.verify_batch(items) == [i not in (7, 33) for i in range(48)]
+    st = devd_shard.endpoint_stats()
+    # the gateway screens the 2 wrong-length lanes to its CPU path
+    # (non-ed25519 shape); the 46 well-formed lanes sharded
+    assert sum(d["sigs"] for d in st.values()) == 46
+    assert all(d["dispatched_slices"] >= 1 for d in st.values()), st
+
+    # prime plane: dispatch async, pop per-item verdicts
+    primed = _forge(_items(32, tag=b"prime"), [3])
+    v.prime_cache_async(primed)
+    assert v.pop_primed(primed[3]) is False
+    assert v.pop_primed(primed[4]) is True
+    assert v.pop_primed(primed[4]) is None  # single-use
+
+    # devd-routed counters moved; the fleet-summed transport stats fold
+    # into the same flat surface the single-socket plane exports
+    vs = v.stats()
+    assert vs["tpu_sigs"] >= 48
+    assert any(k.startswith("stream") for k in vs), sorted(vs)
+
+
+def test_kill_one_endpoint_mid_batch_redispatches(fleet2):
+    """The tentpole's failure contract at the dispatcher level: SIGKILL
+    one daemon, dispatch — the failed slices re-dispatch to the healthy
+    endpoint, every lane still gets the CORRECT verdict, and the dead
+    endpoint's breaker took the failure accounting."""
+    from tendermint_tpu.ops import devd_shard, gateway
+
+    items = _forge(_items(64, tag=b"kill"), [11, 50])
+    assert devd_shard.verify_batch(items) == [
+        i not in (11, 50) for i in range(64)
+    ]
+    fleet2.kill(0)
+    dead = fleet2.sock_paths[0]
+    got = devd_shard.verify_batch(items)
+    assert got == [i not in (11, 50) for i in range(64)]
+    st = devd_shard.endpoint_stats()
+    assert st[dead]["redispatches"] >= 1, st
+    assert gateway.devd_breaker(dead).stats()[
+        "breaker_consecutive_failures"] >= 1
+    # plane still allows: one healthy endpoint is capacity, not death
+    assert gateway.devd_plane_allow()
+
+
+def test_all_endpoints_dead_raises_to_cpu_floor(shard_env, tmp_path):
+    """Every breaker open -> the dispatcher refuses (DevdShardError) and
+    the gateway Verifier serves correct verdicts on the CPU floor —
+    the whole plane degrades only when the entire fleet is gone."""
+    from tendermint_tpu.ops import devd_shard, gateway
+
+    socks = [str(tmp_path / "gone-0.sock"), str(tmp_path / "gone-1.sock")]
+    shard_env.setenv("TENDERMINT_DEVD_SOCKS", ",".join(socks))
+    shard_env.setenv("TENDERMINT_TPU_BREAKER_FAILURES", "1")
+    items = _forge(_items(24, tag=b"floor"), [2])
+    v = gateway.Verifier(min_tpu_batch=1, use_tpu=True)
+    # first batch eats the endpoint failures (opening both breakers) and
+    # falls back; verdicts are correct throughout
+    assert v.verify_batch(items) == [i != 2 for i in range(24)]
+    states = gateway.devd_breaker_states()
+    assert all(states[s] == 2 for s in socks), states
+    assert not gateway.devd_plane_allow()
+    with pytest.raises(devd_shard.DevdShardError):
+        devd_shard.verify_batch(items)
+    # still serving on the floor
+    assert v.verify_batch(items) == [i != 2 for i in range(24)]
+    assert v.stats()["cpu_sigs"] >= 24

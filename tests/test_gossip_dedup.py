@@ -318,6 +318,70 @@ def test_relay_screen_holds_fresh_votes_only():
     assert r_off._relay_ready(_V())  # pre-round-20 gossip: no hold
 
 
+def test_adaptive_relay_delay_clamp_and_fallback():
+    """Round 21 satellite: the lazy-relay hold tracks 2x the smoothed
+    peer RTT, clamped to [0.5x, 4x] of the constant; no samples keeps
+    the constant exactly."""
+    from tendermint_tpu.consensus.reactor import (
+        VOTE_RELAY_DELAY,
+        VOTE_RELAY_DELAY_MAX,
+        VOTE_RELAY_DELAY_MIN,
+        adaptive_relay_delay,
+    )
+
+    assert VOTE_RELAY_DELAY_MIN == pytest.approx(0.5 * VOTE_RELAY_DELAY)
+    assert VOTE_RELAY_DELAY_MAX == pytest.approx(4.0 * VOTE_RELAY_DELAY)
+    # no samples: the constant, byte-for-byte
+    assert adaptive_relay_delay(None) == VOTE_RELAY_DELAY
+    # fast LAN: clamps at the floor, never disables the hold
+    assert adaptive_relay_delay(0.0005) == VOTE_RELAY_DELAY_MIN
+    assert adaptive_relay_delay(0.0) == VOTE_RELAY_DELAY_MIN
+    # mid-range: tracks 2x RTT
+    assert adaptive_relay_delay(0.08) == pytest.approx(0.16)
+    # slow WAN / garbage sample: clamps at the ceiling
+    assert adaptive_relay_delay(1.5) == VOTE_RELAY_DELAY_MAX
+
+
+def test_reactor_relay_delay_reads_rtt_ewma():
+    """The reactor's hold: constant with no switch, no registry, or no
+    samples; RTT-adaptive once the switch's registry carries ping
+    samples (fed by PeerConnMetrics.pong_received)."""
+    from tendermint_tpu.consensus.reactor import VOTE_RELAY_DELAY
+    from tendermint_tpu.libs import telemetry
+    from tendermint_tpu.p2p.telemetry import peer_metrics
+
+    r = ConsensusReactor(_ConState(gossip_dedup=True))
+    assert r._relay_delay() == VOTE_RELAY_DELAY  # no switch at all
+
+    class _Switch:
+        metrics_registry = None
+
+    r.switch = _Switch()
+    assert r._relay_delay() == VOTE_RELAY_DELAY  # switch, no registry
+
+    reg = telemetry.Registry()  # fresh: no cross-test samples
+    r.switch.metrics_registry = reg
+    assert r._relay_delay() == VOTE_RELAY_DELAY  # registry, no samples
+
+    peer_metrics(reg)["ping_rtt_ewma"].observe(0.08)
+    assert r._relay_delay() == pytest.approx(0.16)
+    # EWMA moves with new samples, and the clamp still rules
+    for _ in range(64):
+        peer_metrics(reg)["ping_rtt_ewma"].observe(5.0)
+    assert r._relay_delay() == pytest.approx(4.0 * VOTE_RELAY_DELAY)
+
+
+def test_rtt_ewma_smoothing():
+    from tendermint_tpu.p2p.telemetry import RttEwma
+
+    e = RttEwma()
+    assert e.value() is None
+    e.observe(0.1)
+    assert e.value() == pytest.approx(0.1)  # first sample seeds exactly
+    e.observe(0.2)
+    assert e.value() == pytest.approx(0.1 + 0.2 * (0.2 - 0.1))
+
+
 def test_vote_recv_stamp_is_bounded():
     """The stamp map self-prunes on overflow — entries only matter for
     one gossip tick, so unbounded growth would be a leak, not memory."""

@@ -311,6 +311,12 @@ METRICS_REQUIRED_KEYS = (
     "gateway_hash_tpu_part_batches", "gateway_hash_tpu_leaves",
     "gateway_hash_cpu_leaves", "gateway_hash_tx_root_cache_hits",
     "gateway_hash_batch_bytes", "gateway_hash_stream_batches",
+    # sharded device plane (round 21): the flat aggregates over the
+    # labeled gateway_endpoint_* families — stable in single-socket
+    # mode (count=1) so the contract holds without a fleet
+    "gateway_endpoints_count", "gateway_endpoints_healthy",
+    "gateway_endpoints_dispatched_slices", "gateway_endpoints_stolen_slices",
+    "gateway_endpoints_redispatches", "gateway_endpoints_outstanding",
 )
 
 
@@ -385,7 +391,13 @@ def test_prometheus_exposition_endpoint(node):
                 "statesync_offerer_bans_forged",
                 "statesync_offerer_bans_corrupt",
                 "statesync_offerer_bans_stall",
-                "fastsync_below_horizon_fallbacks"):
+                "fastsync_below_horizon_fallbacks",
+                # round 21: per-endpoint device-plane gauges (labeled by
+                # endpoint socket; one child per configured endpoint even
+                # in single-socket mode)
+                "gateway_endpoint_outstanding",
+                "gateway_endpoint_breaker_state",
+                "gateway_endpoint_sigs_per_s"):
         assert fam in families, fam
         assert families[fam] == "gauge"
     # round 18: the secret-connection transport counters, incl. the
@@ -406,7 +418,12 @@ def test_prometheus_exposition_endpoint(node):
                 "p2p_peer_vote_gossip_sends_total",
                 "p2p_peer_vote_gossip_send_failures_total",
                 "p2p_peer_catchup_commits_total",
-                "p2p_peer_vote_duplicates_total"):
+                "p2p_peer_vote_duplicates_total",
+                # round 21: per-endpoint dispatch accounting on the
+                # sharded device plane
+                "gateway_endpoint_dispatched_slices_total",
+                "gateway_endpoint_stolen_slices_total",
+                "gateway_endpoint_redispatches_total"):
         assert families.get(fam) == "counter", fam
     # the latency-distribution instruments render as real histograms
     for fam in ("devd_stream_chunk_seconds", "devd_single_shot_seconds",
