@@ -30,11 +30,16 @@ Three row families:
                        (native AVX on the CPU floor, streamed devd when
                        a daemon serves — the live row joins the standard
                        tunnel-window queue).
-- aggregate N=...    — the aggregate-commit prototype (types/agg_commit):
-                       wire bytes of the full Commit vs the
-                       half-aggregated object (asserted < 0.6x at every
-                       N; ~0.22x at 400), aggregate verify latency, and
-                       a verification round trip.
+- aggregate N=...    — the aggregate-commit format (types/agg_commit;
+                       the round-22 cutover's wire object,
+                       docs/upgrade.md): wire bytes of the full Commit
+                       vs the half-aggregated object (asserted < 0.6x
+                       at every N; ~0.22x at 400), a verification
+                       round trip, and the round-22 verify-latency A/B
+                       (`verify_s` gateway-batched vs
+                       `verify_python_s` pure reference vs
+                       `full_per_sig_s` — the per-sig loop the cutover
+                       retires).
 
 Chip-free by construction on this box; the consensus and commit-verify
 batched rows ride whatever the gateway resolves (devd rows auto-join
@@ -324,6 +329,7 @@ def _commit_verify_rows():
 
 
 def _aggregate_rows():
+    from tendermint_tpu.crypto import ed25519_agg
     from tendermint_tpu.types.agg_commit import AggregateCommit
 
     rows = []
@@ -332,9 +338,20 @@ def _aggregate_rows():
         t0 = time.perf_counter()
         agg = AggregateCommit.from_commit(commit, CHAIN_ID, vals)
         agg_build_s = time.perf_counter() - t0
+        # round 22: the verify-latency A/B the cutover rides — the same
+        # aggregate through the gateway-batched dual-scalar-mul path
+        # (devd/sharded/direct kernel, CPU floor included) vs the
+        # pure-python reference, next to the full commit's per-sig loop
         t0 = time.perf_counter()
-        agg.verify(CHAIN_ID, vals)
+        agg.verify(CHAIN_ID, vals)  # gateway-batched (default verifier)
         agg_verify_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        agg.verify(CHAIN_ID, vals,
+                   agg_verifier=ed25519_agg.verify_aggregate)
+        agg_verify_py_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        vals.verify_commit(CHAIN_ID, bid, height, commit)
+        full_per_sig_s = time.perf_counter() - t0
         commit_bytes = len(commit.to_bytes())
         agg_bytes = len(agg.to_bytes())
         ratio = agg_bytes / commit_bytes
@@ -352,6 +369,10 @@ def _aggregate_rows():
             "bytes_vs_full": round(ratio, 3),
             "aggregate_s": round(agg_build_s, 4),
             "verify_s": round(agg_verify_s, 4),
+            "verify_python_s": round(agg_verify_py_s, 4),
+            "full_per_sig_s": round(full_per_sig_s, 4),
+            "verify_vs_per_sig": round(full_per_sig_s / agg_verify_s, 2)
+            if agg_verify_s else 0.0,
             "platform": "host",
         })
     return rows

@@ -1,0 +1,258 @@
+"""Upgrade-at-height bench (round 22): the aggregate-commit cutover,
+measured on the wire and across a LIVE flip. Writes BENCH_r22.json.
+
+Row families:
+
+- flip:n=4          — the ops/localnet `upgrade` scenario: a real
+                      4-process fleet with `upgrade_height` baked into
+                      the shared genesis, a laggard SIGKILLed BEFORE
+                      the flip, the survivors crossing H without
+                      missing a height, one survivor rolled across the
+                      boundary, the laggard catching up THROUGH both
+                      formats. Per-height byte identity both sides of
+                      H and the upgrade_* scrape asserts live inside
+                      the scenario (ops/localnet.py). The full run
+                      additionally polls node0's public RPC during the
+                      flip and reports `flip_stall_x` — the commit
+                      interval AT height H over the median interval of
+                      the surrounding heights (the "zero missed
+                      heights" claim, quantified: a consensus-rule
+                      cutover that stalled would spike this number).
+- wire:n=100/400    — the cutover's object-level payoff: wire bytes of
+                      the full Commit vs the half-aggregated
+                      AggregateCommit over the same signed precommits
+                      (ASSERTED <= 0.35x at n=100; measured ~0.25x),
+                      and the verify-latency A/B the block plane rides
+                      after the flip — full per-sig loop vs the
+                      gateway-batched dual-scalar-mul aggregate verify
+                      vs the pure-python reference. `gateway faster
+                      than python` is asserted ONLY when the gateway
+                      actually took a device lane (verifier stats
+                      `agg_lanes_device` > 0): on a chip-free box the
+                      gateway's CPU floor IS the pure-python verifier
+                      (ops/gateway.py), so the two rows measure the
+                      same code plus dispatch overhead — asserting an
+                      ordering there would be noise, not signal (the
+                      BENCHES.cpu-fallback.json precedent).
+
+Asserted floors (chip-free — this gates `make upgrade-smoke` in tier1):
+- the upgrade scenario converges byte-identically through the flip with
+  the laggard recovering (scenario-internal asserts)
+- zero schedule refusals inside the homogeneous fleet
+- full run: aggregate commit bytes <= 0.35x full at n=100
+
+BENCH_UPGRADE_SMOKE=1 shrinks to the one 4-node flip run (~60-90 s)
+for the tier-1 gate. Prints ONE JSON line like the other benches;
+writes BENCH_r22.json on full runs. Run from the repo root:
+python benches/bench_upgrade.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+SMOKE = os.environ.get("BENCH_UPGRADE_SMOKE", "") == "1"
+WIRE_VALS = [100] if SMOKE else [100, 400]
+MAX_BYTES_RATIO = float(os.environ.get("BENCH_UPGRADE_MAX_RATIO", "0.35"))
+GENESIS_NS = 1_700_000_000_000_000_000
+CHAIN_ID = "bench_upgrade"
+
+
+def _poll_heights(port: int, seen: dict, stop: threading.Event) -> None:
+    """Background poller: height -> first time observed, off node0's
+    public RPC. Best-effort — the node may not be up yet, and fast
+    commits can skip heights between polls; the consumer only uses
+    consecutive observations."""
+    body = json.dumps({
+        "jsonrpc": "2.0", "id": "bench", "method": "status", "params": {},
+    }).encode()
+    while not stop.is_set():
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=2.0) as resp:
+                out = json.loads(resp.read())
+            h = int(out["result"]["latest_block_height"])
+            if h > 0 and h not in seen:
+                seen[h] = time.monotonic()
+        except Exception:
+            pass
+        stop.wait(0.05)
+
+
+def _flip_stall(seen: dict, H: int):
+    """Commit interval at the flip height over the median interval of
+    every other consecutively-observed pair. None when the poller
+    missed either side of the boundary."""
+    dts = {}
+    for h in sorted(seen):
+        if h - 1 in seen:
+            dts[h] = seen[h] - seen[h - 1]
+    others = [dt for h, dt in dts.items() if h != H]
+    if H not in dts or not others:
+        return None
+    med = statistics.median(others)
+    return round(dts[H] / med, 2) if med > 0 else None
+
+
+def _flip_row(heights: int, measure_stall: bool) -> dict:
+    from tendermint_tpu.ops.localnet import LocalnetSpec, run_scenario
+
+    spec = LocalnetSpec(
+        n=4, root=tempfile.mkdtemp(prefix="bench-upgrade-"),
+        seed=22, base_port=47700, upgrade_height=4,
+    )
+    seen: dict = {}
+    stop = threading.Event()
+    poller = None
+    if measure_stall:
+        poller = threading.Thread(
+            target=_poll_heights, args=(spec.rpc_port(0), seen, stop),
+            daemon=True,
+        )
+        poller.start()
+    try:
+        t0 = time.perf_counter()
+        r = run_scenario(spec, "upgrade", heights=heights)
+        wall = time.perf_counter() - t0
+    finally:
+        stop.set()
+        if poller is not None:
+            poller.join(timeout=5.0)
+    assert r["agg_commit_rejects"] == 0, r
+    row = {
+        "row": "flip:n=4",
+        "nodes": 4,
+        "upgrade_height": r["upgrade_height"],
+        "converged_heights": r["converged_heights"],
+        "laggard_killed_at": r["laggard_killed_at"],
+        "agg_commits_proposed": r["agg_commits_proposed"],
+        "agg_commit_rejects": r["agg_commit_rejects"],
+        "wall_s": round(wall, 1),
+    }
+    if measure_stall:
+        row["flip_stall_x"] = _flip_stall(seen, r["upgrade_height"])
+    return row
+
+
+def _signed_commit(n, height=7):
+    """n seeded validators and a fully-signed precommit Commit — the
+    object both wire formats are built from."""
+    from tendermint_tpu.crypto.keys import gen_priv_key_ed25519
+    from tendermint_tpu.libs.db import MemDB
+    from tendermint_tpu.state.state import State
+    from tendermint_tpu.types import (
+        GenesisDoc, GenesisValidator, PrivValidatorFS,
+    )
+    from tendermint_tpu.types.block import Commit
+    from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+    from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT, Vote
+
+    pvs = []
+    for i in range(n):
+        seed = (b"upgrade-%05d" % i).ljust(32, b"\x00")
+        pvs.append(PrivValidatorFS(gen_priv_key_ed25519(seed), None))
+    pvs.sort(key=lambda pv: pv.get_address())
+    gvals = [GenesisValidator(pv.get_pub_key(), 10, f"v{i}")
+             for i, pv in enumerate(pvs)]
+    doc = GenesisDoc(genesis_time_ns=GENESIS_NS, chain_id=CHAIN_ID,
+                     validators=gvals)
+    vals = State.get_state(MemDB(), doc).validators
+    bid = BlockID(b"\x22" * 20, PartSetHeader(1, b"\x44" * 20))
+    pres = []
+    for i, pv in enumerate(pvs):
+        v = Vote(pv.get_address(), i, height, 0, VOTE_TYPE_PRECOMMIT, bid)
+        pres.append(pv.sign_vote(CHAIN_ID, v))
+    return vals, bid, Commit(bid, pres), height
+
+
+def _wire_rows() -> list:
+    from tendermint_tpu.crypto import ed25519_agg
+    from tendermint_tpu.ops.gateway import default_verifier
+    from tendermint_tpu.types.agg_commit import AggregateCommit
+
+    rows = []
+    for n in WIRE_VALS:
+        vals, bid, commit, height = _signed_commit(n)
+        agg = AggregateCommit.from_commit(commit, CHAIN_ID, vals)
+        commit_bytes = len(commit.to_bytes())
+        agg_bytes = len(agg.to_bytes())
+        ratio = agg_bytes / commit_bytes
+        if n == 100:
+            assert ratio <= MAX_BYTES_RATIO, (
+                f"post-cutover commit wire bytes {ratio:.3f}x full at "
+                f"n={n} (ceiling {MAX_BYTES_RATIO}x)"
+            )
+
+        dv = default_verifier()
+        lanes_before = dv.stats()["agg_lanes_device"]
+        t0 = time.perf_counter()
+        agg.verify(CHAIN_ID, vals)  # gateway-batched (default verifier)
+        gateway_s = time.perf_counter() - t0
+        device_lanes = dv.stats()["agg_lanes_device"] - lanes_before
+        t0 = time.perf_counter()
+        agg.verify(CHAIN_ID, vals,
+                   agg_verifier=ed25519_agg.verify_aggregate)
+        python_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        vals.verify_commit(CHAIN_ID, bid, height, commit)
+        per_sig_s = time.perf_counter() - t0
+        if device_lanes > 0:
+            # only when a device lane actually served: the chip-free
+            # floor IS the python verifier, so the ordering there is
+            # dispatch noise (see module docstring)
+            assert gateway_s < python_s, (
+                f"device-lane aggregate verify slower than pure python "
+                f"at n={n}: {gateway_s:.4f}s vs {python_s:.4f}s"
+            )
+        rows.append({
+            "row": f"wire:n={n}",
+            "validators": n,
+            "commit_bytes": commit_bytes,
+            "aggregate_bytes": agg_bytes,
+            "bytes_vs_full": round(ratio, 3),
+            "verify_gateway_s": round(gateway_s, 4),
+            "verify_python_s": round(python_s, 4),
+            "full_per_sig_s": round(per_sig_s, 4),
+            "agg_lanes_device": device_lanes,
+            "platform": "devd" if device_lanes > 0 else "host",
+        })
+    return rows
+
+
+def main() -> None:
+    os.environ.setdefault("TENDERMINT_DEVD_SOCK", "/nonexistent/devd.sock")
+    os.environ.setdefault("TENDERMINT_TPU_PLATFORM", "cpu")
+
+    rows = [_flip_row(heights=4 if SMOKE else 8,
+                      measure_stall=not SMOKE)]
+    if not SMOKE:
+        rows.extend(_wire_rows())
+
+    out = {
+        "bench": "upgrade",
+        "smoke": SMOKE,
+        "max_bytes_ratio_asserted": MAX_BYTES_RATIO,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "rows": rows,
+    }
+    if not SMOKE:
+        with open(os.path.join(ROOT, "BENCH_r22.json"), "w") as f:
+            json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -141,6 +141,7 @@ BENCHES = {
     "19_retention": [sys.executable, "benches/bench_retention.py"],
     "20_localnet": [sys.executable, "benches/bench_localnet.py"],
     "21_devd_shard": [sys.executable, "benches/bench_devd_shard.py"],
+    "22_upgrade": [sys.executable, "benches/bench_upgrade.py"],
 }
 
 

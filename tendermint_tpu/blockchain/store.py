@@ -112,15 +112,25 @@ class BlockStore:
             chunks.append(part.bytes_)
         return Block.from_bytes(b"".join(chunks))
 
-    def load_block_commit(self, height: int) -> Commit | None:
+    def load_block_commit(self, height: int):
         """The canonical commit for `height`, i.e. block height+1's
-        LastCommit (blockchain/store.go:102-110)."""
-        obj = self._get_json(_commit_key(height))
-        return Commit.from_json(obj) if obj else None
+        LastCommit (blockchain/store.go:102-110). Polymorphic: the key
+        C:h holds whatever form block h+1 carried — full below the
+        upgrade boundary, AggregateCommit at and above it."""
+        from tendermint_tpu.types.agg_commit import commit_from_json
 
-    def load_seen_commit(self, height: int) -> Commit | None:
+        obj = self._get_json(_commit_key(height))
+        return commit_from_json(obj) if obj else None
+
+    def load_seen_commit(self, height: int):
+        """SC:h holds whatever form the node OBSERVED the commit in —
+        its own VoteSet's full commit when it took part in consensus, or
+        an aggregate when the height arrived via fast-sync past the
+        upgrade boundary."""
+        from tendermint_tpu.types.agg_commit import commit_from_json
+
         obj = self._get_json(_seen_commit_key(height))
-        return Commit.from_json(obj) if obj else None
+        return commit_from_json(obj) if obj else None
 
     # -- save --------------------------------------------------------------
 

@@ -244,6 +244,34 @@ class HasBlockPartMessage:
         )
 
 
+@register("agg_commit")
+@dataclass
+class AggregateCommitMessage:
+    """Catch a lagging peer up under the aggregate commit format
+    (docs/upgrade.md): individual precommits no longer exist once a
+    commit has been half-aggregated, so the per-vote catchup gossip
+    (reactor.go:609-645) is impossible — the whole AggregateCommit
+    ships instead, and the receiver finalizes from it as a commit
+    proof (consensus/state.apply_commit_proof) after verifying the
+    aggregate against its own validator set. A forged or sub-quorum
+    aggregate is a peer error (stop_peer_for_error)."""
+
+    height: int
+    commit: object  # AggregateCommit (typed lazily: types <-/-> consensus)
+
+    def to_json(self):
+        return {"height": self.height, "commit": self.commit.to_json()}
+
+    @classmethod
+    def from_json(cls, o):
+        from tendermint_tpu.types.agg_commit import AggregateCommit
+
+        return cls(
+            _int_field(o, "height", 0, _MAX_HEIGHT),
+            AggregateCommit.from_json(_dict_field(o, "commit")),
+        )
+
+
 @register("vote_set_maj23")
 @dataclass
 class VoteSetMaj23Message:

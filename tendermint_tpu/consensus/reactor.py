@@ -26,6 +26,8 @@ from tendermint_tpu.libs.service import BaseService
 from tendermint_tpu.p2p.conn import ChannelDescriptor
 from tendermint_tpu.p2p.switch import Reactor
 from tendermint_tpu.types import events as tev
+from tendermint_tpu.types.agg_commit import AggregateLastCommit, commit_is_aggregate
+from tendermint_tpu.types.validator_set import CommitError
 from tendermint_tpu.types.block_id import BlockID, PartSetHeader
 from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT, VOTE_TYPE_PREVOTE
 
@@ -126,6 +128,10 @@ class PeerState:
             peer=pid
         )
         self.m_catchup_commits = fams["catchup_commits"].labels(peer=pid)
+        # aggregate catchup (round 22): one whole-commit send per lagging
+        # height, re-armed after a hold so a lost frame can't wedge the
+        # peer — (height, monotonic send time) of the last send
+        self._agg_commit_sent: tuple[int, float] | None = None
 
     # -- reads -------------------------------------------------------------
 
@@ -269,6 +275,20 @@ class PeerState:
                 return None
             return vote_set.get_by_index(index)
 
+    def agg_commit_due(self, height: int, hold: float = 1.0) -> bool:
+        """Whether the aggregate catchup commit for `height` should be
+        (re)sent to this peer: never sent, sent for another height, or
+        sent over `hold` seconds ago with the peer still stuck there."""
+        with self._mtx:
+            sent = self._agg_commit_sent
+            if sent is None or sent[0] != height:
+                return True
+            return time.monotonic() - sent[1] >= hold
+
+    def mark_agg_commit_sent(self, height: int) -> None:
+        with self._mtx:
+            self._agg_commit_sent = (height, time.monotonic())
+
     # -- step transitions --------------------------------------------------
 
     def apply_new_round_step(self, msg: msgs.NewRoundStepMessage) -> None:
@@ -378,6 +398,9 @@ class ConsensusReactor(Reactor, BaseService):
         self.has_votes_applied = 0
         self.part_announces_sent = 0
         self.part_announces_applied = 0
+        # aggregate-format catchup accounting (round 22, docs/upgrade.md)
+        self.agg_commits_sent = 0      # whole-commit catchup sends
+        self.agg_commits_rejected = 0  # forged/sub-quorum screened out
 
     # -- wiring ------------------------------------------------------------
 
@@ -529,6 +552,9 @@ class ConsensusReactor(Reactor, BaseService):
             elif isinstance(msg, msgs.BlockPartMessage):
                 ps.set_has_proposal_block_part(msg.height, msg.round_, msg.part.index)
                 self.con_s.add_peer_message(msg, peer.id())
+            elif isinstance(msg, msgs.AggregateCommitMessage):
+                if self._screen_agg_commit(peer, msg):
+                    self.con_s.add_peer_message(msg, peer.id())
             else:
                 self.switch.stop_peer_for_error(peer, f"bad data msg {type(msg)}")
         elif ch_id == VOTE_CHANNEL:
@@ -568,6 +594,40 @@ class ConsensusReactor(Reactor, BaseService):
                 ps.apply_vote_set_bits(msg, ours)
             else:
                 self.switch.stop_peer_for_error(peer, f"bad bits msg {type(msg)}")
+
+    def _screen_agg_commit(self, peer, msg: msgs.AggregateCommitMessage) -> bool:
+        """Verify a received aggregate catchup commit on the peer thread
+        BEFORE it reaches the consensus queue: a forged or sub-quorum
+        aggregate is a peer error (stop_peer_for_error) — the aggregate
+        form makes the whole commit one signature check, so the screen
+        costs one gateway batch, not N serial verifies. True = enqueue
+        for the consensus thread (which re-verifies: WAL replay must
+        re-derive the verdict)."""
+        rs = self.con_s.get_round_state()
+        if msg.height != msg.commit.height():
+            self.switch.stop_peer_for_error(
+                peer, "aggregate commit message height mismatch"
+            )
+            return False
+        if msg.height != rs.height or rs.validators is None:
+            return False  # stale (we moved on) or not ready — drop quietly
+        err = msg.commit.validate_basic()
+        if err is None:
+            try:
+                msg.commit.verify(self.con_s.state.chain_id, rs.validators)
+            except CommitError as exc:
+                err = str(exc)
+        if err is not None:
+            self.agg_commits_rejected += 1
+            fr = getattr(self.con_s, "flightrec", None)
+            if fr is not None:
+                fr.record("agg_commit_reject", height=msg.height,
+                          err=err, peer=_peer_label(peer))
+            self.switch.stop_peer_for_error(
+                peer, f"bad aggregate commit: {err}"
+            )
+            return False
+        return True
 
     def _handle_vote_set_maj23(self, peer, ps: PeerState, msg: msgs.VoteSetMaj23Message) -> None:
         """reactor.go:230-263: record the claim, respond with our bits."""
@@ -877,6 +937,13 @@ class ConsensusReactor(Reactor, BaseService):
         # couldn't advance (no quorum at the new height), so the +2
         # branch never engaged, and the laggards never saw the commit.
         if rs.height == prs.height + 1 and rs.last_commit is not None:
+            if isinstance(rs.last_commit, AggregateLastCommit):
+                # our last commit exists only in aggregate form (we
+                # ourselves finalized from a proof): no per-vote sends
+                # possible — ship the whole commit
+                return self._send_agg_commit(
+                    peer, ps, prs.height, rs.last_commit.agg
+                )
             if rs.last_validators is not None:
                 ps.ensure_catchup_commit_round(
                     prs.height, rs.last_commit.round_,
@@ -892,12 +959,38 @@ class ConsensusReactor(Reactor, BaseService):
             if store is not None:
                 commit = store.load_block_commit(prs.height)
                 if commit is not None:
+                    if commit_is_aggregate(commit):
+                        # the stored commit IS the aggregate (post-flip
+                        # heights, docs/upgrade.md): per-vote catchup is
+                        # impossible — one AggregateCommitMessage carries
+                        # the whole quorum
+                        return self._send_agg_commit(
+                            peer, ps, prs.height, commit
+                        )
                     ps.ensure_catchup_commit_round(
                         prs.height, commit.round_(), len(commit.precommits)
                     )
                     vote = self._pick_commit_vote_to_send(ps, prs, commit)
                     if vote is not None:
                         return self._send_vote(peer, ps, vote)
+        return False
+
+    def _send_agg_commit(self, peer, ps: PeerState, height: int, agg) -> bool:
+        """One whole-commit catchup send, per-peer deduplicated: the
+        aggregate replaces N per-vote sends, so it goes out once per
+        lagging height (re-armed after a short hold in case the frame
+        was lost). Marks only on successful send, like _send_vote."""
+        if not ps.agg_commit_due(height):
+            return False
+        msg = msgs.AggregateCommitMessage(height, agg)
+        if peer.send(DATA_CHANNEL, _enc(msg)):
+            ps.mark_agg_commit_sent(height)
+            ps.m_catchup_commits.inc()
+            self.agg_commits_sent += 1
+            return True
+        fr = getattr(self.con_s, "flightrec", None)
+        if fr is not None:
+            fr.record("gossip_send_fail", peer=_peer_label(peer))
         return False
 
     def _pick_commit_vote_to_send(self, ps: PeerState, prs: PeerRoundState, commit):

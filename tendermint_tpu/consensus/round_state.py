@@ -51,6 +51,12 @@ class RoundState:
         self.commit_round = -1
         self.last_commit = None  # VoteSet of last height's precommits
         self.last_validators = None  # ValidatorSet
+        # a VERIFIED AggregateCommit for this height, received via the
+        # catchup gossip path (AggregateCommitMessage): under the
+        # aggregate commit format individual precommits cannot be
+        # re-gossiped, so a lagging node finalizes from this proof
+        # instead of a +2/3 VoteSet (consensus/state.apply_commit_proof)
+        self.commit_proof = None  # AggregateCommit | None
 
     def round_state_event(self):
         from tendermint_tpu.types.events import EventDataRoundState

@@ -76,7 +76,13 @@ from tendermint_tpu.types import (
     VoteSet,
 )
 from tendermint_tpu.types import events as tev
+from tendermint_tpu.types.agg_commit import (
+    AggregateCommit,
+    AggregateLastCommit,
+    commit_is_aggregate,
+)
 from tendermint_tpu.types.block import empty_commit
+from tendermint_tpu.types.validator_set import CommitError
 from tendermint_tpu.types.vote import UnexpectedStepError
 
 
@@ -162,6 +168,13 @@ class ConsensusState(BaseService):
         # (reactor._relay_ready). Own votes are never stamped — they
         # relay immediately.
         self.vote_recv_mono: dict[tuple, float] = {}
+        # aggregate commit-proof plane (round 22, docs/upgrade.md):
+        # catchup under the aggregate format ships whole commits, and a
+        # lagging node finalizes from the proof instead of a VoteSet —
+        # counted so an upgrade flip's catchup traffic is scrape-visible
+        self.agg_commit_proofs = 0    # verified proofs accepted
+        self.agg_commit_rejects = 0   # stale/forged/sub-quorum refused
+        self.agg_commits_proposed = 0  # proposals built with an aggregate
 
         # pipelined execution plane (round 14): stage-2 (apply) rides an
         # ordered executor; the consensus thread holds at most ONE
@@ -464,6 +477,24 @@ class ConsensusState(BaseService):
             raise RuntimeError(
                 f"failed to reconstruct last commit; seen commit for height {state.last_block_height} missing"
             )
+        if commit_is_aggregate(seen_commit):
+            # fast-sync/statesync stored the NEXT block's aggregate
+            # last_commit as the seen commit — there are no individual
+            # precommits to rebuild a VoteSet from. Verify the aggregate
+            # against the signing set and install it as the last-commit
+            # stand-in: proposing at the next height emits it verbatim
+            # (the schedule requires the aggregate form there anyway)
+            try:
+                seen_commit.verify(state.chain_id, state.last_validators)
+            except CommitError as exc:
+                raise RuntimeError(
+                    f"failed to reconstruct last commit; stored aggregate "
+                    f"for height {state.last_block_height} is invalid: {exc}"
+                )
+            self.rs.last_commit = AggregateLastCommit(
+                seen_commit, state.last_validators
+            )
+            return
         last_precommits = VoteSet(
             state.chain_id,
             state.last_block_height,
@@ -521,9 +552,17 @@ class ConsensusState(BaseService):
         last_precommits = None
         if rs.commit_round > -1 and rs.votes is not None:
             pc = rs.votes.precommits(rs.commit_round)
-            if pc is None or not pc.has_two_thirds_majority():
+            if pc is not None and pc.has_two_thirds_majority():
+                last_precommits = pc
+            elif rs.commit_proof is not None:
+                # finalized from an aggregate commit proof (catchup under
+                # the aggregate format): the proof, already verified, IS
+                # the last commit — wrapped so H+1 proposing works
+                last_precommits = AggregateLastCommit(
+                    rs.commit_proof, state.last_validators
+                )
+            else:
                 raise RuntimeError("update_to_state called but last precommit round lacks +2/3")
-            last_precommits = pc
 
         height = state.last_block_height + 1
         rs.height = height
@@ -543,6 +582,7 @@ class ConsensusState(BaseService):
         rs.locked_block_parts = None
         rs.votes = HeightVoteSet(state.chain_id, height, validators)
         rs.commit_round = -1
+        rs.commit_proof = None
         rs.last_commit = last_precommits
         rs.last_validators = state.last_validators
         self.state = state
@@ -657,6 +697,8 @@ class ConsensusState(BaseService):
             self.add_proposal_block_part(msg.height, msg.part, verify=bool(peer_id))
         elif isinstance(msg, msgs.VoteMessage):
             self.try_add_vote(msg.vote, peer_id)
+        elif isinstance(msg, msgs.AggregateCommitMessage):
+            self.apply_commit_proof(msg.commit, peer_id)
         else:
             self.logger.warning("unknown msg type %r", type(msg))
 
@@ -874,7 +916,7 @@ class ConsensusState(BaseService):
         if rs.height == 1:
             commit = empty_commit()
         elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
-            commit = rs.last_commit.make_commit()
+            commit = self._commit_for_proposal(rs.last_commit.make_commit())
         else:
             self.logger.error("propose without last commit (+2/3 missing)")
             return None, None
@@ -926,6 +968,34 @@ class ConsensusState(BaseService):
             # root) happens INSIDE the propose segment, so it rides the
             # trace's aux table, never the segment sum
             self.trace.note("part_hash_s", time.perf_counter() - t0)
+
+    def _commit_for_proposal(self, commit):
+        """The last_commit section in the format the chain's schedule
+        requires at rs.height (genesis commit_format_at, docs/upgrade.md):
+        the quorum half-aggregates into an AggregateCommit when the
+        aggregate format is active, and passes through untouched below
+        the upgrade height — the proposer is where the cutover actually
+        happens on a live net."""
+        gd = getattr(self.state, "genesis_doc", None)
+        if gd is None or not gd.aggregate_commits_at(self.rs.height):
+            return commit
+        if commit_is_aggregate(commit):
+            return commit  # AggregateLastCommit.make_commit() already is
+        if not commit.is_commit():
+            return commit  # empty (height 1); schedule never aggregates it
+        agg = AggregateCommit.from_commit(
+            commit, self.state.chain_id, self.rs.last_validators
+        )
+        self.agg_commits_proposed += 1
+        if self.agg_commits_proposed == 1 and self.flightrec is not None:
+            # the flip itself, in the black box: this proposer just built
+            # its first aggregate last-commit (height == upgrade_height on
+            # a clean flip)
+            self.flightrec.record(
+                "upgrade_flip", height=self.rs.height,
+                signers=agg.num_signers(), of=agg.size(),
+            )
+        return agg
 
     # -- step: prevote -----------------------------------------------------
 
@@ -1126,12 +1196,83 @@ class ConsensusState(BaseService):
                 rs.proposal_block_parts = PartSet.from_header(block_id.parts_header)
         defer_()
 
+    def apply_commit_proof(self, agg, peer_id: str = "") -> bool:
+        """Adopt a received AggregateCommit as this height's commit
+        proof (the aggregate-format catchup path, docs/upgrade.md): the
+        reactor already crypto-verified it against rs.validators before
+        enqueueing, but the consensus thread re-verifies here — the WAL
+        replays this message, and replay must re-derive every verdict
+        rather than trust the recorded one. On success the height
+        finalizes exactly like enter_commit, with the proof standing in
+        for the +2/3 VoteSet."""
+        rs = self.rs
+        if agg.height() != rs.height or rs.step >= RoundStep.COMMIT:
+            return False  # stale or already committing — not an error
+        err = agg.validate_basic()
+        if err is None:
+            self._join_apply("commit_proof")
+            try:
+                agg.verify(self.state.chain_id, rs.validators)
+            except CommitError as exc:
+                err = str(exc)
+        if err is not None:
+            self.agg_commit_rejects += 1
+            if self.flightrec is not None:
+                self.flightrec.record(
+                    "agg_commit_reject", height=agg.height(),
+                    err=err, peer=peer_id or "self",
+                )
+            self.logger.warning(
+                "rejected aggregate commit proof from %s: %s",
+                peer_id or "self", err,
+            )
+            return False
+        self.agg_commit_proofs += 1
+        rs.commit_proof = agg
+        if self.flightrec is not None:
+            self.flightrec.record(
+                "agg_commit_proof", height=agg.height(),
+                signers=agg.num_signers(), peer=peer_id or "self",
+            )
+        self.logger.info(
+            "commit proof at height %d: aggregate of %d/%d signers",
+            rs.height, agg.num_signers(), agg.size(),
+        )
+        # adopt the committed block id (enter_commit's fetch logic)
+        if rs.locked_block is not None and rs.locked_block.hashes_to(agg.block_id.hash):
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+        if rs.proposal_block is None or not rs.proposal_block.hashes_to(agg.block_id.hash):
+            if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                agg.block_id.parts_header
+            ):
+                rs.proposal_block = None
+                from tendermint_tpu.types import PartSet
+
+                rs.proposal_block_parts = PartSet.from_header(agg.block_id.parts_header)
+        rs.step = RoundStep.COMMIT
+        rs.commit_round = agg.round_()
+        rs.commit_time = time.time()
+        self.new_step()
+        self.try_finalize_commit(rs.height)
+        return True
+
+    def _committed_block_id(self):
+        """The BlockID this height commits to: the commit proof's when
+        one was adopted (aggregate catchup), else the +2/3 precommit
+        majority of the commit round."""
+        rs = self.rs
+        if rs.commit_proof is not None:
+            return rs.commit_proof.block_id
+        pc = rs.votes.precommits(rs.commit_round) if rs.votes is not None else None
+        return pc.two_thirds_majority() if pc is not None else None
+
     def try_finalize_commit(self, height: int) -> None:
         """consensus/state.go:1236-1256."""
         rs = self.rs
         if rs.height != height:
             raise RuntimeError("try_finalize_commit: height mismatch")
-        block_id = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        block_id = self._committed_block_id()
         if block_id is None or not block_id.hash:
             return
         if rs.proposal_block is None or not rs.proposal_block.hashes_to(block_id.hash):
@@ -1153,7 +1294,7 @@ class ConsensusState(BaseService):
         # while H fully committed — impossible via the vote path (every
         # H-vote joins first), but replay/test seams can call directly
         self._join_apply("finalize")
-        block_id = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        block_id = self._committed_block_id()
         block, block_parts = rs.proposal_block, rs.proposal_block_parts
         if block_id is None or not block.hashes_to(block_id.hash):
             raise RuntimeError("cannot finalize: proposal block does not hash to commit hash")
@@ -1177,8 +1318,12 @@ class ConsensusState(BaseService):
         fail_point()
 
         if self.block_store.height() < block.header.height:
-            precommits = rs.votes.precommits(rs.commit_round)
-            seen_commit = precommits.make_commit()
+            if rs.commit_proof is not None:
+                # catchup finalize: the verified aggregate IS the seen
+                # commit (SC:h stores whatever quorum form was observed)
+                seen_commit = rs.commit_proof
+            else:
+                seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
             self.block_store.save_block(block, block_parts, seen_commit)
         # else: already saved (e.g. during replay); proceed to apply
 

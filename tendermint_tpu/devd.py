@@ -1013,6 +1013,25 @@ def _handle_conn(conn: socket.socket, st: _DaemonState) -> None:
                     else:
                         oks = v.verify_batch(req["items"])
                         _send_frame(conn, {"ok": True, "results": [bool(b) for b in oks]})
+                elif op == "agg":
+                    # aggregate-commit dual-scalar-mul lanes
+                    # (ops/ed25519.dsm_batch; docs/upgrade.md): terms are
+                    # (a, (px,py), b, (qx,qy)) python-int tuples, the
+                    # reply the per-lane affine points. Rides the held
+                    # device via the int32 kernel module directly — the
+                    # only kernel with the dsm ladder.
+                    if st.verifier is None:
+                        _send_frame(conn, {
+                            "ok": False,
+                            "error": f"device not held (status: {st.status})",
+                        })
+                    else:
+                        from tendermint_tpu.ops import ed25519 as _ops_ed
+
+                        points = _ops_ed.dsm_batch(
+                            [tuple(t) for t in req.get("items", [])]
+                        )
+                        _send_frame(conn, {"ok": True, "points": points})
                 elif op == "stats":
                     _send_frame(conn, {
                         "ok": True,
@@ -1431,6 +1450,21 @@ class DevdClient:
         if not rep.get("ok"):
             raise DevdError(rep.get("error", "verify failed"))
         return rep["results"]
+
+    def agg_batch(self, terms) -> list[tuple[int, int]]:
+        """Aggregate-commit dual-scalar-mul lanes (the 'agg' op): terms
+        as in ops/ed25519.dsm_batch; returns per-lane affine points. A
+        pre-agg daemon replies 'unknown op' -> DevdError, which
+        ops/devd_backend latches into its CPU-floor fallback."""
+        t0 = time.perf_counter()
+        rep = self.request({"op": "agg", "items": [tuple(t) for t in terms]},
+                           timeout=self.io_timeout)
+        _latency_hists()[1].labels(op="agg").observe(
+            time.perf_counter() - t0
+        )
+        if not rep.get("ok"):
+            raise DevdError(rep.get("error", "agg failed"))
+        return [tuple(p) for p in rep["points"]]
 
     def verify_batch_async(self, items):
         items = list(items)

@@ -393,7 +393,18 @@ class Node(BaseService):
             config.p2p.addr_book(), config.p2p.addr_book_strict
         )
         if config.p2p.pex_reactor:
-            self.pex_reactor = PEXReactor(self.addr_book)
+            # dial-cadence knob for harness tiers (ops/localnet pex_churn
+            # runs whole discovery→dial→evict cycles in seconds; the 30s
+            # production default would make that scenario minutes long)
+            from tendermint_tpu.libs.envknob import env_number
+            from tendermint_tpu.p2p.pex import DEFAULT_ENSURE_PEERS_PERIOD
+            self.pex_reactor = PEXReactor(
+                self.addr_book,
+                ensure_peers_period=float(env_number(
+                    "TENDERMINT_PEX_ENSURE_PERIOD_S",
+                    DEFAULT_ENSURE_PEERS_PERIOD,
+                )),
+            )
             self.sw.add_reactor("PEX", self.pex_reactor)
         else:
             self.pex_reactor = None
@@ -627,8 +638,12 @@ class Node(BaseService):
                 f"rpc_addr={self.config.rpc.laddr}",
                 # round 18: the genesis commit-format flag rides the
                 # handshake so mixed-format nets refuse loudly at
-                # peering (NodeInfo.compatible_with)
+                # peering (NodeInfo.compatible_with); round 22 adds the
+                # full upgrade SCHEDULE — nodes disagreeing on the flip
+                # height refuse here, never wedge at decode
+                # (docs/upgrade.md)
                 f"commit_format={self.genesis_doc.commit_format}",
+                f"commit_schedule={self.genesis_doc.schedule_string()}",
             ],
         )
         self.sw.set_node_info(info)

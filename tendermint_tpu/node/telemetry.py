@@ -281,6 +281,11 @@ def build_registry(node) -> telemetry.Registry:
         )
         out["adversary_handshake_rejects"] = adv["handshake_rejects"]
         out["adversary_frame_violations"] = adv["frame_violations"]
+        # round 22: commit-schedule disagreements refused at handshake —
+        # THE misconfiguration alarm during a rolling upgrade (a nonzero
+        # value names a peer running a different genesis schedule;
+        # docs/upgrade.md)
+        out["adversary_schedule_refused"] = adv["schedule_refused"]
         # gate-level sheds only: bad signatures are unambiguously
         # hostile, saturation drops are shed load. Dedup-cache hits
         # deliberately do NOT count here — honest gossip re-delivery
@@ -292,9 +297,41 @@ def build_registry(node) -> telemetry.Registry:
         if batcher is not None:
             flood = batcher.bad_sigs + batcher.dropped
         out["adversary_flood_txs_rejected"] = flood
+        # round 22: address-book shape — size/new/old, churn counters,
+        # and the group-domination containment gauge (max_group), so the
+        # pex_churn scenario asserts eviction off scrapes alone
+        for k, v in node.addr_book.stats().items():
+            out[f"addrbook_{k}"] = v
         return out
 
     reg.register_producer("p2p", p2p)
+
+    # round 22: the upgrade-at-height plane — where this node stands
+    # relative to the scheduled commit-format flip, and every aggregate-
+    # commit verdict it has rendered. upgrade_height is 0 when no flip is
+    # scheduled; upgrade_active flips 0 -> 1 when the NEXT block this
+    # node commits will carry an aggregate last-commit (the operator's
+    # "has the cutover happened HERE yet" gauge, docs/upgrade.md).
+    def upgrade() -> dict:
+        gd = node.genesis_doc
+        next_height = max(node.block_store.height(), 0) + 1
+        return {
+            "height": gd.upgrade_height,
+            "active": 1 if gd.aggregate_commits_at(next_height) else 0,
+            # consensus-thread verdicts: commit proofs accepted from
+            # catchup gossip, forged/stale/sub-quorum refused, and
+            # proposals this node built with an aggregate last-commit
+            "agg_commit_proofs": cs.agg_commit_proofs,
+            "agg_commit_rejects": cs.agg_commit_rejects,
+            "agg_commits_proposed": cs.agg_commits_proposed,
+            # peer-thread accounting: whole aggregates shipped to lagging
+            # peers, and forged ones screened before they could enqueue
+            "agg_commits_sent": node.consensus_reactor.agg_commits_sent,
+            "agg_commits_rejected":
+                node.consensus_reactor.agg_commits_rejected,
+        }
+
+    reg.register_producer("upgrade", upgrade)
 
     # round 15: the health verdict as flat gauges on both surfaces —
     # alerting keys off node_health_status without the JSON endpoint

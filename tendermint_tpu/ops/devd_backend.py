@@ -117,15 +117,57 @@ def _latch_single_shot() -> None:
 
 
 def reset_stream_latches() -> None:
-    """Re-arm the version-skew latches (verify AND hash planes). Called
-    by the shared circuit breaker's on_close hook (ops/gateway): the
-    latches are per-DAEMON facts, and a breaker re-close means the
-    daemon came back — possibly upgraded — so the streamed fast path
+    """Re-arm the version-skew latches (verify, hash, AND agg planes).
+    Called by the shared circuit breaker's on_close hook (ops/gateway):
+    the latches are per-DAEMON facts, and a breaker re-close means the
+    daemon came back — possibly upgraded — so the latched-off fast paths
     must get another chance instead of staying latched off by the build
     that died."""
-    global _stream_ok, _hash_stream_ok
+    global _stream_ok, _hash_stream_ok, _agg_ok
     _stream_ok = True
     _hash_stream_ok = True
+    _agg_ok = True
+
+
+# -- aggregate plane ----------------------------------------------------------
+#
+# The aggregate-commit verify's dual-scalar-mul lanes (docs/upgrade.md):
+# one "agg" op per commit, lanes batched daemon-side through
+# ops/ed25519.dsm_batch. Sharded fleets split the lanes across endpoints
+# with the same offset-merge per-lane attribution the verify plane has.
+
+
+class AggUnsupported(Exception):
+    """The serving daemon predates the agg op (version skew). The
+    gateway treats this as 'route unavailable' — straight to the CPU
+    floor, NO breaker penalty (the daemon is healthy, just old)."""
+
+
+_agg_ok = True
+
+
+def _latch_agg_off() -> None:
+    global _agg_ok
+    _agg_ok = False
+
+
+def agg_batch(terms) -> list[tuple[int, int]]:
+    """Per-lane [a]P + [b]Q over the daemon-owned device; terms as in
+    ops/ed25519.dsm_batch. Raises AggUnsupported on a pre-agg daemon
+    (latched for the daemon's lifetime; re-armed by breaker re-close)."""
+    if not _agg_ok:
+        raise AggUnsupported("daemon predates the agg op (latched)")
+    terms = [tuple(t) for t in terms]
+    shard = _shard()
+    try:
+        if shard is not None:
+            return shard.agg_batch(terms)
+        return _get_client().agg_batch(terms)
+    except devd.DevdError as exc:
+        if "unknown op" not in str(exc):
+            raise
+        _latch_agg_off()
+        raise AggUnsupported(str(exc)) from exc
 
 
 def stream_stats() -> dict:

@@ -203,6 +203,47 @@ def _plan_slices(n: int, workers: int, floor: int) -> list[tuple[int, int]]:
     return out
 
 
+def _plan_slices_weighted(
+    n: int, weights: list[float], floor: int,
+) -> list[tuple[int, int, int]] | None:
+    """Endpoint-weighted planning (round 22, ROADMAP follow-on from
+    PR 21): (start, stop, home) slices whose widths are proportional to
+    each endpoint's recorded ``sigs_per_s`` EWMA, so a PERMANENTLY
+    slower chip gets proportionally narrower slices up front instead of
+    relying on steals every batch. Endpoints with no history yet take
+    the fleet's mean recorded rate (a new chip is assumed average until
+    measured). Returns None when no endpoint has history or the batch is
+    too narrow to split — the caller falls back to the equal-width
+    planner. Each home still gets ~2 slices when its share allows, so
+    the steal tail keeps absorbing TRANSIENT slowness."""
+    floor = max(1, floor)
+    known = [w for w in weights if w > 0]
+    if not known or n < 2 * floor:
+        return None
+    fill = sum(known) / len(known)
+    w = [wi if wi > 0 else fill for wi in weights]
+    total = sum(w)
+    # largest-remainder apportionment of the n lanes over the workers
+    raw = [n * wi / total for wi in w]
+    shares = [int(r) for r in raw]
+    short = n - sum(shares)
+    for i in sorted(
+        range(len(w)), key=lambda j: raw[j] - shares[j], reverse=True,
+    )[:short]:
+        shares[i] += 1
+    out, start = [], 0
+    for i, q in enumerate(shares):
+        if q <= 0:
+            continue
+        parts = 2 if q >= 2 * floor else 1
+        base, rem = divmod(q, parts)
+        for j in range(parts):
+            size = base + (1 if j < rem else 0)
+            out.append((start, start + size, i))
+            start += size
+    return out or None
+
+
 # -- the dispatcher -----------------------------------------------------------
 
 # bound on full re-dispatch rounds: within a round, surviving workers
@@ -235,10 +276,18 @@ def _dispatch(items: list, run, floor: int, sigs: bool) -> list:
             ) from (last_exc[-1] if last_exc else None)
         if not pending:
             if round_ == 0:
-                pending = [
-                    [s, e, i % len(eps)]
-                    for i, (s, e) in enumerate(_plan_slices(n, len(eps), floor))
-                ]
+                weighted = _plan_slices_weighted(
+                    n, [ep.sigs_per_s for ep in eps], floor,
+                ) if sigs else None
+                if weighted is not None:
+                    pending = [[s, e, h] for s, e, h in weighted]
+                else:
+                    pending = [
+                        [s, e, i % len(eps)]
+                        for i, (s, e) in enumerate(
+                            _plan_slices(n, len(eps), floor)
+                        )
+                    ]
             else:  # everything completed in a prior round
                 break
         else:
@@ -389,6 +438,25 @@ def verify_batch_async(items):
         return box["res"]
 
     return resolve
+
+
+# -- aggregate plane ----------------------------------------------------------
+
+
+def agg_batch(terms) -> list[tuple[int, int]]:
+    """Sharded dual-scalar-mul lanes for the aggregate-commit verify
+    (the 'agg' op; docs/upgrade.md): contiguous lane slices across the
+    fleet, results offset-merged back — per-lane attribution survives
+    slicing and re-dispatch exactly as the verify plane's does. A lane
+    is one [a]P + [b]Q term, so the verify floor is the right width
+    gate (each lane costs one Straus ladder, same as a signature)."""
+    terms = [tuple(t) for t in terms]
+    if not terms:
+        return []
+    return [tuple(p) for p in _dispatch(
+        terms, lambda ep, sub: ep.client.agg_batch(sub),
+        _verify_floor(), sigs=True,
+    )]
 
 
 # -- hash plane ---------------------------------------------------------------

@@ -530,6 +530,101 @@ def prepare_batch_limbs(items: list[tuple[bytes, bytes, bytes]], bucket: int):
     )
 
 
+# ---------------------------------------------------------------------------
+# batched dual scalar multiplication: per-lane [a]P + [b]Q for VARIABLE
+# points (the aggregate-commit verify's per-lane term [z_i]R_i +
+# [z_i*h_i]A_i — see crypto/ed25519_agg.py and docs/upgrade.md). Same
+# 2-bit interleaved Straus scan as _verify_impl, but the whole 16-entry
+# table is built from per-lane points instead of host constants.
+# ---------------------------------------------------------------------------
+
+
+def _dsm_impl(px, py, qx, qy, a_limbs, b_limbs):
+    """px/py, qx/qy: affine point limbs (17,B); a_limbs/b_limbs: (17,B)
+    15-bit limb scalars (< L). Returns canonical affine (x (17,B),
+    y (17,B)) of [a]P + [b]Q per lane."""
+    batch = px.shape[-1]
+    zeros = jnp.zeros((NLIMB, batch), dtype=jnp.int32)
+    one = zeros.at[0].set(1)
+
+    p1 = (px, py, one, fmul(px, py))
+    q1 = (qx, qy, one, fmul(qx, qy))
+    p2, q2 = point_double(p1), point_double(q1)
+    p3, q3 = point_add(p2, p1), point_add(q2, q1)
+    ident = _identity(batch)
+    p_row = [ident, p1, p2, p3]
+    q_row = [ident, q1, q2, q3]
+    table = []
+    for j in range(4):  # b digit (multiples of Q)
+        for i in range(4):  # a digit (multiples of P)
+            if i == 0:
+                table.append(q_row[j])
+            elif j == 0:
+                table.append(p_row[i])
+            else:
+                table.append(point_add(p_row[i], q_row[j]))
+    tcoords = [jnp.stack([t[c] for t in table], axis=0) for c in range(4)]
+
+    xs = jnp.stack(
+        [_digits2_from_limbs(a_limbs), _digits2_from_limbs(b_limbs)], axis=1
+    )  # (127,2,B)
+    idx16 = jnp.arange(16, dtype=jnp.int32)
+
+    def step(acc, dig):
+        acc = point_double(point_double(acc))
+        sel = dig[0] + 4 * dig[1]
+        onehot = (sel[None, :] == idx16[:, None]).astype(jnp.int32)
+        addend = tuple(
+            jnp.sum(onehot[:, None, :] * tc, axis=0) for tc in tcoords
+        )
+        return point_add(acc, addend), None
+
+    acc, _ = jax.lax.scan(step, ident, xs)
+    ax_, ay_, az_, _ = acc
+    zinv = finv(az_)
+    return fcanon(fmul(ax_, zinv)), fcanon(fmul(ay_, zinv))
+
+
+_dsm_jit = jax.jit(_dsm_impl)
+
+# identity lane padding for dsm_batch: [0]P + [0]Q from the neutral point
+_DSM_PAD = (0, (0, 1), 0, (0, 1))
+
+
+def dsm_batch(
+    terms: list[tuple[int, tuple[int, int], int, tuple[int, int]]],
+) -> list[tuple[int, int]]:
+    """terms: (a, (px, py), b, (qx, qy)) per lane, scalars already
+    reduced mod L, points affine on-curve (caller-validated — the
+    aggregate path decompresses via crypto/ed25519.point_decompress).
+    Returns per-lane affine [a]P + [b]Q as python ints. Padded to the
+    next power of two like verify_batch (one compiled program per
+    bucket)."""
+    n = len(terms)
+    if n == 0:
+        return []
+    bucket = _next_pow2(n)
+    padded = list(terms) + [_DSM_PAD] * (bucket - n)
+    a_i = [t[0] for t in padded]
+    b_i = [t[2] for t in padded]
+    px_i = [t[1][0] for t in padded]
+    py_i = [t[1][1] for t in padded]
+    qx_i = [t[3][0] for t in padded]
+    qy_i = [t[3][1] for t in padded]
+    x_l, y_l = _dsm_jit(
+        jnp.asarray(int_to_limbs_np(px_i)),
+        jnp.asarray(int_to_limbs_np(py_i)),
+        jnp.asarray(int_to_limbs_np(qx_i)),
+        jnp.asarray(int_to_limbs_np(qy_i)),
+        jnp.asarray(int_to_limbs_np(a_i)),
+        jnp.asarray(int_to_limbs_np(b_i)),
+    )
+    x_np, y_np = np.asarray(x_l), np.asarray(y_l)
+    return [
+        (limbs_to_int(x_np[:, i]), limbs_to_int(y_np[:, i])) for i in range(n)
+    ]
+
+
 def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
     """Batched strict-RFC8032 verify of (pubkey32, message, signature64)
     triples -> bool[B]. Semantics identical to crypto.ed25519.verify per

@@ -685,7 +685,12 @@ class Verifier:
         self.min_tpu_batch = min_tpu_batch
         self._tpu_ok = use_tpu
         self._mtx = threading.Lock()
-        self._stats = {"tpu_batches": 0, "tpu_sigs": 0, "cpu_sigs": 0}
+        self._stats = {
+            "tpu_batches": 0, "tpu_sigs": 0, "cpu_sigs": 0,
+            # aggregate-commit verify lanes (docs/upgrade.md): device-
+            # batched dual-scalar-muls vs the pure-python CPU floor
+            "agg_batches": 0, "agg_lanes_device": 0, "agg_lanes_cpu": 0,
+        }
         # verify-ahead results for the live vote path: consensus drains a
         # run of queued votes, batch-verifies here, then each add_vote's
         # verify_one pops its primed result (single-use)
@@ -861,6 +866,57 @@ class Verifier:
             self._stats["cpu_sigs"] += n
         res = _cpu_verify_batch(items)
         return lambda: res
+
+    def verify_aggregate(self, pubs: list[bytes], msgs: list[bytes],
+                         rs: list[bytes], s_agg: bytes,
+                         _attempt: int = 0) -> bool:
+        """Half-aggregate verify (crypto/ed25519_agg equation) with the
+        n+1 dual-scalar-mul lanes batched through the device plane —
+        devd 'agg' op (sharded fleets slice the lanes with per-lane
+        attribution), or the in-process int32 dsm ladder on a direct
+        kernel. The pure-python reference (~4.5 ms/lane) is the CPU
+        floor, taken below min_tpu_batch lanes, when every breaker is
+        open, or on a pre-agg daemon (version skew — no breaker
+        penalty). Semantics identical to ed25519_agg.verify_aggregate."""
+        from tendermint_tpu.crypto import ed25519_agg
+
+        terms = ed25519_agg.aggregate_terms(pubs, msgs, rs, s_agg)
+        if terms is None:
+            return False
+        n = len(terms)
+        if self._use_device(n) and _attempt <= self._max_retries():
+            try:
+                if self._kernel == "devd":
+                    from tendermint_tpu.ops import devd_backend
+
+                    try:
+                        points = devd_backend.agg_batch(terms)
+                    except devd_backend.AggUnsupported:
+                        # healthy-but-old daemon: CPU floor, no breaker
+                        # penalty, latched so the next commit skips the
+                        # doomed attempt
+                        points = None
+                else:
+                    from tendermint_tpu.ops import ed25519 as ops_ed
+
+                    points = ops_ed.dsm_batch(terms)
+                if points is not None:
+                    with self._mtx:
+                        self._stats["agg_batches"] += 1
+                        self._stats["agg_lanes_device"] += n
+                    self._note_device_success()
+                    return ed25519_agg.finish_from_points(points)
+            except Exception:
+                logger.exception(
+                    "aggregate verify via %s failed", self._kernel
+                )
+                self._demote_after_failure()
+                return self.verify_aggregate(
+                    pubs, msgs, rs, s_agg, _attempt=_attempt + 1
+                )
+        with self._mtx:
+            self._stats["agg_lanes_cpu"] += n
+        return ed25519_agg.verify_aggregate(pubs, msgs, rs, s_agg)
 
     def pop_primed(self, item: Item) -> bool | None:
         """Pop (single-use) the primed verdict for one item: True/False

@@ -92,6 +92,16 @@ class LocalnetSpec:
     # commit pacing: real timeout_commit (not the test preset's skipped
     # one) so the fleet's skew/byte-per-height readouts are meaningful
     timeout_commit: float = 0.1
+    # commit-format schedule baked into the shared genesis (round 22):
+    # heights >= upgrade_height carry upgrade_format last-commits,
+    # heights below stay on commit_format forever (docs/upgrade.md).
+    # upgrade_height=0 = no flip scheduled.
+    commit_format: str = "full"
+    upgrade_height: int = 0
+    upgrade_format: str = "aggregate"
+    # peer discovery: run the PEX reactor + address book on every node
+    # (the pex_churn scenario's subject)
+    pex: bool = False
     extra_args: list = field(default_factory=list)
 
     def resolved_topology(self) -> str:
@@ -190,6 +200,10 @@ class LocalNode:
         # peers must survive the whole outage window
         env.setdefault("TENDERMINT_P2P_RECONNECT_INTERVAL_S", "0.5")
         env.setdefault("TENDERMINT_P2P_RECONNECT_ATTEMPTS", "600")
+        if self.spec.pex:
+            # whole discovery->dial->evict cycles inside a scenario
+            # window (production default is 30 s between ensure rounds)
+            env.setdefault("TENDERMINT_PEX_ENSURE_PERIOD_S", "2")
         env["PYTHONPATH"] = REPO
         cmd = [
             sys.executable, "-m", "tendermint_tpu.cli",
@@ -299,7 +313,11 @@ class Localnet:
                 GenesisValidator(pv.get_pub_key(), 10, f"node{i}")
                 for i, pv in enumerate(pvs)
             ],
+            commit_format=spec.commit_format,
+            upgrade_height=spec.upgrade_height,
+            upgrade_format=spec.upgrade_format if spec.upgrade_height else "",
         )
+        genesis.validate_and_complete()
         timeouts = spec.consensus_timeouts()
         for i, pv in enumerate(pvs):
             home = spec.home(i)
@@ -310,6 +328,7 @@ class Localnet:
             cfg.base.db_backend = spec.db_backend
             cfg.base.tx_index = spec.tx_index
             cfg.consensus.gossip_dedup = spec.gossip_dedup
+            cfg.p2p.pex_reactor = spec.pex
             for k, v in timeouts.items():
                 setattr(cfg.consensus, k, v)
             cfg.consensus.skip_timeout_commit = False
@@ -319,6 +338,32 @@ class Localnet:
             pv.save()
             genesis.save_as(cfg.base.genesis_file())
         return self
+
+    def seed_addr_book(self, idx: int, addrs: list[str]) -> int:
+        """Pre-seed node idx's on-disk address book (before start: the
+        node loads it at boot). Entries are "ip:port" strings; each is
+        written as a new-bucket address sourced from itself — exactly
+        what a PEX flood of hearsay addresses leaves behind. Returns
+        entries written."""
+        import hashlib as _hashlib
+
+        from tendermint_tpu.config import load_config
+
+        cfg = load_config(self.spec.home(idx))
+        path = cfg.p2p.addr_book()
+        entries = [
+            {"addr": a, "src": a, "attempts": 0, "bucket_type": "new"}
+            for a in addrs
+        ]
+        # deterministic per-node bucket salt: two runs of one spec place
+        # the same addresses in the same buckets
+        key = _hashlib.sha256(
+            f"{self.spec.chain_id}-{self.spec.seed}-book-{idx}".encode()
+        ).hexdigest()[:48]
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"key": key, "addrs": entries}, f)
+        return len(entries)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -462,6 +507,16 @@ class Localnet:
             block["header"]["app_hash"],
         )
 
+    def last_commit_is_aggregate(self, idx: int, height: int) -> bool:
+        """Wire-format probe: does the block at `height` carry an
+        aggregate last-commit? Read off the public RPC block JSON (the
+        "s_agg" key is the aggregate's signature scalar — full commits
+        have "precommits" instead), the same way an operator would
+        confirm the cutover actually happened on the wire."""
+        res = self.nodes[idx].rpc("block", {"height": height})
+        lc = (res["block"] or {}).get("last_commit") or {}
+        return "s_agg" in lc
+
     def assert_converged(self, upto: int, from_height: int = 1,
                          nodes: list[int] | None = None) -> int:
         """Per-height byte identity across `nodes` for every height in
@@ -495,12 +550,47 @@ def run_scenario(spec: LocalnetSpec, scenario: str = "converge",
     rolling_restart — converge, SIGKILL-and-restart a third of the
                       fleet one node at a time, prove each rejoins and
                       the fleet converges byte-identically
+    upgrade         — rolling-upgrade a live net across the genesis
+                      commit-format flip (docs/upgrade.md): converge
+                      below upgrade_height H, SIGKILL a laggard BEFORE
+                      the flip, prove the rest cross H without missing
+                      a height, roll one survivor through the boundary,
+                      restart the laggard and prove it catches up
+                      THROUGH both formats; per-height byte identity on
+                      both sides of H; upgrade_* scrape asserts (the
+                      flip is visible on the public surface)
+    pex_churn       — star + PEX: pre-seed every spoke's address book
+                      with ~500 hearsay addresses dominated by one
+                      subnet, run real discovery dials, prove the book
+                      contains the domination (max_group bounded by
+                      bucket hashing), evicts under pressure, and the
+                      real net stays peered and committing
 
     Returns a flat JSON-able result row (heights/s, duplicate-vote
     ratio, fleet bytes — the bench's raw material)."""
+    if scenario == "upgrade" and spec.upgrade_height == 0:
+        # default flip far enough in that the net demonstrably runs the
+        # old format first, near enough that the scenario stays short
+        spec.upgrade_height = max(4, heights)
+    if scenario == "pex_churn":
+        spec.topology = spec.topology or "star"
+        spec.pex = True
     net = Localnet(spec)
     try:
         net.generate()
+        injected = 0
+        if scenario == "pex_churn":
+            # one deterministic hearsay set, the same on every node (so
+            # hub-side gossip re-adds known keys and bucket pressure is
+            # real): ~420 addresses inside ONE dominating subnet, ~80
+            # spread across distinct groups. 127.x.y.z is all loopback
+            # on Linux — dials fail instantly (refused), which is what
+            # drives is_bad/eviction inside the scenario window.
+            dominated = [f"127.66.6.{i}:26656" for i in range(1, 251)]
+            dominated += [f"127.66.7.{i}:26656" for i in range(1, 171)]
+            spread = [f"127.{70 + i}.1.1:26656" for i in range(80)]
+            for i in range(spec.n):
+                injected = net.seed_addr_book(i, dominated + spread)
         t0 = time.monotonic()
         net.start()
         if not net.wait_height(1, timeout=180.0):
@@ -558,10 +648,129 @@ def run_scenario(spec: LocalnetSpec, scenario: str = "converge",
             result["heights"] = target
             result["restarted"] = victims
             result["converged_heights"] = net.assert_converged(target)
+        elif scenario == "upgrade":
+            assert spec.n >= 4, "upgrade needs n >= 4 (laggard down at flip)"
+            H = spec.upgrade_height
+            assert H >= 2, "upgrade scenario needs a scheduled flip"
+            # converge on the OLD format first
+            ok = net.wait_height(max(2, H - 2), timeout=60.0 * H)
+            assert ok, f"no pre-flip convergence: {net.heights()}"
+            # the laggard goes down BEFORE the flip and sleeps through it
+            laggard = spec.n - 1
+            killed_at = net.nodes[laggard].height()
+            assert killed_at < H, (
+                f"laggard already past the flip ({killed_at} >= {H}); "
+                "raise upgrade_height"
+            )
+            net.nodes[laggard].kill(signal.SIGKILL)
+            survivors = [i for i in range(spec.n) if i != laggard]
+            # the live net crosses H without missing a height: +2/3 of
+            # the validator set keeps committing straight through the
+            # format boundary (this wait stalling IS a missed height)
+            ok = net.wait_height(H + 2, timeout=120.0 + 30.0 * H,
+                                 nodes=survivors)
+            assert ok, f"net stalled at the flip: {net.heights()}"
+            # roll one survivor across the boundary (the rolling-upgrade
+            # arm proper: its WAL replay spans both formats)
+            net.restart_node(survivors[0])
+            ok = net.wait_height(
+                max(net.nodes[i].height() for i in survivors[1:]) + 1,
+                timeout=180.0, nodes=[survivors[0]],
+            )
+            assert ok, f"rolled node never rejoined: {net.heights()}"
+            # the laggard wakes up post-flip and catches up THROUGH both
+            # formats (full blocks below H, aggregate from H on)
+            net.restart_node(laggard)
+            target = max(
+                h for h in net.heights() if h >= 0
+            ) + 2
+            ok = net.wait_height(target, timeout=240.0)
+            assert ok, f"laggard never caught up: {net.heights()}"
+            # byte identity on BOTH sides of the boundary, every node
+            result["converged_heights"] = net.assert_converged(target)
+            # wire-format proof off the public RPC: the block AT the
+            # flip carries an aggregate last-commit, the one below it a
+            # full one — on the laggard, which fetched both via catchup
+            if H >= 3:
+                assert not net.last_commit_is_aggregate(laggard, H - 1), (
+                    f"height {H - 1} (below flip) carries an aggregate"
+                )
+            assert net.last_commit_is_aggregate(laggard, H), (
+                f"height {H} (at flip) does not carry an aggregate"
+            )
+            totals = net.scrape_totals([
+                "upgrade_agg_commits_proposed", "upgrade_active",
+                "upgrade_agg_commit_rejects",
+                "p2p_adversary_schedule_refused",
+            ])
+            assert totals["upgrade_agg_commits_proposed"] >= 1, (
+                f"no proposer ever built an aggregate: {totals}"
+            )
+            assert totals["upgrade_active"] == spec.n, (
+                f"some node does not report the flip active: {totals}"
+            )
+            assert totals["p2p_adversary_schedule_refused"] == 0, (
+                f"schedule refusals inside a homogeneous net: {totals}"
+            )
+            result["upgrade_height"] = H
+            result["heights"] = target
+            result["laggard"] = laggard
+            result["laggard_killed_at"] = killed_at
+            result["agg_commits_proposed"] = int(
+                totals["upgrade_agg_commits_proposed"]
+            )
+            result["agg_commit_rejects"] = int(
+                totals["upgrade_agg_commit_rejects"]
+            )
+        elif scenario == "pex_churn":
+            # the real net must form and commit THROUGH the churn
+            ok = net.wait_height(2, timeout=120.0)
+            assert ok, f"star net never formed: {net.heights()}"
+            # loading the dominated book already evicted down to the
+            # group's bucket capacity; the RUNTIME proof is that real
+            # discovery (failed dials + gossip re-offers) keeps the
+            # churn going — evictions must GROW past the boot baseline
+            base = net.scrape_totals(["p2p_addrbook_evictions"])[
+                "p2p_addrbook_evictions"]
+            deadline = time.monotonic() + 90.0
+            evictions = base
+            while time.monotonic() < deadline:
+                evictions = net.scrape_totals(["p2p_addrbook_evictions"])[
+                    "p2p_addrbook_evictions"]
+                if evictions > base:
+                    break
+                time.sleep(2.0)
+            assert evictions > base, (
+                f"no address-book eviction under live churn "
+                f"(boot baseline {base})"
+            )
+            # domination containment: bucket hashing caps any one group
+            # at NEW_BUCKETS_PER_ADDRESS * BUCKET_SIZE bucket slots, so
+            # no book is owned by the flooding subnet
+            sizes, max_groups = [], []
+            for node in net.nodes:
+                m = node.metrics()
+                sizes.append(fleet.metric_value(m, "p2p_addrbook_size",
+                                                default=0) or 0)
+                mg = fleet.metric_value(m, "p2p_addrbook_max_group",
+                                        default=0) or 0
+                max_groups.append(mg)
+                assert mg <= 256, (
+                    f"node{node.index} book dominated: max_group={mg}"
+                )
+            # and the net is still alive: commits advanced during churn
+            h0 = max(net.heights())
+            ok = net.wait_height(h0 + 2, timeout=120.0)
+            assert ok, f"net stalled under address churn: {net.heights()}"
+            result["heights"] = h0 + 2
+            result["addrs_injected"] = injected
+            result["book_sizes"] = [int(s) for s in sizes]
+            result["book_max_groups"] = [int(g) for g in max_groups]
+            result["book_evictions"] = int(evictions)
         else:
             raise ValueError(
                 f"unknown scenario {scenario!r}; known: converge, "
-                "partition_heal, rolling_restart"
+                "partition_heal, rolling_restart, upgrade, pex_churn"
             )
         result["duplicate_vote_ratio"] = net.duplicate_vote_ratio()
         result["gossip_bytes"] = net.gossip_bytes()
@@ -585,7 +794,8 @@ def main(argv=None) -> int:
                     help="net root dir (default: a temp dir, removed "
                          "unless --keep)")
     ap.add_argument("--scenario", default="converge",
-                    choices=["converge", "partition_heal", "rolling_restart"])
+                    choices=["converge", "partition_heal", "rolling_restart",
+                             "upgrade", "pex_churn"])
     ap.add_argument("--heights", type=int, default=5)
     ap.add_argument("--topology", default="",
                     choices=["", "full", "ring", "star"])
@@ -597,6 +807,9 @@ def main(argv=None) -> int:
                     help="geo-cluster count (0 = off)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--base-port", type=int, default=47100)
+    ap.add_argument("--upgrade-height", type=int, default=0,
+                    help="schedule the commit-format flip at this height "
+                         "(upgrade scenario defaults to max(4, --heights))")
     ap.add_argument("--no-dedup", action="store_true",
                     help="boot with gossip_dedup=false (the pre-round-20 "
                          "gossip baseline)")
@@ -612,6 +825,7 @@ def main(argv=None) -> int:
         n=args.n, root=root, seed=args.seed, topology=args.topology,
         ring_k=args.ring_k, base_port=args.base_port, wan=args.wan,
         geo=args.geo, gossip_dedup=not args.no_dedup,
+        upgrade_height=args.upgrade_height,
     )
     result = run_scenario(
         spec, scenario=args.scenario, heights=args.heights,

@@ -108,6 +108,12 @@ class AddrBook(BaseService):
         ]
         self._rng = random.Random()
         self.save_interval = DEFAULT_SAVE_INTERVAL
+        # churn accounting (round 22, scrape-visible as p2p_addrbook_*):
+        # evictions = entries expired out of full new buckets (the
+        # group-domination containment actually firing), bad_dropped =
+        # addresses removed via mark_bad (flooders, provably-theirs only)
+        self.evictions = 0
+        self.bad_dropped = 0
         if file_path and os.path.exists(file_path):
             self._load(file_path)
 
@@ -185,6 +191,7 @@ class AddrBook(BaseService):
         victim.buckets = [b for b in victim.buckets if bucket is not self._new[b]]
         if not victim.buckets and not victim.is_old():
             self._addrs.pop(victim_key, None)
+        self.evictions += 1
 
     def remove_address(self, addr: NetAddress) -> None:
         with self._mtx:
@@ -206,6 +213,8 @@ class AddrBook(BaseService):
     def mark_bad(self, addr: NetAddress) -> None:
         """Drop a misbehaving peer's address (addrbook.go MarkBad — which
         the reference also implements as removal)."""
+        if str(addr) in self._addrs:
+            self.bad_dropped += 1
         self.remove_address(addr)
 
     def mark_good(self, addr: NetAddress) -> None:
@@ -245,6 +254,29 @@ class AddrBook(BaseService):
     def size(self) -> int:
         with self._mtx:
             return len(self._addrs)
+
+    def stats(self) -> dict:
+        """Scrape-surface shape of the book (node/telemetry.py exports
+        these as p2p_addrbook_*): size split new/old, churn counters,
+        and max_group — how many entries the single most-populated
+        address group holds. Bucket hashing caps any one group at
+        NEW_BUCKETS_PER_ADDRESS buckets, so a subnet flooding ~500
+        addresses can never own more than 4*BUCKET_SIZE slots; this
+        gauge is the operator's read on that containment."""
+        with self._mtx:
+            old = sum(1 for ka in self._addrs.values() if ka.is_old())
+            groups: dict[str, int] = {}
+            for ka in self._addrs.values():
+                g = _group(ka.addr)
+                groups[g] = groups.get(g, 0) + 1
+            return {
+                "size": len(self._addrs),
+                "new": len(self._addrs) - old,
+                "old": old,
+                "max_group": max(groups.values()) if groups else 0,
+                "evictions": self.evictions,
+                "bad_dropped": self.bad_dropped,
+            }
 
     def need_more_addrs(self) -> bool:
         """Should PEX keep soliciting addresses? (addrbook.go
@@ -318,12 +350,18 @@ class AddrBook(BaseService):
                 ka.buckets = [idx]
                 self._addrs[str(ka.addr)] = ka
             else:
-                self._addrs[str(ka.addr)] = ka
-                ka.buckets = []
-                self._add_loaded_new(ka)
-
-    def _add_loaded_new(self, ka: KnownAddress) -> None:
-        idx = self._bucket_index(ka.addr, ka.src, "new", 0)
-        if len(self._new[idx]) < BUCKET_SIZE:
-            self._new[idx][str(ka.addr)] = ka
-            ka.buckets = [idx]
+                # new entries re-enter through the REAL add path so the
+                # bucket-capacity invariants hold on load too: a saved
+                # book dominated by one subnet (or a crafted file) gets
+                # the same group containment a live flood would —
+                # overflow evicts inside the group's few buckets instead
+                # of accumulating bucket-less forever-unevictable
+                # entries in _addrs
+                if not self._add(ka.addr, ka.src):
+                    continue
+                got = self._addrs.get(str(ka.addr))
+                if got is not None:
+                    got.attempts = ka.attempts
+                    got.added = ka.added
+                    got.last_attempt = ka.last_attempt
+                    got.last_success = ka.last_success

@@ -31,14 +31,23 @@ class NodeInfo:
         return self.pub_key.address().hex()
 
     def _commit_format(self) -> str:
-        """The genesis `commit_format` flag this node runs under, from
-        the `other` key/value list; peers predating the flag (or bare
-        test switches that never set it) are "full" — exactly the
-        genesis default, so homogeneous old nets stay compatible."""
+        """The genesis commit-format SCHEDULE this node runs under, from
+        the `other` key/value list (round 22: `commit_schedule=` carries
+        the full upgrade schedule string, e.g. "full>aggregate@100" —
+        genesis.schedule_string(); two nodes agreeing on today's format
+        but disagreeing on the flip height would fork AT the flip, so
+        the whole schedule gates the peering). Falls back to the round-18
+        `commit_format=` flag for older peers, then to "full" — exactly
+        the genesis default, so homogeneous old nets stay compatible."""
+        fmt = None
         for entry in self.other:
-            if isinstance(entry, str) and entry.startswith("commit_format="):
+            if not isinstance(entry, str):
+                continue
+            if entry.startswith("commit_schedule="):
                 return entry.split("=", 1)[1]
-        return "full"
+            if entry.startswith("commit_format="):
+                fmt = entry.split("=", 1)[1]
+        return fmt if fmt is not None else "full"
 
     def compatible_with(self, other: "NodeInfo") -> str | None:
         """None if compatible, else a human-readable reason
@@ -55,9 +64,9 @@ class NodeInfo:
             return f"network mismatch: {self.network} vs {other.network}"
         if self._commit_format() != other._commit_format():
             return (
-                f"commit format mismatch: {self._commit_format()} vs "
-                f"{other._commit_format()} (mixed-format nets refuse at "
-                f"handshake; docs/committee.md)"
+                f"commit schedule mismatch: {self._commit_format()} vs "
+                f"{other._commit_format()} (mixed-schedule nets refuse at "
+                f"handshake, never wedge at decode; docs/upgrade.md)"
             )
         return None
 

@@ -103,6 +103,13 @@ class Switch(BaseService):
             "max_peers_refused": 0,
             "handshake_rejects": 0,
             "frame_violations": 0,
+            # commit-schedule disagreements specifically: a nonzero value
+            # during a rolling upgrade means some peer runs a different
+            # genesis upgrade schedule — the one misconfiguration that
+            # would otherwise fork the net AT the flip height. Counted at
+            # the add_peer refusal site so both inbound and outbound
+            # handshakes land here (docs/upgrade.md).
+            "schedule_refused": 0,
         }
         self._mtx = threading.Lock()
 
@@ -286,6 +293,8 @@ class Switch(BaseService):
             raise ConnectionError("refusing self-connection")
         reason = self.node_info.compatible_with(info)
         if reason is not None:
+            if reason.startswith("commit schedule mismatch"):
+                self._note_adversary("schedule_refused")
             peer.stream.close()
             raise ConnectionError(f"incompatible peer: {reason}")
         # inbound connections respect max_num_peers at the registration

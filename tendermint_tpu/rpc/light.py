@@ -13,6 +13,7 @@ verification — no skipping/bisection, matching the reference line).
 
 from __future__ import annotations
 
+from tendermint_tpu.types.agg_commit import commit_from_json, commit_is_aggregate
 from tendermint_tpu.types.block import Commit, Header
 from tendermint_tpu.types.validator_set import CommitError, ValidatorSet
 
@@ -91,7 +92,9 @@ class LightClient:
             raise LightClientError(f"no commit/header for height {height}")
         try:
             header = Header.from_json(res["header"])
-            commit = Commit.from_json(res["commit"])
+            # polymorphic: post-upgrade heights serve AggregateCommit
+            # (docs/upgrade.md); verify_commit dispatches on the form
+            commit = commit_from_json(res["commit"])
         except ValueError as exc:
             # the serving node's response is untrusted input too
             raise LightClientError(f"malformed commit response: {exc}")
@@ -192,7 +195,10 @@ class LightClient:
                     raise LightClientError(
                         f"header {h} does not chain to verified header {h - 1}"
                     )
-                commit = Commit.from_json(res["commit"])
+                try:
+                    commit = commit_from_json(res["commit"])
+                except ValueError as exc:
+                    raise LightClientError(f"malformed commit at {h}: {exc}")
                 self._check_old_set_overlap(h, commit, claimed)
                 vals = claimed
             # verify with the candidate set FIRST; only a fully verified
@@ -242,6 +248,9 @@ class LightClient:
         per-lane verdicts feed the same tally, so accept/reject is
         byte-identical to the sequential loop."""
         old = self.validators
+        if commit_is_aggregate(commit):
+            self._check_old_set_overlap_aggregate(height, commit, new_set)
+            return
         candidates = []  # (old_val, sign_bytes, signature)
         for idx, pre in enumerate(commit.precommits):
             if pre is None or pre.signature is None:
@@ -279,6 +288,44 @@ class LightClient:
         signed_old_power = sum(
             v.voting_power for (v, _, _), ok in zip(candidates, oks) if ok
         )
+        if signed_old_power * 3 <= old.total_voting_power() * 2:
+            raise LightClientError(
+                f"validator change at {height}: trusted set signed only "
+                f"{signed_old_power}/{old.total_voting_power()} power"
+            )
+
+    def _check_old_set_overlap_aggregate(
+        self, height: int, commit, new_set: ValidatorSet
+    ) -> None:
+        """Condition (d) for an aggregate-format commit (docs/upgrade.md):
+        the half-aggregate is one indivisible equation over the NEW set's
+        signer lanes, so it verifies whole — against the new set — and
+        then the OLD trusted set's power is credited over the signer
+        BITMAP (a signer lane that fails would fail the whole equation,
+        so a verified aggregate proves every bitmap member signed).
+        The per-lane scalar muls ride the gateway's batched path."""
+        if (
+            commit.height() != height
+            or commit.block_id.is_zero()
+        ):
+            raise LightClientError(
+                f"aggregate commit at {height} has wrong coordinates"
+            )
+        try:
+            commit.verify(self.chain_id, new_set)
+        except CommitError as exc:
+            raise LightClientError(
+                f"validator change at {height}: aggregate commit failed: {exc}"
+            )
+        old = self.validators
+        signed_old_power = 0
+        for idx in commit.signers.indices():
+            _, val = new_set.get_by_index(idx)
+            if val is None:
+                continue
+            _, old_val = old.get_by_address(val.address)
+            if old_val is not None:
+                signed_old_power += old_val.voting_power
         if signed_old_power * 3 <= old.total_voting_power() * 2:
             raise LightClientError(
                 f"validator change at {height}: trusted set signed only "

@@ -58,19 +58,20 @@ def update_validators(validators: ValidatorSet, diffs) -> None:
 def validate_block(state: State, block, batch_verifier=None) -> None:
     """state/execution.go:180-206. Raises InvalidBlockError."""
     err = block.validate_basic(
-        state.chain_id, state.last_block_height, state.last_block_id, state.app_hash
+        state.chain_id, state.last_block_height, state.last_block_id, state.app_hash,
+        commit_format=state.genesis_doc.commit_format_at(block.header.height),
     )
     if err:
         raise InvalidBlockError(err)
 
     if block.header.height == 1:
-        if block.last_commit.precommits:
+        if block.last_commit.is_commit():
             raise InvalidBlockError("first block should have no LastCommit precommits")
     else:
-        if len(block.last_commit.precommits) != state.last_validators.size():
+        if block.last_commit.size() != state.last_validators.size():
             raise InvalidBlockError(
                 f"invalid commit size: expected {state.last_validators.size()}, "
-                f"got {len(block.last_commit.precommits)}"
+                f"got {block.last_commit.size()}"
             )
         from tendermint_tpu.types.validator_set import CommitError
 

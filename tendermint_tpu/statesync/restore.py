@@ -248,7 +248,7 @@ class Restorer:
         way). Returns (state, meta, parts, seen_commit, validators_info)."""
         from tendermint_tpu.state.state import State
         from tendermint_tpu.types import PartSet
-        from tendermint_tpu.types.block import Commit
+        from tendermint_tpu.types.agg_commit import commit_from_json
         from tendermint_tpu.types.block_meta import BlockMeta
         from tendermint_tpu.types.part_set import Part, PartSetError
         from tendermint_tpu.types.validator_set import CommitError
@@ -262,9 +262,9 @@ class Restorer:
             if manifest.format >= 2:
                 if manifest.seen_commit is None:
                     raise ValueError("format-2 manifest carries no seen commit")
-                seen_commit = Commit.from_json(manifest.seen_commit)
+                seen_commit = commit_from_json(manifest.seen_commit)
             else:
-                seen_commit = Commit.from_json(obj["block"]["seen_commit"])
+                seen_commit = commit_from_json(obj["block"]["seen_commit"])
             parts_json = obj["block"]["parts"]
             validators_info = obj["validators_info"]
             if not isinstance(parts_json, list) or not isinstance(validators_info, dict):

@@ -192,10 +192,12 @@ class Manifest:
         if fmt >= 2 and "seen_commit" in obj:
             seen_commit = obj["seen_commit"]
             # validate NOW (it arrives over p2p); keep the JSON form —
-            # restore re-parses and signature-verifies it
-            from tendermint_tpu.types.block import Commit
+            # restore re-parses and signature-verifies it. Polymorphic:
+            # post-upgrade snapshots carry an AggregateCommit here
+            # (docs/upgrade.md), dispatched on the "s_agg" key
+            from tendermint_tpu.types.agg_commit import commit_from_json
 
-            Commit.from_json(jv.dict_field(obj, "seen_commit"))
+            commit_from_json(jv.dict_field(obj, "seen_commit"))
         m = cls(
             height=height,
             chain_id=chain_id,

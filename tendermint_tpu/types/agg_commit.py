@@ -24,10 +24,18 @@ hash's varint length — 0x00 or 0x14), and ``decode_commit`` only accepts
 it when the chain's genesis says ``commit_format: "aggregate"``
 (types/genesis.py). A full-format node fed an aggregate commit refuses
 LOUDLY at decode, and the genesis docs themselves differ byte-for-byte —
-a mixed net cannot silently form. This is a PROTOTYPE: blocks and the
-block store still carry full commits; the object, wire form, verifier,
-flag, and refusal path are real, the consensus-rule cutover (headers
-committing to aggregate last-commit hashes) is queued in ROADMAP.
+a mixed net cannot silently form.
+
+Round 22 turned the prototype into the consensus rule: blocks, the block
+store, gossip (commit catchup included), fast-sync, statesync manifests,
+and the light client all carry and verify AggregateCommit wherever the
+chain's schedule (types/genesis.py commit_format_at) says the format is
+active, and the multi-term verify rides the device-plane gateway
+(ops/gateway.Verifier.verify_aggregate) instead of the pure-python
+reference path. The class mirrors Commit's accessor surface
+(height()/round_()/size()/bit_array()/hash()/validate_basic()) so every
+consumer stays polymorphic over the two forms. docs/upgrade.md covers
+the upgrade-at-height orchestration that flips a live net between them.
 """
 
 from __future__ import annotations
@@ -35,6 +43,7 @@ from __future__ import annotations
 from tendermint_tpu.codec.binary import Decoder, Encoder
 from tendermint_tpu.crypto import ed25519_agg
 from tendermint_tpu.libs.bitarray import BitArray
+from tendermint_tpu.merkle.simple import leaf_hash
 from tendermint_tpu.types.block import Commit
 from tendermint_tpu.types.block_id import BlockID
 from tendermint_tpu.types.validator_set import CommitError, ValidatorSet
@@ -53,11 +62,62 @@ class AggregateCommit:
     def __init__(self, block_id: BlockID, height: int, round_: int,
                  signers: BitArray, rs: list[bytes], s_agg: bytes):
         self.block_id = block_id
-        self.height = height
-        self.round_ = round_
+        self._height = height
+        self._round = round_
         self.signers = signers
         self.rs = rs
         self.s_agg = s_agg
+        self._hash: bytes | None = None
+
+    # -- Commit-mirroring accessors (keep consumers polymorphic) -----------
+
+    def height(self) -> int:
+        return self._height
+
+    def round_(self) -> int:
+        return self._round
+
+    def type_(self) -> int:
+        return VOTE_TYPE_PRECOMMIT
+
+    def size(self) -> int:
+        """Validator-set size the signer bits span (Commit.size parity:
+        the set size, not the signer count)."""
+        return self.signers.size
+
+    def num_signers(self) -> int:
+        return len(self.rs)
+
+    def bit_array(self) -> BitArray:
+        return self.signers.copy()
+
+    def is_commit(self) -> bool:
+        return len(self.rs) != 0
+
+    def validate_basic(self) -> str | None:
+        """None if structurally valid; else an error string (the
+        aggregate counterpart of Commit.validate_basic)."""
+        if self.block_id.is_zero():
+            return "aggregate commit cannot be for nil block"
+        if not self.rs:
+            return "no signers in aggregate commit"
+        if not 0 < self.signers.size <= MAX_AGG_SIGNERS:
+            return f"bad signer-set size {self.signers.size}"
+        if len(self.rs) != self.signers.num_true_bits():
+            return "signer bits do not match nonce points"
+        if any(len(r) != 32 for r in self.rs):
+            return "nonce point not 32 bytes"
+        if len(self.s_agg) != 32:
+            return "aggregate scalar not 32 bytes"
+        return None
+
+    def hash(self) -> bytes:
+        """What the NEXT header's last_commit_hash commits to when the
+        aggregate format is active: the leaf hash of the canonical wire
+        form (one leaf — the object IS the whole commit section)."""
+        if self._hash is None:
+            self._hash = leaf_hash(self.to_bytes())
+        return self._hash
 
     # -- construction ------------------------------------------------------
 
@@ -105,15 +165,22 @@ class AggregateCommit:
         """The ONE canonical payload every aggregated lane signed (vote
         sign-bytes exclude the validator identity)."""
         return Vote(
-            validator_address=b"", validator_index=0, height=self.height,
-            round_=self.round_, type_=VOTE_TYPE_PRECOMMIT,
+            validator_address=b"", validator_index=0, height=self._height,
+            round_=self._round, type_=VOTE_TYPE_PRECOMMIT,
             block_id=self.block_id,
         ).sign_bytes(chain_id)
 
-    def verify(self, chain_id: str, val_set: ValidatorSet) -> None:
+    def verify(self, chain_id: str, val_set: ValidatorSet,
+               agg_verifier=None) -> None:
         """Raise CommitError unless the aggregate carries +2/3 of
         `val_set` AND the half-aggregate equation holds for every signer
-        lane — the whole commit's crypto in one multi-term check."""
+        lane — the whole commit's crypto in one multi-term check.
+
+        `agg_verifier` is a callable (pubs, msgs, rs, s_agg) -> bool; by
+        default the device-plane gateway's batched dual-scalar-mul path
+        (ops/gateway.Verifier.verify_aggregate — devd/sharded/direct
+        kernel with the pure-python reference as CPU floor), so the hot
+        paths never pay ~4.5 ms/lane of host scalar muls."""
         idxs = self.signers.indices()
         if self.signers.size != val_set.size():
             raise CommitError(
@@ -138,9 +205,9 @@ class AggregateCommit:
                 f"needed {val_set.total_voting_power() * 2 // 3 + 1}"
             )
         msg = self.sign_message(chain_id)
-        if not ed25519_agg.verify_aggregate(
-            pubs, [msg] * len(pubs), self.rs, self.s_agg
-        ):
+        if agg_verifier is None:
+            agg_verifier = _default_agg_verifier()
+        if not agg_verifier(pubs, [msg] * len(pubs), self.rs, self.s_agg):
             raise CommitError("aggregate signature failed verification")
 
     # -- wire --------------------------------------------------------------
@@ -148,8 +215,8 @@ class AggregateCommit:
     def encode(self, e: Encoder) -> None:
         e.write_u8(AGG_COMMIT_TAG)
         self.block_id.encode(e)
-        e.write_varint(self.height)
-        e.write_varint(self.round_)
+        e.write_varint(self._height)
+        e.write_varint(self._round)
         e.write_varint(self.signers.size)
         e.write_list(self.signers.indices(), lambda enc, i: enc.write_varint(i))
         e.write_raw(b"".join(self.rs))
@@ -196,8 +263,8 @@ class AggregateCommit:
     def to_json(self):
         return {
             "block_id": self.block_id.to_json(),
-            "height": self.height,
-            "round": self.round_,
+            "height": self._height,
+            "round": self._round,
             "signers": self.signers.to_json(),
             "rs": [r.hex().upper() for r in self.rs],
             "s_agg": self.s_agg.hex().upper(),
@@ -241,17 +308,97 @@ class AggregateCommit:
         )
 
 
+class AggregateLastCommit:
+    """rs.last_commit stand-in when only a VERIFIED AggregateCommit is
+    available for the previous height — commit-proof catchup and restart
+    from an aggregate seen-commit (consensus/state.py). It carries no
+    individual votes: vote-gossip picks nothing from it (bit_array() is
+    empty; the reactor's aggregate catchup branch ships the whole commit
+    instead), late precommits cannot be absorbed (begin_add refuses as a
+    duplicate), but proposing at the next height works — make_commit()
+    IS the aggregate, exactly what the schedule requires the next
+    block's last_commit section to be."""
+
+    def __init__(self, agg: "AggregateCommit", val_set: ValidatorSet):
+        self.agg = agg
+        self.val_set = val_set  # the set that signed (VoteSet parity)
+        self.height = agg.height()
+        self.round_ = agg.round_()
+        self.type_ = VOTE_TYPE_PRECOMMIT
+
+    def size(self) -> int:
+        return self.agg.size()
+
+    def has_two_thirds_majority(self) -> bool:
+        return True  # verified against val_set before construction
+
+    def two_thirds_majority(self):
+        return self.agg.block_id
+
+    def has_all(self) -> bool:
+        return self.agg.num_signers() == self.agg.size()
+
+    def make_commit(self):
+        return self.agg
+
+    def bit_array(self) -> BitArray:
+        # EMPTY by design: pick_vote_to_send must never find a per-vote
+        # lane here (there are none to send)
+        return BitArray(self.agg.size())
+
+    def get_by_index(self, index: int):
+        # truthy for "this lane is covered" screens (vote_batcher), but
+        # unreachable from vote gossip (bit_array above is empty)
+        return self.agg if self.agg.signers.get_index(index) else None
+
+    def begin_add(self, vote):
+        return None  # cannot absorb votes; reads as an exact duplicate
+
+    def add_vote(self, vote, verifier=None) -> bool:
+        return False
+
+    def __repr__(self):
+        return f"AggregateLastCommit{{{self.agg!r}}}"
+
+
+def _default_agg_verifier():
+    """The gateway-batched aggregate verifier, resolved lazily (types/
+    must not import ops/ at module load). Falls back to the pure-python
+    reference if the gateway is unavailable for any reason."""
+    try:
+        from tendermint_tpu.ops.gateway import default_verifier
+
+        return default_verifier().verify_aggregate
+    except Exception:
+        return ed25519_agg.verify_aggregate
+
+
 def decode_commit(d: Decoder, aggregate_commits: bool = False):
     """Format-flag-aware commit decode: dispatches on the aggregate
-    magic tag. `aggregate_commits` is the chain's genesis
-    ``commit_format == "aggregate"`` — a full-format node fed an
-    aggregate commit refuses HERE, loudly (the mixed-net refusal test,
-    tests/test_vote_batch.py)."""
+    magic tag. `aggregate_commits` is whether the chain's schedule
+    allows the aggregate format AT THIS HEIGHT (genesis
+    ``commit_format_at``) — a node fed an aggregate commit for a
+    full-format height refuses HERE, loudly (the mixed-net refusal
+    test, tests/test_vote_batch.py)."""
     if d.peek_u8() == AGG_COMMIT_TAG:
         if not aggregate_commits:
             raise ValueError(
-                "aggregate commit refused: this chain's genesis runs "
-                "commit_format=full (mixed-net refusal, docs/committee.md)"
+                "aggregate commit refused: this chain runs "
+                "commit_format=full at this height (mixed-net refusal, "
+                "docs/committee.md; upgrade schedule, docs/upgrade.md)"
             )
         return AggregateCommit.decode(d)
     return Commit.decode(d)
+
+
+def commit_from_json(obj):
+    """Polymorphic commit parse: aggregate JSON carries ``s_agg``, full
+    carries ``precommits`` — the RPC /commit, statesync manifests, and
+    the light client all accept either form and verify by the schedule."""
+    if isinstance(obj, dict) and "s_agg" in obj:
+        return AggregateCommit.from_json(obj)
+    return Commit.from_json(obj)
+
+
+def commit_is_aggregate(commit) -> bool:
+    return isinstance(commit, AggregateCommit)

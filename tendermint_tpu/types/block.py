@@ -373,14 +373,25 @@ class Block:
             tree_hasher=tree_hasher, tree_submitter=tree_submitter,
         )
 
+    def commit_format(self) -> str:
+        """Wire format this block's last_commit actually carries."""
+        from tendermint_tpu.types.agg_commit import AggregateCommit
+
+        return "aggregate" if isinstance(self.last_commit, AggregateCommit) else "full"
+
     def validate_basic(
         self,
         chain_id: str,
         last_block_height: int,
         last_block_id: BlockID,
         app_hash: bytes,
+        commit_format: str | None = None,
     ) -> str | None:
-        """Stateless-ish validation (types/block.go:48-85); None when OK."""
+        """Stateless-ish validation (types/block.go:48-85); None when OK.
+        `commit_format` (when given) is the format the chain's upgrade
+        schedule requires at this height — a block carrying its
+        last_commit in the wrong form is refused with a NAMED error, not
+        a later hash mismatch (docs/upgrade.md boundary invariant)."""
         h = self.header
         if h.chain_id != chain_id:
             return f"wrong chain_id: {h.chain_id} != {chain_id}"
@@ -390,6 +401,13 @@ class Block:
             return f"wrong num_txs: {h.num_txs} != {len(self.data.txs)}"
         if h.last_block_id != last_block_id:
             return f"wrong last_block_id: {h.last_block_id} != {last_block_id}"
+        if commit_format is not None and h.height != 1:
+            got = self.commit_format()
+            if got != commit_format:
+                return (
+                    f"wrong last_commit format at height {h.height}: "
+                    f"got {got}, schedule requires {commit_format}"
+                )
         if h.last_commit_hash != self.last_commit.hash():
             return "wrong last_commit_hash"
         if h.height != 1:
@@ -419,14 +437,22 @@ class Block:
 
     @classmethod
     def decode(cls, d: Decoder) -> "Block":
+        from tendermint_tpu.types.agg_commit import AggregateCommit, AGG_COMMIT_TAG
         from tendermint_tpu.types.evidence import EvidenceData
 
-        return cls(
-            Header.decode(d),
-            Data.decode(d),
-            Commit.decode(d),
-            evidence=EvidenceData.decode(d),
-        )
+        header = Header.decode(d)
+        data = Data.decode(d)
+        # self-describing last-commit: the aggregate form leads with a
+        # magic byte no full Commit can start with, so blocks on either
+        # side of an upgrade boundary decode without out-of-band state;
+        # whether the format is ALLOWED at this height is enforced at
+        # validate time (validate_basic's commit_format check) — a
+        # schedule violation is a named refusal, never a decode wedge
+        if d.peek_u8() == AGG_COMMIT_TAG:
+            last_commit = AggregateCommit.decode(d)
+        else:
+            last_commit = Commit.decode(d)
+        return cls(header, data, last_commit, evidence=EvidenceData.decode(d))
 
     @classmethod
     def from_bytes(cls, b: bytes) -> "Block":
@@ -449,11 +475,13 @@ class Block:
         from tendermint_tpu.codec import jsonval as jv
         from tendermint_tpu.types.evidence import EvidenceData
 
+        from tendermint_tpu.types.agg_commit import commit_from_json
+
         obj = jv.require_dict(obj)
         return cls(
             Header.from_json(jv.dict_field(obj, "header")),
             Data.from_json(jv.dict_field(obj, "data")),
-            Commit.from_json(jv.dict_field(obj, "last_commit")),
+            commit_from_json(jv.dict_field(obj, "last_commit")),
             evidence=(
                 EvidenceData.from_json(jv.dict_field(obj, "evidence"))
                 if "evidence" in obj else EvidenceData()

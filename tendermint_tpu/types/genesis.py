@@ -39,6 +39,13 @@ class GenesisDoc:
     app_hash: bytes = b""
     consensus_params: ConsensusParams = field(default_factory=ConsensusParams)
     commit_format: str = "full"
+    # Scheduled consensus-rule flip: blocks at heights >= upgrade_height
+    # carry their last_commit in upgrade_format; heights below stay on
+    # commit_format forever. 0 = no flip scheduled. The schedule is part
+    # of the chain identity — nodes disagreeing on it refuse at the
+    # handshake (p2p/node_info.py), never wedge on a later decode.
+    upgrade_height: int = 0
+    upgrade_format: str = ""
 
     def validate_and_complete(self) -> None:
         """types/genesis.go:55-84: ensure chain id, >=1 validator with
@@ -53,15 +60,52 @@ class GenesisDoc:
                 f"unknown commit_format {self.commit_format!r}; "
                 f"expected one of {COMMIT_FORMATS}"
             )
+        if self.upgrade_height < 0:
+            raise ValueError("upgrade_height must be >= 0")
+        if self.upgrade_height:
+            if self.upgrade_format not in COMMIT_FORMATS:
+                raise ValueError(
+                    f"unknown upgrade_format {self.upgrade_format!r}; "
+                    f"expected one of {COMMIT_FORMATS}"
+                )
+            if self.upgrade_format == self.commit_format:
+                raise ValueError(
+                    "upgrade_format equals commit_format; drop the schedule"
+                )
+            if self.upgrade_height < 2:
+                # height 1 carries no last_commit, so the earliest height
+                # whose format can differ is 2
+                raise ValueError("upgrade_height must be >= 2")
+        elif self.upgrade_format:
+            raise ValueError("upgrade_format set without upgrade_height")
         if not self.validators:
             raise ValueError("genesis doc must include at least one validator")
         for v in self.validators:
             if v.power <= 0:
                 raise ValueError(f"validator {v.name!r} has non-positive power")
 
+    def commit_format_at(self, height: int) -> str:
+        """Wire format of the last_commit carried by the block at
+        `height` (which attests height-1). Heights below the scheduled
+        flip are commit_format forever; at and above, upgrade_format."""
+        if self.upgrade_height and height >= self.upgrade_height:
+            return self.upgrade_format
+        return self.commit_format
+
+    def aggregate_commits_at(self, height: int) -> bool:
+        return self.commit_format_at(height) == "aggregate"
+
+    def schedule_string(self) -> str:
+        """Canonical one-token schedule descriptor, carried in the p2p
+        handshake: `full`, or `full>aggregate@100` when a flip is set."""
+        if self.upgrade_height:
+            return f"{self.commit_format}>{self.upgrade_format}@{self.upgrade_height}"
+        return self.commit_format
+
     def aggregate_commits(self) -> bool:
-        """The agg_commit.decode_commit gate."""
-        return self.commit_format == "aggregate"
+        """True when ANY height uses the aggregate format (genesis flag
+        or scheduled flip) — the agg_commit.decode_commit gate."""
+        return self.commit_format == "aggregate" or self.upgrade_format == "aggregate"
 
     def validator_hash(self) -> bytes:
         from tendermint_tpu.types.validator import Validator
@@ -82,6 +126,9 @@ class GenesisDoc:
             # key present only off the default so every existing genesis
             # doc serializes byte-identically to the pre-flag format
             out["commit_format"] = self.commit_format
+        if self.upgrade_height:
+            out["upgrade_height"] = self.upgrade_height
+            out["upgrade_format"] = self.upgrade_format
         return out
 
     def save_as(self, path: str) -> None:
@@ -97,6 +144,8 @@ class GenesisDoc:
             app_hash=bytes.fromhex(obj.get("app_hash", "")),
             consensus_params=ConsensusParams.from_json(obj.get("consensus_params")),
             commit_format=obj.get("commit_format", "full"),
+            upgrade_height=obj.get("upgrade_height", 0),
+            upgrade_format=obj.get("upgrade_format", ""),
         )
         doc.validate_and_complete()
         return doc

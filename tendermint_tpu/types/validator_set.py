@@ -158,7 +158,15 @@ class ValidatorSet:
         When given, all structural checks run first, then every signature in
         the commit is verified in ONE batch (the TPU kernel); per-signature
         results feed the same accept/reject logic the sequential loop has.
+
+        Polymorphic over the commit format: an AggregateCommit takes the
+        aggregate branch (one multi-term check, batched through the
+        device gateway), so every caller — block validation, fast-sync,
+        statesync restore, the light client — spans the upgrade boundary
+        without knowing it.
         """
+        if self._try_verify_aggregate(chain_id, block_id, height, commit):
+            return
         items = self._commit_structural_check(chain_id, height, commit)
         if batch_verifier is not None:
             oks = batch_verifier(
@@ -183,6 +191,11 @@ class ValidatorSet:
 
         async_batch_verifier: callable(items) -> resolver() -> list[bool]
         (ops/gateway.Verifier.verify_batch_async)."""
+        if self._aggregate_precheck(chain_id, block_id, height, commit):
+            def finish_agg() -> None:
+                self._try_verify_aggregate(chain_id, block_id, height, commit)
+
+            return finish_agg
         items = self._commit_structural_check(chain_id, height, commit)
         resolve = async_batch_verifier(
             [(val.pub_key.raw, sb, sig.raw) for _, _, val, sb, sig in items]
@@ -207,6 +220,12 @@ class ValidatorSet:
         spans, all_items = [], []
         for block_id, height, commit in entries:
             try:
+                if self._aggregate_precheck(chain_id, block_id, height, commit):
+                    # aggregate entries carry no per-vote lanes for the
+                    # group batch; their multi-term check runs at consume
+                    # time and rides the gateway's own aggregate batching
+                    spans.append(((block_id, height, commit), None, 0, 0))
+                    continue
                 items = self._commit_structural_check(chain_id, height, commit)
             except CommitError as exc:
                 # a structurally bad commit must not poison its group: its
@@ -230,11 +249,47 @@ class ValidatorSet:
             def finish() -> None:
                 if isinstance(items, CommitError):
                     raise items
+                if items is None:
+                    bid, h, agg = block_id
+                    self._try_verify_aggregate(chain_id, bid, h, agg)
+                    return
                 self._commit_tally(block_id, items, resolved()[lo:hi])
 
             return finish
 
         return [make_finish(*span) for span in spans]
+
+    # -- aggregate-commit branch (docs/upgrade.md cutover) -----------------
+
+    def _aggregate_precheck(self, chain_id: str, block_id: BlockID,
+                            height: int, commit) -> bool:
+        """True iff `commit` is an AggregateCommit; raises CommitError on
+        the cheap structural mismatches so async callers fail fast."""
+        from tendermint_tpu.types.agg_commit import AggregateCommit
+
+        if not isinstance(commit, AggregateCommit):
+            return False
+        if height != commit.height():
+            raise CommitError(f"wrong height: {height} vs {commit.height()}")
+        if block_id != commit.block_id:
+            raise CommitError(
+                f"aggregate commit is for a different block: "
+                f"{commit.block_id!r} vs {block_id!r}"
+            )
+        err = commit.validate_basic()
+        if err:
+            raise CommitError(err)
+        return True
+
+    def _try_verify_aggregate(self, chain_id: str, block_id: BlockID,
+                              height: int, commit,
+                              agg_verifier=None) -> bool:
+        """Full aggregate verify (structural + quorum + multi-term
+        crypto); returns False when `commit` is a plain Commit."""
+        if not self._aggregate_precheck(chain_id, block_id, height, commit):
+            return False
+        commit.verify(chain_id, self, agg_verifier=agg_verifier)
+        return True
 
     def _commit_structural_check(self, chain_id: str, height: int, commit):
         """Everything verify_commit checks before signatures; returns the

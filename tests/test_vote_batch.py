@@ -499,8 +499,8 @@ class TestAggregateCommit:
         e = Encoder()
         e.write_u8(0xAC)
         agg.block_id.encode(e)
-        e.write_varint(agg.height)
-        e.write_varint(agg.round_)
+        e.write_varint(agg.height())
+        e.write_varint(agg.round_())
         e.write_varint(agg.signers.size)
         e.write_list(swapped, lambda enc, i: enc.write_varint(i))
         e.write_raw(b"".join(agg.rs))
