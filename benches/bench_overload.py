@@ -1,0 +1,99 @@
+"""Overload-control bench (round 23): consensus cadence under ingress
+flood, scrape-visible shed ratios, and priority-vs-bulk commit ordering
+(docs/serving.md).
+
+Runs the `overload` ops/localnet scenario: a real 4-node process fleet
+where node 0 is flooded with bulk writes (4 clients pinned to one
+throttled source IP), hot status reads (4 clients on a second IP), and
+two deliberately-slow WS subscribers — while the scenario asserts
+
+- consensus cadence stays within 1.5x the unloaded baseline,
+- every shed is visible on the scrape surface (rpc_shed_total,
+  mempool_lane_full_total, ws_evictions_total),
+- a priority probe tx commits at a strictly LOWER height than a bulk
+  marker submitted BEFORE it (the mempool lane proof),
+- the load-shed ladder transition landed in the flight ring, and
+- per-height byte identity holds across the fleet (lanes reorder only
+  within a block's reap, never across nodes).
+
+Rows: cadence ratio (flood/baseline heights/s), shed counts by plane,
+probe-vs-marker heights, WS evictions/drops, flood HTTP status tallies.
+
+BENCH_OVERLOAD_SMOKE=1 shrinks to one 4-node run (~90 s) for the
+tier-1 gate (`make overload-smoke`). Prints ONE JSON line like the
+other benches; writes BENCH_r23.json on full runs. Run from the repo
+root: python benches/bench_overload.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+SMOKE = os.environ.get("BENCH_OVERLOAD_SMOKE", "") == "1"
+# (n, baseline heights) per run; the flood window is max(heights, 8)
+# blocks inside the scenario
+SCALES = [(4, 3)] if SMOKE else [(4, 5), (6, 4)]
+
+
+def main() -> None:
+    os.environ.setdefault("TENDERMINT_DEVD_SOCK", "/nonexistent/devd.sock")
+    os.environ.setdefault("TENDERMINT_TPU_PLATFORM", "cpu")
+
+    from tendermint_tpu.ops.localnet import LocalnetSpec, run_scenario
+
+    rows = []
+    port = 47700
+    for n, heights in SCALES:
+        root = tempfile.mkdtemp(prefix=f"bench-overload-{n}-")
+        spec = LocalnetSpec(n=n, root=root, seed=23, base_port=port)
+        port += 2 * n + 10
+        t0 = time.perf_counter()
+        r = run_scenario(spec, "overload", heights=heights)
+        wall = time.perf_counter() - t0
+        # the scenario already asserted the cadence floor, the shed
+        # visibility, the probe ordering, and byte identity — the bench
+        # records the measurables
+        rows.append({
+            "mode": f"overload:n={n}",
+            "nodes": n,
+            "baseline_heights_per_s": r["baseline_heights_per_s"],
+            "flood_heights_per_s": r["flood_heights_per_s"],
+            "cadence_ratio": r["cadence_ratio"],
+            "probe_height": r["probe_height"],
+            "marker_height": r["marker_height"],
+            "priority_blocks_ahead": r["marker_height"] - r["probe_height"],
+            "rpc_sheds": r["rpc_sheds"],
+            "lane_full_rejects": r["lane_full_rejects"],
+            "shed_writes_rejects": r["shed_writes_rejects"],
+            "ws_evictions": r["ws_evictions"],
+            "ws_dropped_events": r["ws_dropped_events"],
+            "overload_transitions": r["overload_transitions"],
+            "flood_statuses": r["flood_statuses"],
+            "converged_heights": r["converged_heights"],
+            "wall_s": round(wall, 1),
+        })
+
+    record = {
+        "bench": "overload",
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": "cpu",
+        "smoke": SMOKE,
+        "cores": os.cpu_count(),
+        "rows": rows,
+    }
+    if not SMOKE:
+        with open(os.path.join(ROOT, "BENCH_r23.json"), "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

@@ -142,6 +142,7 @@ BENCHES = {
     "20_localnet": [sys.executable, "benches/bench_localnet.py"],
     "21_devd_shard": [sys.executable, "benches/bench_devd_shard.py"],
     "22_upgrade": [sys.executable, "benches/bench_upgrade.py"],
+    "23_overload": [sys.executable, "benches/bench_overload.py"],
 }
 
 

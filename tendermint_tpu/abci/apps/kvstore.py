@@ -46,6 +46,20 @@ VAL_TX_PREFIX = b"val:"
 # never deletes — an authenticated tree without delete coverage would
 # leave the absence-proof/delta-delete planes untested end to end)
 DEL_TX_PREFIX = b"rm:"
+# round 23 (docs/serving.md): app-visible mempool lane hints. A "pri:"
+# key routes to the priority lane, "bulk:" to the bulk lane; delivery is
+# untouched (the prefix stays part of the key, so blocks are
+# byte-identical whether or not the mempool honors the hint).
+PRI_TX_PREFIX = b"pri:"
+BULK_TX_PREFIX = b"bulk:"
+
+
+def tx_priority_hint(tx: bytes) -> int:
+    if tx.startswith(PRI_TX_PREFIX):
+        return 1
+    if tx.startswith(BULK_TX_PREFIX):
+        return -1
+    return 0
 
 # round 14 (docs/execution-pipeline.md): keyspace-sharded parallel apply.
 # TENDERMINT_KVSTORE_SHARDS=N (>1) routes whole-block DeliverTx batches
@@ -87,7 +101,7 @@ class KVStoreApp(Application):
         )
 
     def check_tx(self, tx: bytes) -> ResponseCheckTx:
-        return ResponseCheckTx(code=CODE_OK)
+        return ResponseCheckTx(code=CODE_OK, priority=tx_priority_hint(tx))
 
     def deliver_tx(self, tx: bytes) -> ResponseDeliverTx:
         if tx.startswith(DEL_TX_PREFIX):
@@ -418,7 +432,7 @@ class PersistentKVStoreApp(KVStoreApp):
             err = self._parse_val_tx(tx) is None
             if err:
                 return ResponseCheckTx(code=CODE_UNAUTHORIZED, log="bad val tx")
-        return ResponseCheckTx(code=CODE_OK)
+        return ResponseCheckTx(code=CODE_OK, priority=tx_priority_hint(tx))
 
     def _parse_val_tx(self, tx: bytes):
         try:

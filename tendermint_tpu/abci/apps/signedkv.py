@@ -23,7 +23,7 @@ from tendermint_tpu.abci.types import (
     ResponseCheckTx,
     ResponseDeliverTx,
 )
-from tendermint_tpu.abci.apps.kvstore import KVStoreApp
+from tendermint_tpu.abci.apps.kvstore import KVStoreApp, tx_priority_hint
 
 SIG_TX_OVERHEAD = 96  # pubkey(32) + sig(64)
 
@@ -69,7 +69,9 @@ class SignedKVStoreApp(KVStoreApp):
             return ResponseCheckTx(code=CODE_UNAUTHORIZED, log="malformed signed tx")
         if self.verify_in_app and not self._verify(tx):
             return ResponseCheckTx(code=CODE_UNAUTHORIZED, log="invalid signature")
-        return ResponseCheckTx()
+        # lane hint rides the inner payload: a signed "pri:..." kv tx
+        # lands in the priority lane just like its unsigned counterpart
+        return ResponseCheckTx(priority=tx_priority_hint(tx[SIG_TX_OVERHEAD:]))
 
     def deliver_tx(self, tx: bytes) -> ResponseDeliverTx:
         if not self._verify(tx):

@@ -336,12 +336,16 @@ class SocketClient(ABCIClient):
                 with self._pending_mtx:
                     rr = self._pending.pop(0)
                 res = self._decode(rr.req_type, obj)
-                rr.complete(res)
                 if self._res_cb and rr.req_type in ("check_tx", "deliver_tx"):
-                    # callback contract: tx as raw bytes, same as LocalClient
+                    # callback contract: tx as raw bytes, and the GLOBAL
+                    # callback fires before per-request completion — same
+                    # as LocalClient. The mempool's admission path relies
+                    # on this order: a lane-full rejection mutates the
+                    # response before any broadcast_tx waiter sees it.
                     tx_hex = obj.get("_tx")
                     tx = bytes.fromhex(tx_hex) if tx_hex else None
                     self._res_cb(rr.req_type, tx, res)
+                rr.complete(res)
         except Exception as e:
             self._err = e
         # receive loop is done (EOF or error): release every in-flight

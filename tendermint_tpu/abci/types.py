@@ -14,6 +14,7 @@ CODE_OK = 0
 CODE_BAD_NONCE = 4  # counter-app style ordering violation
 CODE_UNAUTHORIZED = 3
 CODE_UNSUPPORTED = 5  # query feature the app cannot serve (e.g. prove=True)
+CODE_MEMPOOL_FULL = 6  # shed at a mempool lane cap / load-shed ladder (round 23)
 
 
 def proofs_unsupported_response(app, key: bytes) -> "ResponseQuery":
@@ -106,17 +107,30 @@ class ResponseCheckTx:
     code: int = CODE_OK
     data: bytes = b""
     log: str = ""
+    # app-visible priority hint: >0 routes the tx to the mempool's
+    # priority lane, <0 to the bulk lane, 0 (the default) to the default
+    # lane. Key-absent on the wire when 0 so pre-existing CheckTx JSON
+    # stays byte-identical (same pattern as the aggregate-commit fields).
+    priority: int = 0
 
     @property
     def is_ok(self) -> bool:
         return self.code == CODE_OK
 
     def to_json(self):
-        return {"code": self.code, "data": self.data.hex().upper(), "log": self.log}
+        obj = {"code": self.code, "data": self.data.hex().upper(), "log": self.log}
+        if self.priority:
+            obj["priority"] = self.priority
+        return obj
 
     @classmethod
     def from_json(cls, obj):
-        return cls(obj.get("code", 0), bytes.fromhex(obj.get("data", "")), obj.get("log", ""))
+        return cls(
+            obj.get("code", 0),
+            bytes.fromhex(obj.get("data", "")),
+            obj.get("log", ""),
+            obj.get("priority", 0),
+        )
 
 
 @dataclass

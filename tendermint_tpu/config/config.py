@@ -64,6 +64,16 @@ class RPCConfig:
     laddr: str = "tcp://0.0.0.0:46657"
     grpc_laddr: str = ""
     unsafe: bool = False
+    # -- ingress admission (round 23, docs/serving.md) ------------------
+    # every knob here has a TENDERMINT_RPC_* env twin (env wins, read
+    # per request — live-tunable under fire). 0 disables a limit.
+    max_connections: int = 512  # concurrent HTTP/WS connections
+    max_inflight: int = 256  # concurrently-executing requests
+    rate_limit: float = 0.0  # per-client-IP requests/s (unix peers exempt)
+    rate_burst: float = 0.0  # bucket depth; 0 -> 2x rate_limit
+    deadline_s: float = 0.0  # per-request budget; waits inside handlers obey it
+    ws_send_queue: int = 256  # per-WS-client bounded event queue
+    ws_max_clients: int = 200  # concurrent WS subscribers
 
 
 @dataclass
@@ -96,6 +106,18 @@ class MempoolConfig:
     recheck_empty: bool = True
     broadcast: bool = True
     wal_path: str = "data/mempool.wal"
+    # -- priority lanes (round 23, docs/serving.md) ---------------------
+    # per-lane count/byte caps; reap drains priority -> default -> bulk.
+    # TENDERMINT_MEMPOOL_LANE_<LANE>_MAX_TXS / _MAX_BYTES env twins win.
+    lane_priority_max_txs: int = 10_000
+    lane_priority_max_bytes: int = 32 * 1024 * 1024
+    lane_default_max_txs: int = 50_000
+    lane_default_max_bytes: int = 64 * 1024 * 1024
+    lane_bulk_max_txs: int = 20_000
+    lane_bulk_max_bytes: int = 32 * 1024 * 1024
+    # per-source in-pool tx cap (source = rpc client IP or peer id);
+    # 0 disables. TENDERMINT_MEMPOOL_SOURCE_MAX_TXS wins.
+    source_max_txs: int = 0
 
     def wal_dir(self) -> str:
         return _root_join(self.root_dir, self.wal_path)

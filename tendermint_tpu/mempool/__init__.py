@@ -1,3 +1,15 @@
-from tendermint_tpu.mempool.mempool import Mempool, TxInCacheError
+from tendermint_tpu.mempool.mempool import (
+    LANES,
+    Mempool,
+    MempoolFullError,
+    MempoolSourceLimitError,
+    TxInCacheError,
+)
 
-__all__ = ["Mempool", "TxInCacheError"]
+__all__ = [
+    "LANES",
+    "Mempool",
+    "MempoolFullError",
+    "MempoolSourceLimitError",
+    "TxInCacheError",
+]

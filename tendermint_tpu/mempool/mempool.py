@@ -18,11 +18,34 @@ import threading
 import time
 from collections import OrderedDict
 
-from tendermint_tpu.abci.types import CODE_UNAUTHORIZED, ResponseCheckTx
+from tendermint_tpu.abci.types import (
+    CODE_MEMPOOL_FULL,
+    CODE_UNAUTHORIZED,
+    ResponseCheckTx,
+)
 from tendermint_tpu.libs.autofile import Group
 from tendermint_tpu.libs.clist import CList
+from tendermint_tpu.libs.envknob import env_number
 
 CACHE_SIZE = 100_000
+
+# Priority lanes (round 23, docs/serving.md): reap drains in this order,
+# FIFO within a lane. Gossip stays lane-blind — one CList in arrival
+# order is what the reactor walks, so the wire format is unchanged and
+# byte-identical blocks stay byte-identical.
+LANES = ("priority", "default", "bulk")
+# load-shed ladder levels (mirrored in node/health.py; duplicated here so
+# the mempool has no node-package import)
+PRESSURE_SHED_WRITES = 2
+
+
+def lane_for_priority(priority: int) -> str:
+    """App CheckTx priority hint -> lane name (>0 priority, <0 bulk)."""
+    if priority > 0:
+        return LANES[0]
+    if priority < 0:
+        return LANES[2]
+    return LANES[1]
 
 logger = logging.getLogger("mempool")
 
@@ -213,16 +236,30 @@ class TxInCacheError(Exception):
     """Tx already seen (mempool/mempool.go:162)."""
 
 
+class MempoolFullError(Exception):
+    """Pool at the sum of its lane caps: shed at intake, before any app
+    dispatch (round 23). Stable reason string for the RPC layer."""
+
+
+class MempoolSourceLimitError(Exception):
+    """One source (rpc IP / peer id) holds its full in-pool tx budget —
+    shed ITS txs so it can't crowd out other clients' lanes (round 23)."""
+
+
 class MemTx:
     """A good tx in the pool, tagged with the height it was checked at
-    (mempool/mempool.go:407-410)."""
+    (mempool/mempool.go:407-410) plus its lane and admitting source
+    (round 23 accounting)."""
 
-    __slots__ = ("counter", "height", "tx")
+    __slots__ = ("counter", "height", "tx", "lane", "source")
 
-    def __init__(self, counter: int, height: int, tx: bytes):
+    def __init__(self, counter: int, height: int, tx: bytes,
+                 lane: str = "default", source: str = ""):
         self.counter = counter
         self.height = height
         self.tx = tx
+        self.lane = lane
+        self.source = source
 
 
 class TxCache:
@@ -272,6 +309,40 @@ class Mempool:
         # valid-but-DUPLICATE arm of a mempool flood (one int += on the
         # dup path only; the clean path pays nothing)
         self.cache_dups = 0
+        # -- priority lanes + per-source accounting (round 23) ----------
+        # lane caps from config with TENDERMINT_MEMPOOL_LANE_* env twins
+        # (env wins — the DeviceConfig precedence rule)
+        self.lane_caps: dict[str, tuple[int, int]] = {}
+        for lane in LANES:
+            self.lane_caps[lane] = (
+                int(env_number(
+                    f"TENDERMINT_MEMPOOL_LANE_{lane.upper()}_MAX_TXS",
+                    getattr(config, f"lane_{lane}_max_txs", 0), cast=int)),
+                int(env_number(
+                    f"TENDERMINT_MEMPOOL_LANE_{lane.upper()}_MAX_BYTES",
+                    getattr(config, f"lane_{lane}_max_bytes", 0), cast=int)),
+            )
+        # whole-pool intake cap = sum of lane tx caps; any uncapped
+        # (0) lane uncaps the pool too — 0 always means "no limit"
+        caps = [c for c, _b in self.lane_caps.values()]
+        self.pool_cap = sum(caps) if all(caps) else 0
+        self.source_max_txs = int(env_number(
+            "TENDERMINT_MEMPOOL_SOURCE_MAX_TXS",
+            getattr(config, "source_max_txs", 0), cast=int))
+        self.lane_counts = {lane: 0 for lane in LANES}
+        self.lane_bytes = {lane: 0 for lane in LANES}
+        self.lane_full = {lane: 0 for lane in LANES}  # rejects per lane
+        self.pool_full_rejects = 0
+        self.source_limited = 0
+        self.shed_writes = 0
+        # in-pool txs per source key ("rpc:<ip>" / "peer:<id>"); entries
+        # drop at 0 so cardinality is bounded by pool size
+        self.source_counts: dict[str, int] = {}
+        # tx -> source for in-flight CheckTx (popped at every terminal)
+        self._pending_source: dict[bytes, str] = {}
+        # load-shed ladder probe, wired by the node to
+        # OverloadMonitor.level; None (bare harnesses) = never shed
+        self.pressure_fn = None
         self.wal: Group | None = None
         # recheck cursor: txs in [recheck_cursor, recheck_end] are being
         # re-validated post-commit (mempool/mempool.go:72-75)
@@ -346,6 +417,9 @@ class Mempool:
                 nxt = el.next()
                 self.txs.remove(el)
                 el = nxt
+            self.lane_counts = {lane: 0 for lane in LANES}
+            self.lane_bytes = {lane: 0 for lane in LANES}
+            self.source_counts.clear()
 
     def txs_front(self):
         return self.txs.front()
@@ -355,17 +429,39 @@ class Mempool:
 
     # -- checktx -----------------------------------------------------------
 
-    def check_tx(self, tx: bytes, cb=None, source: str = "rpc") -> None:
+    def check_tx(self, tx: bytes, cb=None, source: str = "rpc",
+                 source_id: str = "") -> None:
         """Validate tx against the app; good txs enter the pool when the
         async response lands (mempool/mempool.go:166-205). With a
         SigBatcher wired, sig-carrying txs first pass the batched
         signature gate — invalid signatures are rejected here without
         ever reaching the app. `source` tags the tx-lifecycle trace
-        (round 17): "rpc" for a client submit, "peer" for gossip."""
+        (round 17): "rpc" for a client submit, "peer" for gossip.
+        `source_id` (round 23) narrows it to the specific client IP /
+        peer id for per-source admission accounting; intake sheds raise
+        typed errors (MempoolFullError / MempoolSourceLimitError) with
+        stable reason strings the RPC layer forwards verbatim."""
+        src_key = f"{source}:{source_id}" if source_id else source
         with self._mtx:
             if not self.cache.push(tx):
                 self.cache_dups += 1
                 raise TxInCacheError(tx.hex()[:16])
+            if self.pool_cap and len(self.txs) >= self.pool_cap:
+                # pool at the sum of its lane caps: fail fast at intake,
+                # before WAL/gate/app work. Cache entry dropped so the tx
+                # can resubmit once the pool drains.
+                self.pool_full_rejects += 1
+                self.cache.remove(tx)
+                raise MempoolFullError(
+                    f"mempool_full: {len(self.txs)} txs >= cap {self.pool_cap}")
+            if (self.source_max_txs
+                    and self.source_counts.get(src_key, 0) >= self.source_max_txs):
+                self.source_limited += 1
+                self.cache.remove(tx)
+                raise MempoolSourceLimitError(
+                    f"mempool_source_limit: {src_key} holds "
+                    f">={self.source_max_txs} txs")
+            self._pending_source[tx] = src_key
             # lifecycle ingress, inlined (the <2% discipline): an
             # untraced tx pays ONE local-attribute countdown decrement;
             # only the sampled tx enters the recorder (which re-arms
@@ -386,6 +482,7 @@ class Mempool:
                         # gate saturated: refuse retriably, never grow an
                         # unbounded backlog off a peer-driven path
                         self.cache.remove(tx)
+                        self._pending_source.pop(tx, None)
                         if self._txtrace is not None:
                             # a traced tx leaving the lifecycle here
                             # must seal, not linger as a false PARKED
@@ -454,6 +551,7 @@ class Mempool:
         same cache semantics as an app-rejected tx (allow resubmission,
         mempool/mempool.go:231)."""
         self.cache.remove(tx)
+        self._pending_source.pop(tx, None)
         if cb is not None:
             cb(ResponseCheckTx(code=CODE_UNAUTHORIZED,
                                log="invalid signature (batch pre-verify)"))
@@ -469,13 +567,45 @@ class Mempool:
             self._res_cb_recheck(tx, res)
 
     def _res_cb_normal(self, tx: bytes, res: ResponseCheckTx) -> None:
+        src = self._pending_source.pop(tx, "")
         if res.is_ok:
+            # lane admission (round 23): the app's priority hint picks
+            # the lane; a full lane or a shed-writes ladder level rejects
+            # by MUTATING the response — the ABCI clients fire this
+            # global callback before per-request completion, so every
+            # broadcast_tx waiter sees the typed rejection.
+            lane = lane_for_priority(getattr(res, "priority", 0))
+            cap_txs, cap_bytes = self.lane_caps[lane]
+            if (cap_txs and self.lane_counts[lane] >= cap_txs) or (
+                    cap_bytes and self.lane_bytes[lane] + len(tx) > cap_bytes):
+                self.lane_full[lane] += 1
+                self.cache.remove(tx)
+                if self._txtrace is not None:
+                    self._txtrace.reject(tx, "lane_full")
+                res.code = CODE_MEMPOOL_FULL
+                res.log = f"mempool_lane_full:{lane}"
+                return
+            pressure = self.pressure_fn() if self.pressure_fn is not None else 0
+            if pressure >= PRESSURE_SHED_WRITES and lane != LANES[0]:
+                # ladder at shed-writes: only the priority lane still
+                # admits (reads were already shed at the RPC edge)
+                self.shed_writes += 1
+                self.cache.remove(tx)
+                if self._txtrace is not None:
+                    self._txtrace.reject(tx, "shed_writes")
+                res.code = CODE_MEMPOOL_FULL
+                res.log = f"mempool_shed_writes:{lane}"
+                return
             if self._admit_rec is not None:
                 # ungated path only: the sig-gate path already stamped
                 # admit batch-granularly (_sig_gate_results)
                 self._admit_rec.stamp(tx, "mempool_admit")
             self.counter += 1
-            self.txs.push_back(MemTx(self.counter, self.height, tx))
+            self.txs.push_back(MemTx(self.counter, self.height, tx, lane, src))
+            self.lane_counts[lane] += 1
+            self.lane_bytes[lane] += len(tx)
+            if src:
+                self.source_counts[src] = self.source_counts.get(src, 0) + 1
             self._notify_txs_available()
         else:
             # bad tx: allow future resubmission (mempool/mempool.go:231)
@@ -495,6 +625,7 @@ class Mempool:
             # tx invalidated by the last block: evict from the pool AND the
             # cache — it might become good again later (mempool.go:258-259)
             self.txs.remove(cursor)
+            self._forget(memtx)
             self.cache.remove(tx)
         if cursor is self.recheck_end:
             self.recheck_cursor = None
@@ -519,16 +650,25 @@ class Mempool:
     # -- consensus interface ----------------------------------------------
 
     def reap(self, max_txs: int) -> list[bytes]:
-        """Up to max_txs good txs in order; -1 = all (mempool/mempool.go:300-327).
-        Waits for outstanding CheckTx responses first."""
+        """Up to max_txs good txs, lanes drained in priority order
+        (priority -> default -> bulk, FIFO within a lane; -1 = all).
+        With every tx in the default lane this is exactly the reference's
+        FIFO reap (mempool/mempool.go:300-327). Waits for outstanding
+        CheckTx responses first."""
         with self._mtx:
             if self.height > 0:
                 self.proxy_app_conn.flush_sync()
-            out = []
+            by_lane: dict[str, list[bytes]] = {lane: [] for lane in LANES}
             el = self.txs.front()
-            while el is not None and (max_txs < 0 or len(out) < max_txs):
-                out.append(el.value.tx)
+            while el is not None:
+                # unknown lane tag (hand-built MemTx) rides the default lane
+                by_lane.get(el.value.lane, by_lane["default"]).append(el.value.tx)
                 el = el.next()
+            out: list[bytes] = []
+            for lane in LANES:
+                out.extend(by_lane[lane])
+            if max_txs >= 0:
+                del out[max_txs:]
             return out
 
     def update(self, height: int, txs: list[bytes]) -> None:
@@ -545,6 +685,22 @@ class Mempool:
             # fires _res_cb_recheck for each in-flight response
             self.proxy_app_conn.flush_async()
 
+    def _forget(self, memtx: MemTx) -> None:
+        """Reverse the lane/source accounting of one pool departure."""
+        lane = memtx.lane
+        if lane in self.lane_counts:
+            self.lane_counts[lane] = max(0, self.lane_counts[lane] - 1)
+            self.lane_bytes[lane] = max(0, self.lane_bytes[lane] - len(memtx.tx))
+        src = memtx.source
+        if src:
+            left = self.source_counts.get(src, 0) - 1
+            if left > 0:
+                self.source_counts[src] = left
+            else:
+                # entries drop at zero: per-source cardinality stays
+                # bounded by the pool, not by client-IP churn
+                self.source_counts.pop(src, None)
+
     def _filter_txs(self, block_txs: set[bytes]) -> list:
         good = []
         el = self.txs.front()
@@ -552,6 +708,7 @@ class Mempool:
             nxt = el.next()
             if el.value.tx in block_txs:
                 self.txs.remove(el)
+                self._forget(el.value)
             else:
                 good.append(el)
             el = nxt

@@ -90,8 +90,11 @@ class MempoolReactor(Reactor, BaseService):
             self.switch.stop_peer_for_error(peer, exc)
             return
         try:
-            self.mempool.check_tx(tx, source="peer")
-        except Exception:  # noqa: BLE001 — dup-in-cache / app reject: fine
+            # peer id keys the mempool's per-source admission accounting
+            # (round 23): one flooding peer exhausts ITS budget, not the
+            # lanes other sources share
+            self.mempool.check_tx(tx, source="peer", source_id=str(peer.id()))
+        except Exception:  # noqa: BLE001 — dup/full/source-limit/app reject: fine
             pass
 
     # -- gossip ------------------------------------------------------------

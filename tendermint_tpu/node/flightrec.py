@@ -35,6 +35,11 @@ harnesses pay nothing):
                                            switch-to-consensus)
     exception     thread, err              unhandled consensus-thread
                                            exception (also dumps)
+    overload      level, prev, score,      load-shed ladder level
+                  frac_*                   transition (round 23,
+                                           node/health.OverloadMonitor)
+                                           with the per-input fill
+                                           fractions that drove it
 
 Auto-dump triggers (each exactly once per episode; the latch re-arms
 when the condition clears):

@@ -32,6 +32,16 @@ env knob (libs/envknob — a typo'd value keeps the default):
     TENDERMINT_HEALTH_WAL_SYNC_AGE_S          (30)
     TENDERMINT_HEALTH_MEMPOOL_DEGRADED        (50000)
 
+Round 23 adds the load-shed ladder (OverloadMonitor below,
+docs/serving.md): one pressure score folded from mempool depth, RPC
+in-flight, WS queue depths, and the apply backlog, mapped to
+ok -> shed-reads -> shed-writes and consulted by rpc/admission and the
+mempool's lane admission. Ladder knobs:
+
+    TENDERMINT_OVERLOAD_SHED_READS_AT         (0.75)
+    TENDERMINT_OVERLOAD_SHED_WRITES_AT        (0.90)
+    TENDERMINT_OVERLOAD_APPLY_BACKLOG_CAP     (8)
+
 The flat ``node_health_*`` gauges (node/telemetry.py wires the producer)
 export the same verdict numerically: status 0=ok / 1=degraded /
 2=failing, so alerting needs no JSON endpoint.
@@ -191,3 +201,110 @@ def health_gauges(node) -> dict:
         "checks_degraded": sum(1 for c in checks if c["status"] == DEGRADED),
         "checks_failing": sum(1 for c in checks if c["status"] == FAILING),
     }
+
+
+# -- load-shed ladder (round 23, docs/serving.md) ---------------------------
+
+PRESSURE_OK = 0
+PRESSURE_SHED_READS = 1
+PRESSURE_SHED_WRITES = 2
+PRESSURE_NAMES = {PRESSURE_OK: "ok", PRESSURE_SHED_READS: "shed_reads",
+                  PRESSURE_SHED_WRITES: "shed_writes"}
+
+
+def _ladder_knobs() -> dict:
+    """Read per call (live-tunable). The score is the max fill fraction
+    across the pressure inputs; the rungs are fractions of saturation."""
+    return {
+        "shed_reads_at": float(
+            env_number("TENDERMINT_OVERLOAD_SHED_READS_AT", 0.75)),
+        "shed_writes_at": float(
+            env_number("TENDERMINT_OVERLOAD_SHED_WRITES_AT", 0.90)),
+        "apply_backlog_cap": int(
+            env_number("TENDERMINT_OVERLOAD_APPLY_BACKLOG_CAP", 8, cast=int)),
+    }
+
+
+class OverloadMonitor:
+    """ONE pressure signal for every ingress layer (the tentpole's
+    ladder): folds mempool depth, RPC in-flight, WS send-queue depth and
+    the apply-executor backlog into a saturation score, maps the score
+    to a level (ok -> shed-reads -> shed-writes), and records a
+    flight-recorder ``overload`` event on every level transition.
+
+    Consulted per request by rpc/admission and per admit by the mempool,
+    so the evaluation is cached for `ttl_s` — attribute reads only, but
+    thousands of requests/s shouldn't each walk the WS registry.
+    Consensus lanes (p2p vote/part channels, the apply executor) never
+    consult it: the ladder sheds edge traffic, never the core."""
+
+    def __init__(self, node, ttl_s: float = 0.25):
+        self.node = node
+        self.ttl_s = ttl_s
+        self._mtx = None  # plain attrs; races only re-evaluate the cache
+        self._cached_at = 0.0
+        self._level = PRESSURE_OK
+        self._score = 0.0
+        self._inputs: dict = {}
+        self.transitions = 0
+
+    def level(self) -> int:
+        now = time.monotonic()
+        if now - self._cached_at >= self.ttl_s:
+            self._evaluate(now)
+        return self._level
+
+    def snapshot(self) -> dict:
+        """Flat view for the node_overload_* telemetry producer."""
+        self.level()
+        out = {"level": self._level, "score": round(self._score, 4),
+               "transitions": self.transitions}
+        for k, v in self._inputs.items():
+            out[f"frac_{k}"] = round(v, 4)
+        return out
+
+    def _evaluate(self, now: float) -> None:
+        k = _ladder_knobs()
+        node = self.node
+        inputs: dict[str, float] = {}
+
+        mp = node.mempool
+        cap = mp.pool_cap
+        inputs["mempool"] = (mp.size() / cap) if cap else 0.0
+
+        admission = getattr(node, "rpc_admission", None)
+        if admission is not None:
+            max_inflight = admission.max_inflight()
+            inputs["rpc_inflight"] = (
+                admission.inflight / max_inflight if max_inflight else 0.0)
+            inputs["ws_queue"] = admission.ws_queue_frac()
+
+        cs = node.consensus_state
+        backlog = (len(cs._apply_executor._queue)
+                   if cs._apply_executor is not None else 0)
+        inputs["apply_backlog"] = min(
+            1.0, backlog / max(1, k["apply_backlog_cap"]))
+
+        score = max(inputs.values()) if inputs else 0.0
+        if score >= k["shed_writes_at"]:
+            level = PRESSURE_SHED_WRITES
+        elif score >= k["shed_reads_at"]:
+            level = PRESSURE_SHED_READS
+        else:
+            level = PRESSURE_OK
+        prev = self._level
+        self._score = score
+        self._inputs = inputs
+        self._level = level
+        self._cached_at = now
+        if level != prev:
+            self.transitions += 1
+            fr = getattr(node, "flightrec", None)
+            if fr is not None:
+                fr.record(
+                    "overload",
+                    level=PRESSURE_NAMES[level],
+                    prev=PRESSURE_NAMES[prev],
+                    score=round(score, 4),
+                    **{f"frac_{k_}": round(v, 4) for k_, v in inputs.items()},
+                )

@@ -433,6 +433,19 @@ class Node(BaseService):
         self.rpc_server = None
         self.grpc_server = None
 
+        # -- overload-control plane (round 23, docs/serving.md): one
+        # ingress admission controller shared with the RPC server (its
+        # counters feed telemetry), one pressure monitor feeding the
+        # load-shed ladder to both the RPC edge and the mempool's lane
+        # admission. Consensus paths never consult either.
+        from tendermint_tpu.node.health import OverloadMonitor
+        from tendermint_tpu.rpc.admission import AdmissionController
+
+        self.rpc_admission = AdmissionController(config.rpc)
+        self.overload = OverloadMonitor(self)
+        self.rpc_admission.pressure_fn = self.overload.level
+        self.mempool.pressure_fn = self.overload.level
+
         # -- telemetry plane (round 11): one registry wires every
         # subsystem's gauges + the process-wide instrument set; the
         # metrics RPC renders its flat legacy dict and GET /metrics its

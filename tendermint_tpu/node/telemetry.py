@@ -192,11 +192,23 @@ def build_registry(node) -> telemetry.Registry:
         # cache_dups: already-seen txs shed at the dedup cache — under
         # a duplicate flood this is the shed counter; on a quiet net it
         # counts benign gossip redundancy (round 18)
+        mp = node.mempool
         out = {
-            "size": node.mempool.size(),
-            "cache_dups": node.mempool.cache_dups,
+            "size": mp.size(),
+            "cache_dups": mp.cache_dups,
+            # priority lanes + intake sheds (round 23, docs/serving.md);
+            # the labeled mempool_lane_* families carry the same data
+            # per lane — these flats are the legacy-RPC/fleet view
+            "lane_priority_size": mp.lane_counts["priority"],
+            "lane_default_size": mp.lane_counts["default"],
+            "lane_bulk_size": mp.lane_counts["bulk"],
+            "lane_full_rejects": sum(mp.lane_full.values()),
+            "pool_full_rejects": mp.pool_full_rejects,
+            "source_limit_rejects": mp.source_limited,
+            "shed_writes_rejects": mp.shed_writes,
+            "sources": len(mp.source_counts),
         }
-        batcher = node.mempool.sig_batcher
+        batcher = mp.sig_batcher
         if batcher is not None:
             out["sig_gate_dropped"] = batcher.dropped
             out["sig_gate_delivered"] = batcher.delivered
@@ -205,6 +217,11 @@ def build_registry(node) -> telemetry.Registry:
         return out
 
     reg.register_producer("mempool", mempool)
+
+    # -- overload-control plane (round 23, docs/serving.md) -----------------
+    # flat views: the ingress admission counters and the ladder position
+    reg.register_producer("rpc", node.rpc_admission.snapshot)
+    reg.register_producer("node_overload", node.overload.snapshot)
 
     # collect-time refresh of the per-peer staleness gauge: an age only
     # means something at read time, so every scrape sets the labeled
@@ -456,5 +473,64 @@ def build_registry(node) -> telemetry.Registry:
                     child.inc(delta)
 
     reg.on_collect(refresh_endpoint_families)
+
+    # -- overload-control labeled families (round 23, docs/serving.md) -----
+    # every shed is visible BY REASON on the scrape surface; the sources
+    # are monotonic python ints, so children advance by delta-inc (the
+    # endpoint-family pattern above).
+    shed_counter = reg.counter(
+        "rpc_shed_total",
+        "RPC requests shed at the ingress admission edge, by reason",
+        labelnames=("reason",),
+    )
+    ws_evictions_counter = reg.counter(
+        "ws_evictions_total",
+        "WS subscribers evicted for persistent send-queue overflow",
+    )
+    ws_dropped_counter = reg.counter(
+        "ws_dropped_events_total",
+        "Events dropped from slow WS subscribers' bounded send queues",
+    )
+    lane_depth_gauge = reg.gauge(
+        "mempool_lane_depth",
+        "Txs currently pooled in this priority lane",
+        labelnames=("lane",),
+    )
+    lane_bytes_gauge = reg.gauge(
+        "mempool_lane_bytes",
+        "Bytes currently pooled in this priority lane",
+        labelnames=("lane",),
+    )
+    lane_full_counter = reg.counter(
+        "mempool_lane_full_total",
+        "CheckTx-ok txs rejected because this lane was at its cap",
+        labelnames=("lane",),
+    )
+
+    def refresh_overload_families() -> None:
+        admission = node.rpc_admission
+        for reason, total in admission.sheds.items():
+            child = shed_counter.labels(reason=reason)
+            delta = total - child.value
+            if delta > 0:
+                child.inc(delta)
+        for plain, source in (
+            (ws_evictions_counter, admission.ws_evictions),
+            (ws_dropped_counter, admission.ws_dropped_events),
+        ):
+            child = plain.labels()
+            delta = source - child.value
+            if delta > 0:
+                child.inc(delta)
+        mp = node.mempool
+        for lane in mp.lane_counts:
+            lane_depth_gauge.labels(lane=lane).set(mp.lane_counts[lane])
+            lane_bytes_gauge.labels(lane=lane).set(mp.lane_bytes[lane])
+            child = lane_full_counter.labels(lane=lane)
+            delta = mp.lane_full[lane] - child.value
+            if delta > 0:
+                child.inc(delta)
+
+    reg.on_collect(refresh_overload_families)
 
     return reg

@@ -47,6 +47,7 @@ import shutil
 import signal
 import subprocess
 import sys
+import threading
 import time
 import urllib.request
 from dataclasses import dataclass, field
@@ -102,7 +103,14 @@ class LocalnetSpec:
     # peer discovery: run the PEX reactor + address book on every node
     # (the pex_churn scenario's subject)
     pex: bool = False
+    # block tx cap baked into every config.toml (0 = the config default;
+    # the overload scenario shrinks it so a bulk backlog spans blocks)
+    max_block_txs: int = 0
     extra_args: list = field(default_factory=list)
+    # extra environment for every node process — how scenarios arm the
+    # TENDERMINT_RPC_* / TENDERMINT_MEMPOOL_LANE_* overload knobs
+    # (rpc/admission.py, mempool lanes) without touching config.toml
+    extra_env: dict = field(default_factory=dict)
 
     def resolved_topology(self) -> str:
         if self.topology:
@@ -204,6 +212,7 @@ class LocalNode:
             # whole discovery->dial->evict cycles inside a scenario
             # window (production default is 30 s between ensure rounds)
             env.setdefault("TENDERMINT_PEX_ENSURE_PERIOD_S", "2")
+        env.update({k: str(v) for k, v in self.spec.extra_env.items()})
         env["PYTHONPATH"] = REPO
         cmd = [
             sys.executable, "-m", "tendermint_tpu.cli",
@@ -243,6 +252,27 @@ class LocalNode:
             return int(self.rpc("status", timeout=5)["latest_block_height"])
         except Exception:  # noqa: BLE001 — down/starting counts as -1
             return -1
+
+    def metrics_height(self) -> int:
+        """Height via GET /metrics — the admission-exempt ops surface
+        (rpc/admission "ops" kind), so it reads true even while this
+        node's RPC ingress is rate-limiting or shedding reads."""
+        try:
+            m = self.metrics()
+            return int(fleet.metric_value(m, "consensus_height",
+                                          default=-1) or -1)
+        except Exception:  # noqa: BLE001
+            return -1
+
+    def flight_events(self, kind: str | None = None) -> list[dict]:
+        """The flight-recorder ring via GET /debug/flight (ops-exempt)."""
+        with urllib.request.urlopen(
+            f"http://{self.rpc_url}/debug/flight", timeout=10
+        ) as resp:
+            events = json.loads(resp.read()).get("events", [])
+        if kind is not None:
+            events = [e for e in events if e.get("kind") == kind]
+        return events
 
     def metrics(self) -> dict:
         return fleet.fetch_metrics(self.rpc_url)
@@ -332,6 +362,8 @@ class Localnet:
             for k, v in timeouts.items():
                 setattr(cfg.consensus, k, v)
             cfg.consensus.skip_timeout_commit = False
+            if spec.max_block_txs:
+                cfg.consensus.max_block_size_txs = spec.max_block_txs
             with open(os.path.join(home, "config.toml"), "w") as f:
                 f.write(config_to_toml(cfg))
             pv.file_path = cfg.base.priv_validator_file()
@@ -533,6 +565,102 @@ class Localnet:
         return compared
 
 
+# -- overload scenario helpers ------------------------------------------------
+
+# knobs the overload scenario arms on every node (spec.extra_env wins):
+# a per-IP rate limit the flood address must trip, a tiny WS send queue
+# with fast eviction, a bulk lane small enough to fill inside the
+# scenario window, and a request deadline so no handler wait outlives
+# the flood
+OVERLOAD_ENV_DEFAULTS = {
+    "TENDERMINT_RPC_RATE_LIMIT": "40",
+    "TENDERMINT_RPC_RATE_BURST": "80",
+    "TENDERMINT_RPC_WS_QUEUE": "8",
+    "TENDERMINT_RPC_WS_MAX_OVERFLOWS": "2",
+    "TENDERMINT_RPC_WS_SNDBUF": "8192",
+    "TENDERMINT_RPC_DEADLINE_S": "10",
+    "TENDERMINT_MEMPOOL_LANE_BULK_MAX_TXS": "150",
+}
+# distinct loopback source addresses: the per-IP token buckets throttle
+# each flood plane separately, and neither touches the 127.0.0.1
+# control-plane bucket the scenario driver uses
+OVERLOAD_WRITE_IP = "127.0.0.2"
+OVERLOAD_READ_IP = "127.0.0.3"
+
+
+def _flood_loop(port: int, method: str, make_params, stop, statuses: dict,
+                source_ip: str) -> None:
+    """One flood client pinned to `source_ip` via source_address.
+    Typed sheds (429/503) are the scenario working, not failures:
+    each HTTP status is tallied and the loop keeps pressing."""
+    import http.client
+
+    conn = None
+    i = 0
+    while not stop.is_set():
+        i += 1
+        try:
+            if conn is None:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=5,
+                    source_address=(source_ip, 0))
+            body = json.dumps({
+                "jsonrpc": "2.0", "id": i, "method": method,
+                "params": make_params(i),
+            }).encode()
+            conn.request("POST", "/", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            statuses[resp.status] = statuses.get(resp.status, 0) + 1
+        except Exception:  # noqa: BLE001 — refused/dropped connections
+            # under load are expected; reconnect and keep the pressure on
+            statuses["err"] = statuses.get("err", 0) + 1
+            try:
+                if conn is not None:
+                    conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+            conn = None
+
+
+def _slow_ws_subscribe(port: int):
+    """A deliberately-slow NewBlock subscriber: tiny receive buffer,
+    subscribes, then never reads a byte again. The server's bounded
+    send queue must absorb, drop-oldest, and finally evict it — without
+    the event bus ever blocking on this socket."""
+    import base64
+    import socket
+
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+    s.settimeout(10.0)
+    s.connect(("127.0.0.1", port))
+    key = base64.b64encode(os.urandom(16)).decode()
+    s.sendall((
+        f"GET /websocket HTTP/1.1\r\nHost: 127.0.0.1:{port}\r\n"
+        "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+        f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+    ).encode())
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = s.recv(4096)
+        if not chunk:
+            raise ConnectionError("ws handshake failed")
+        buf += chunk
+    if b"101" not in buf.split(b"\r\n", 1)[0]:
+        raise ConnectionError(f"ws handshake rejected: {buf[:120]!r}")
+    payload = json.dumps({
+        "jsonrpc": "2.0", "id": 1, "method": "subscribe",
+        "params": {"event": "NewBlock"},
+    }).encode()
+    mask = os.urandom(4)
+    frame = bytearray([0x81, 0x80 | len(payload)]) + mask + bytes(
+        c ^ mask[i % 4] for i, c in enumerate(payload))
+    s.sendall(bytes(frame))
+    return s
+
+
 # -- the scenario matrix ------------------------------------------------------
 
 
@@ -565,6 +693,17 @@ def run_scenario(spec: LocalnetSpec, scenario: str = "converge",
                       contains the domination (max_group bounded by
                       bucket hashing), evicts under pressure, and the
                       real net stays peered and committing
+    overload        — the round-23 overload-control proof: measure the
+                      unloaded cadence, then flood node 0 with bulk
+                      writes + hot reads from throttled source IPs and
+                      two deliberately-slow WS subscribers, while
+                      asserting consensus cadence stays within 1.5x the
+                      baseline, sheds are scrape-visible
+                      (rpc_shed_total / mempool_lane_full_total /
+                      ws_evictions_total), a priority probe tx commits
+                      AHEAD of a bulk marker submitted before it, the
+                      ladder transition landed in the flight ring, and
+                      per-height byte identity holds across the fleet
 
     Returns a flat JSON-able result row (heights/s, duplicate-vote
     ratio, fleet bytes — the bench's raw material)."""
@@ -575,6 +714,12 @@ def run_scenario(spec: LocalnetSpec, scenario: str = "converge",
     if scenario == "pex_churn":
         spec.topology = spec.topology or "star"
         spec.pex = True
+    if scenario == "overload":
+        # small blocks so the bulk backlog spans several heights (the
+        # priority-ordering proof needs the marker to wait its turn)
+        spec.max_block_txs = spec.max_block_txs or 10
+        for k, v in OVERLOAD_ENV_DEFAULTS.items():
+            spec.extra_env.setdefault(k, v)
     net = Localnet(spec)
     try:
         net.generate()
@@ -767,10 +912,187 @@ def run_scenario(spec: LocalnetSpec, scenario: str = "converge",
             result["book_sizes"] = [int(s) for s in sizes]
             result["book_max_groups"] = [int(g) for g in max_groups]
             result["book_evictions"] = int(evictions)
+        elif scenario == "overload":
+            assert spec.n >= 2, "overload needs n >= 2 (byte identity)"
+            target_node = net.nodes[0]
+            # unloaded baseline cadence, measured AFTER boot settles so
+            # genesis/dial time doesn't pollute the denominator
+            ok = net.wait_height(2, timeout=120.0)
+            assert ok, f"net never settled: {net.heights()}"
+            b0 = target_node.metrics_height()
+            t_b = time.monotonic()
+            ok = net.wait_height(b0 + heights, timeout=60.0 * heights)
+            assert ok, f"no unloaded convergence: {net.heights()}"
+            baseline_hps = heights / (time.monotonic() - t_b)
+            port = target_node.rpc_port
+            stop = threading.Event()
+            write_stats = [{} for _ in range(4)]
+            read_stats = [{} for _ in range(4)]
+            floods = [
+                threading.Thread(
+                    target=_flood_loop, daemon=True,
+                    args=(port, "broadcast_tx_async",
+                          lambda i, w=w: {
+                              "tx": f"bulk:f{w}-{i}=x".encode().hex()},
+                          stop, write_stats[w], OVERLOAD_WRITE_IP),
+                ) for w in range(4)
+            ] + [
+                threading.Thread(
+                    target=_flood_loop, daemon=True,
+                    args=(port, "status", lambda i: {}, stop, st,
+                          OVERLOAD_READ_IP),
+                ) for st in read_stats
+            ]
+            slow_socks: list = []
+            try:
+                for th in floods:
+                    th.start()
+                # phase 1 — build a multi-block bulk backlog, read off
+                # the scrape surface (ops-exempt even under the flood)
+                want = 5 * spec.max_block_txs
+                deadline = time.monotonic() + 90.0
+                depth = 0
+                while time.monotonic() < deadline:
+                    depth = fleet.metric_value(
+                        target_node.metrics(), "mempool_lane_bulk_size",
+                        default=0) or 0
+                    if depth >= want:
+                        break
+                    time.sleep(0.25)
+                assert depth >= want, (
+                    f"bulk backlog never built: {depth} < {want}")
+                # ordering probe: bulk marker FIRST (behind the
+                # backlog), priority probe SECOND — the probe must
+                # still commit at a strictly lower height. Retries
+                # because the driver shares node-side pressure sheds.
+                marker_hash = ""
+                deadline = time.monotonic() + 60.0
+                while not marker_hash and time.monotonic() < deadline:
+                    try:
+                        marker_hash = target_node.rpc(
+                            "broadcast_tx_async",
+                            {"tx": b"bulk:marker=1".hex()})["hash"]
+                    except Exception:  # noqa: BLE001 — lane-full/shed
+                        time.sleep(0.2)
+                assert marker_hash, "bulk marker never admitted"
+                probe_hash = ""
+                deadline = time.monotonic() + 60.0
+                while not probe_hash and time.monotonic() < deadline:
+                    try:
+                        probe_hash = target_node.rpc(
+                            "broadcast_tx_async",
+                            {"tx": b"pri:probe=1".hex()})["hash"]
+                    except Exception:  # noqa: BLE001
+                        time.sleep(0.2)
+                assert probe_hash, "priority probe never admitted"
+                # phase 2 — add the slow subscribers and measure the
+                # loaded cadence over a window long enough for their
+                # queues to fill, overflow, and evict
+                slow_socks = [_slow_ws_subscribe(port) for _ in range(2)]
+                flood_heights = max(heights, 8)
+                h0 = target_node.metrics_height()
+                t_f = time.monotonic()
+                deadline = t_f + 120.0 * flood_heights
+                while time.monotonic() < deadline:
+                    if target_node.metrics_height() >= h0 + flood_heights:
+                        break
+                    time.sleep(0.25)
+                h1 = target_node.metrics_height()
+                assert h1 >= h0 + flood_heights, (
+                    f"consensus stalled under flood: {h0} -> {h1}")
+                flood_hps = flood_heights / (time.monotonic() - t_f)
+                # the slow subscribers must get EVICTED, not merely
+                # lag. Their sockets stay OPEN here — closing them
+                # would read as dead clients (plain teardown), never
+                # as evictions. Empty blocks keep firing NewBlock
+                # after the floods stop, so keep scraping (ops-exempt)
+                # until the overflow ladder ejects at least one.
+                stop.set()
+                for th in floods:
+                    th.join(timeout=10)
+                deadline = time.monotonic() + 120.0
+                evictions = 0
+                while time.monotonic() < deadline:
+                    evictions = net.scrape_totals(["ws_evictions_total"])[
+                        "ws_evictions_total"]
+                    if evictions >= 1:
+                        break
+                    time.sleep(1.0)
+                assert evictions >= 1, (
+                    "no slow-subscriber eviction recorded")
+            finally:
+                stop.set()
+                for th in floods:
+                    th.join(timeout=10)
+                for s in slow_socks:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+            # the tentpole promise: the ladder shed reads and bulk
+            # writes BEFORE it let consensus slow past 1.5x baseline
+            assert flood_hps >= baseline_hps / 1.5, (
+                f"cadence degraded past 1.5x: {flood_hps:.2f} hps under "
+                f"flood vs {baseline_hps:.2f} unloaded")
+
+            def _tx_height(tx_hash: str, what: str) -> int:
+                # post-flood the node keeps committing (draining the
+                # backlog), so retry until the tx lands; also rides out
+                # any residual shed-reads window at the driver's edge
+                deadline = time.monotonic() + 180.0
+                while time.monotonic() < deadline:
+                    try:
+                        return int(target_node.rpc(
+                            "tx", {"hash": tx_hash})["height"])
+                    except Exception:  # noqa: BLE001 — not yet committed
+                        time.sleep(0.5)
+                raise AssertionError(f"{what} never committed")
+
+            probe_h = _tx_height(probe_hash, "priority probe")
+            marker_h = _tx_height(marker_hash, "bulk marker")
+            assert probe_h < marker_h, (
+                f"priority probe (h{probe_h}) did not beat the bulk "
+                f"marker (h{marker_h})")
+            # every shed is scrape-visible
+            totals = net.scrape_totals([
+                "rpc_shed_total", "mempool_lane_full_total",
+                "ws_dropped_events_total", "mempool_shed_writes_rejects",
+            ])
+            assert totals["rpc_shed_total"] > 0, totals
+            assert totals["mempool_lane_full_total"] > 0, totals
+            assert totals["ws_dropped_events_total"] > 0, totals
+            # the ladder transition landed in the flight ring
+            overload_events = target_node.flight_events("overload")
+            assert overload_events, "no overload event in the flight ring"
+            # per-height byte identity through the flood window —
+            # lanes reorder WITHIN a block's reap, never across nodes
+            target = min(h for h in net.heights() if h >= 0)
+            result["converged_heights"] = net.assert_converged(target)
+            result["heights"] = target
+            result["baseline_heights_per_s"] = round(baseline_hps, 3)
+            result["flood_heights_per_s"] = round(flood_hps, 3)
+            result["cadence_ratio"] = round(flood_hps / baseline_hps, 3)
+            result["probe_height"] = probe_h
+            result["marker_height"] = marker_h
+            result["rpc_sheds"] = int(totals["rpc_shed_total"])
+            result["lane_full_rejects"] = int(
+                totals["mempool_lane_full_total"])
+            result["shed_writes_rejects"] = int(
+                totals["mempool_shed_writes_rejects"])
+            result["ws_evictions"] = int(evictions)
+            result["ws_dropped_events"] = int(
+                totals["ws_dropped_events_total"])
+            result["overload_transitions"] = len(overload_events)
+            agg: dict = {}
+            for st in write_stats + read_stats:
+                for k, v in st.items():
+                    agg[str(k)] = agg.get(str(k), 0) + v
+            result["flood_statuses"] = agg
         else:
             raise ValueError(
                 f"unknown scenario {scenario!r}; known: converge, "
-                "partition_heal, rolling_restart, upgrade, pex_churn"
+                "partition_heal, rolling_restart, upgrade, pex_churn, "
+                "overload"
             )
         result["duplicate_vote_ratio"] = net.duplicate_vote_ratio()
         result["gossip_bytes"] = net.gossip_bytes()
@@ -795,7 +1117,7 @@ def main(argv=None) -> int:
                          "unless --keep)")
     ap.add_argument("--scenario", default="converge",
                     choices=["converge", "partition_heal", "rolling_restart",
-                             "upgrade", "pex_churn"])
+                             "upgrade", "pex_churn", "overload"])
     ap.add_argument("--heights", type=int, default=5)
     ap.add_argument("--topology", default="",
                     choices=["", "full", "ring", "star"])

@@ -10,12 +10,59 @@ from __future__ import annotations
 import threading
 import time
 
+from tendermint_tpu.mempool.mempool import (
+    MempoolFullError,
+    MempoolSourceLimitError,
+    TxInCacheError,
+)
+from tendermint_tpu.rpc import admission as _admission
 from tendermint_tpu.types import events as tev
 from tendermint_tpu.types.tx import tx_hash
 
 
 class RPCError(Exception):
     pass
+
+
+def _mempool_check_tx(ctx, tx, cb=None) -> None:
+    """check_tx with typed shed mapping (round 23): mempool intake
+    refusals become RPCError with STABLE reason strings (tx_in_cache /
+    mempool_full / mempool_source_limit), not generic 500s. The request's
+    client IP (rpc/admission thread-local) keys per-source accounting."""
+    try:
+        ctx.mempool.check_tx(tx, cb, source_id=_admission.request_source())
+    except TxInCacheError as exc:
+        raise RPCError(f"tx_in_cache: {exc}") from exc
+    except (MempoolFullError, MempoolSourceLimitError) as exc:
+        # str(exc) already leads with the stable reason string
+        raise RPCError(str(exc)) from exc
+
+
+def _deadline_wait(default_wait: float) -> float:
+    """Bound a handler wait by the request's admission deadline budget."""
+    left = _admission.deadline_remaining()
+    if left is None:
+        return default_wait
+    return min(default_wait, max(0.0, left))
+
+
+def _raise_deadline(ctx, what: str) -> None:
+    admission_ctl = getattr(getattr(ctx, "node", None), "rpc_admission", None)
+    if admission_ctl is not None:
+        admission_ctl.shed(_admission.SHED_DEADLINE)
+    raise RPCError(f"deadline_exceeded: {what}")
+
+
+def _wait_or_deadline(ctx, event: threading.Event, default_wait: float,
+                      what: str) -> None:
+    """Wait bounded by min(handler default, deadline budget); expiry of
+    the DEADLINE is a typed deadline_exceeded, of the handler's own
+    timeout the pre-existing timed-out error."""
+    wait = _deadline_wait(default_wait)
+    if not event.wait(wait):
+        if wait < default_wait:
+            _raise_deadline(ctx, what)
+        raise RPCError(f"timed out waiting for {what}")
 
 
 def _hex(b: bytes) -> str:
@@ -197,7 +244,7 @@ def dump_consensus_state(ctx) -> dict:
 
 def broadcast_tx_async(ctx, tx) -> dict:
     tx = _unhex(tx)
-    ctx.mempool.check_tx(tx)
+    _mempool_check_tx(ctx, tx)
     return {"hash": _hex(tx_hash(tx)), "code": 0, "data": "", "log": ""}
 
 
@@ -211,9 +258,8 @@ def broadcast_tx_sync(ctx, tx) -> dict:
         box["res"] = res
         done.set()
 
-    ctx.mempool.check_tx(tx, cb)
-    if not done.wait(10.0):
-        raise RPCError("timed out waiting for CheckTx")
+    _mempool_check_tx(ctx, tx, cb)
+    _wait_or_deadline(ctx, done, 10.0, "CheckTx")
     res = box["res"]
     return {
         "code": res.code,
@@ -245,9 +291,8 @@ def broadcast_tx_commit(ctx, tx, timeout: float = 60.0) -> dict:
             box["check"] = res
             check_done.set()
 
-        ctx.mempool.check_tx(tx, cb)
-        if not check_done.wait(10.0):
-            raise RPCError("timed out waiting for CheckTx")
+        _mempool_check_tx(ctx, tx, cb)
+        _wait_or_deadline(ctx, check_done, 10.0, "CheckTx")
         check = box["check"]
         check_json = {
             "code": check.code,
@@ -261,8 +306,8 @@ def broadcast_tx_commit(ctx, tx, timeout: float = 60.0) -> dict:
                 "hash": _hex(tx_hash(tx)),
                 "height": 0,
             }
-        if not committed.wait(timeout):
-            raise RPCError("timed out waiting for tx to be committed")
+        _wait_or_deadline(ctx, committed, float(timeout),
+                          "tx to be committed")
         d = box["deliver"]
         return {
             "check_tx": check_json,

@@ -22,10 +22,12 @@ import json
 import socket
 import struct
 import threading
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qsl, urlparse
 
 from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.rpc import admission as adm
 from tendermint_tpu.rpc.core.handlers import RPCError
 from tendermint_tpu.rpc.core.routes import build_routes
 
@@ -98,6 +100,13 @@ class RPCServer(BaseService):
         super().__init__(name="rpc.server")
         self.ctx = ctx
         self.routes = build_routes(unsafe)
+        # ingress admission (round 23, rpc/admission.py): the node wires
+        # a shared controller (node.rpc_admission) so telemetry and the
+        # load-shed ladder see it; bare harnesses get a private default
+        node = getattr(ctx, "node", None)
+        self.admission = (
+            getattr(node, "rpc_admission", None) or adm.AdmissionController()
+        )
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -105,6 +114,50 @@ class RPCServer(BaseService):
 
             def log_message(self, fmt, *args):  # route through our logger
                 server.logger.debug(fmt, *args)
+
+            # -- ingress admission (round 23) ------------------------------
+
+            def handle(self):
+                """Connection-cap gate ahead of any HTTP parsing: over
+                budget, the flood gets one cheap typed 503 and the thread
+                exits — never a parked worker."""
+                admit = server.admission.conn_acquire()
+                if not admit:
+                    # send_response needs these before a request is parsed
+                    self.requestline = ""
+                    self.request_version = self.protocol_version
+                    self.command = ""
+                    try:
+                        self._shed(admit)
+                    except OSError:
+                        pass
+                    self.close_connection = True
+                    return
+                try:
+                    super().handle()
+                finally:
+                    server.admission.conn_release()
+
+            def _shed(self, admit: adm.Admit, id_=None) -> None:
+                """Typed shed response: HTTP 429/503, Retry-After, and a
+                stable `shed:<reason>` JSON-RPC error string."""
+                body = _dumps({
+                    "jsonrpc": "2.0", "id": id_, "result": None,
+                    "error": f"shed:{admit.reason}",
+                })
+                self.send_response(admit.status)
+                self.send_header("Retry-After",
+                                 adm.retry_after_header(admit.retry_after))
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            @staticmethod
+            def _request_kind(method: str) -> str:
+                # writes reach the mempool's lanes even under shed-reads;
+                # everything else on the method surface is a read
+                return "write" if method.startswith("broadcast_tx") else "read"
 
             def _respond(self, payload: dict, status: int = 200) -> None:
                 body = _dumps(payload)
@@ -136,9 +189,18 @@ class RPCServer(BaseService):
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length)
                 id_ = None
+                admitted = False
                 try:
                     req = json.loads(raw.decode())
                     id_ = req.get("id")
+                    admit = server.admission.admit_request(
+                        self.client_address[0],
+                        self._request_kind(req.get("method", "")),
+                    )
+                    if not admit:
+                        self._shed(admit, id_)
+                        return
+                    admitted = True
                     params = req.get("params") or {}
                     if isinstance(params, list):
                         route = server.routes.get(req.get("method", ""))
@@ -151,12 +213,24 @@ class RPCServer(BaseService):
                 except Exception as exc:  # noqa: BLE001 — surface, don't die
                     server.logger.exception("rpc error")
                     self._rpc_error(id_, f"{type(exc).__name__}: {exc}")
+                finally:
+                    if admitted:
+                        server.admission.request_done()
 
             # -- GET URI + websocket (handlers.go:229-300, 351+) -----------
 
             def do_GET(self):
                 parsed = urlparse(self.path)
                 if parsed.path == "/websocket":
+                    admit = server.admission.admit_request(
+                        self.client_address[0], "ws")
+                    if not admit:
+                        self._shed(admit)
+                        return
+                    # the session must not hold an in-flight REQUEST slot
+                    # for its whole lifetime — subscriber count has its
+                    # own cap (ws_register, checked before the 101)
+                    server.admission.request_done()
                     self._serve_websocket()
                     return
                 if parsed.path == "/metrics":
@@ -183,6 +257,11 @@ class RPCServer(BaseService):
                 if not method:
                     self._respond({"routes": sorted(server.routes)})
                     return
+                admit = server.admission.admit_request(
+                    self.client_address[0], self._request_kind(method))
+                if not admit:
+                    self._shed(admit)
+                    return
                 params = {}
                 for k, v in parse_qsl(parsed.query):
                     try:
@@ -196,6 +275,8 @@ class RPCServer(BaseService):
                 except Exception as exc:  # noqa: BLE001
                     server.logger.exception("rpc error")
                     self._rpc_error("", f"{type(exc).__name__}: {exc}")
+                finally:
+                    server.admission.request_done()
 
             def _serve_prometheus(self):
                 from tendermint_tpu.libs import telemetry
@@ -274,6 +355,22 @@ class RPCServer(BaseService):
                 if not key:
                     self.send_error(400, "not a websocket upgrade")
                     return
+                conn = WSConnection(server, self.connection)
+                if not server.admission.ws_register(conn):
+                    # subscriber budget exhausted: typed 503 instead of
+                    # the 101 (counted under rpc_shed_total{ws_cap})
+                    self._shed(adm.Admit(False, 503, adm.SHED_WS_CAP, 1.0))
+                    return
+                sndbuf = server.admission.ws_sndbuf()
+                if sndbuf:
+                    # bounded kernel send buffer: a slow consumer's
+                    # backlog lands in the accounted send queue instead
+                    # of hiding in multi-MB socket buffers
+                    try:
+                        self.connection.setsockopt(
+                            socket.SOL_SOCKET, socket.SO_SNDBUF, sndbuf)
+                    except OSError:
+                        pass
                 accept = base64.b64encode(
                     hashlib.sha1((key + _WS_MAGIC).encode()).digest()
                 ).decode()
@@ -282,7 +379,7 @@ class RPCServer(BaseService):
                 self.send_header("Connection", "Upgrade")
                 self.send_header("Sec-WebSocket-Accept", accept)
                 self.end_headers()
-                WSConnection(server, self.connection).run()
+                conn.run()
                 self.close_connection = True
 
         if is_unix_laddr(laddr):
@@ -322,7 +419,16 @@ class RPCServer(BaseService):
 
 class WSConnection:
     """One WebSocket session: JSON-RPC calls + event subscriptions
-    (handlers.go:351-630)."""
+    (handlers.go:351-630).
+
+    Round 23 fan-out backpressure: outbound JSON rides a BOUNDED
+    per-client queue drained by this client's own writer thread, so the
+    event bus never blocks on a subscriber socket. Queue overflow drops
+    the oldest N messages (counted); a subscriber that keeps
+    overflowing is evicted (`ws_evictions_total`). Teardown is
+    idempotent and runs on EVERY exit path — reader error, writer error,
+    close frame, eviction — so a dead client can never leave a callback
+    on the event delivery path."""
 
     def __init__(self, server: RPCServer, sock: socket.socket):
         self.server = server
@@ -331,6 +437,10 @@ class WSConnection:
         self._listener_id = f"ws-{id(self):x}"
         self._subscribed: set[str] = set()
         self._closed = False
+        self._sendq: deque = deque()
+        self._q_cv = threading.Condition(threading.Lock())
+        self._overflows = 0
+        self._torn = False
 
     # -- frame IO (RFC 6455, server side: no masking on send) --------------
 
@@ -372,17 +482,84 @@ class WSConnection:
         with self._wmtx:
             self.sock.sendall(bytes(head) + payload)
 
+    def sendq_depth(self) -> int:
+        with self._q_cv:
+            return len(self._sendq)
+
     def send_json(self, obj) -> None:
-        if not self._closed:
-            try:
+        """Enqueue for this client's writer thread — the event-bus side
+        of the session NEVER touches the socket, so one slow consumer
+        cannot stall event delivery to anyone else."""
+        if self._closed:
+            return
+        admission = self.server.admission
+        qmax = admission.ws_send_queue()
+        evict = False
+        with self._q_cv:
+            if self._torn:
+                return
+            if qmax and len(self._sendq) >= qmax:
+                # drop-oldest N: the subscriber keeps the freshest
+                # events; repeated overflow means it can't keep up at
+                # all — evict rather than serve a permanently-lagged view
+                drop = min(max(1, qmax // 4), len(self._sendq))
+                for _ in range(drop):
+                    self._sendq.popleft()
+                self._overflows += 1
+                admission.ws_dropped(drop)
+                if self._overflows >= admission.ws_max_overflows():
+                    evict = True
+            if not evict:
+                self._sendq.append(obj)
+                self._q_cv.notify()
+        if evict:
+            admission.ws_evicted()
+            self._teardown()
+
+    def _writer_loop(self) -> None:
+        try:
+            while True:
+                with self._q_cv:
+                    while not self._sendq and not self._closed:
+                        self._q_cv.wait(0.5)
+                    if self._closed:
+                        return
+                    obj = self._sendq.popleft()
                 self._send_frame(0x1, _dumps(obj))
-            except OSError:
-                self._closed = True
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self._teardown()
+
+    def _teardown(self) -> None:
+        """Idempotent session teardown: deregister event callbacks,
+        leave the subscriber registry, close the socket (which unblocks
+        the reader), wake the writer. Safe from any thread."""
+        with self._q_cv:
+            if self._torn:
+                return
+            self._torn = True
+            self._closed = True
+            self._q_cv.notify_all()
+        evsw = getattr(self.server.ctx, "event_switch", None)
+        if evsw is not None:
+            try:
+                evsw.remove_listener(self._listener_id)
+            except Exception:  # noqa: BLE001 — teardown must finish
+                self.server.logger.exception("ws listener removal failed")
+        self.server.admission.ws_unregister(self)
+        try:
+            self.sock.close()
+        except OSError:
+            pass
 
     # -- session loop ------------------------------------------------------
 
     def run(self) -> None:
-        evsw = self.server.ctx.event_switch
+        writer = threading.Thread(
+            target=self._writer_loop, daemon=True, name="rpc.ws.writer"
+        )
+        writer.start()
         try:
             while not self._closed:
                 opcode, payload = self._read_frame()
@@ -398,9 +575,8 @@ class WSConnection:
         except (ConnectionError, OSError):
             pass
         finally:
-            self._closed = True
-            if evsw is not None:
-                evsw.remove_listener(self._listener_id)
+            self._teardown()
+            writer.join(timeout=2.0)
 
     def _handle(self, payload: bytes) -> None:
         id_ = None
@@ -430,6 +606,12 @@ class WSConnection:
             )
 
     def _subscribe(self, event: str) -> None:
+        admission = self.server.admission
+        if (admission.pressure_fn is not None
+                and admission.pressure_fn() >= adm.PRESSURE_SHED_READS):
+            # ladder rung 1: new subscriptions shed with the reads
+            admission.shed(adm.SHED_READS)
+            raise RPCError(f"shed:{adm.SHED_READS}")
         evsw = self.server.ctx.event_switch
         if evsw is None:
             raise RPCError("no event switch")

@@ -273,6 +273,18 @@ METRICS_REQUIRED_KEYS = (
     "wal_group_size", "wal_repairs", "wal_sync_age_s",
     # evidence + mempool (cache_dups: round-18 dup-flood shed counter)
     "evidence_count", "mempool_size", "mempool_cache_dups",
+    # overload-control plane (round 23): lane depths + intake shed
+    # accounting on the mempool, admission counters on the RPC edge,
+    # and the load-shed ladder's level/score
+    "mempool_lane_priority_size", "mempool_lane_default_size",
+    "mempool_lane_bulk_size", "mempool_lane_full_rejects",
+    "mempool_pool_full_rejects", "mempool_source_limit_rejects",
+    "mempool_shed_writes_rejects", "mempool_sources",
+    "rpc_inflight", "rpc_connections", "rpc_sheds",
+    "rpc_deadline_rejects", "rpc_ws_clients", "rpc_ws_evictions",
+    "rpc_ws_dropped_events",
+    "node_overload_level", "node_overload_score",
+    "node_overload_transitions",
     # p2p (round 15 adds the flat aggregates over the labeled
     # p2p_peer_* gossip families — the wedge signal on the legacy dict)
     "p2p_peers_outbound", "p2p_peers_inbound", "p2p_peers_dialing",
@@ -397,7 +409,12 @@ def test_prometheus_exposition_endpoint(node):
                 # in single-socket mode)
                 "gateway_endpoint_outstanding",
                 "gateway_endpoint_breaker_state",
-                "gateway_endpoint_sigs_per_s"):
+                "gateway_endpoint_sigs_per_s",
+                # round 23: overload-control plane — RPC admission,
+                # per-lane mempool depth, and the load-shed ladder
+                "rpc_inflight", "rpc_ws_clients",
+                "node_overload_level", "node_overload_score",
+                "mempool_lane_depth", "mempool_lane_bytes"):
         assert fam in families, fam
         assert families[fam] == "gauge"
     # round 18: the secret-connection transport counters, incl. the
@@ -423,7 +440,11 @@ def test_prometheus_exposition_endpoint(node):
                 # sharded device plane
                 "gateway_endpoint_dispatched_slices_total",
                 "gateway_endpoint_stolen_slices_total",
-                "gateway_endpoint_redispatches_total"):
+                "gateway_endpoint_redispatches_total",
+                # round 23: shed accounting by reason/lane + slow-WS
+                # eviction counters
+                "rpc_shed_total", "ws_evictions_total",
+                "ws_dropped_events_total", "mempool_lane_full_total"):
         assert families.get(fam) == "counter", fam
     # the latency-distribution instruments render as real histograms
     for fam in ("devd_stream_chunk_seconds", "devd_single_shot_seconds",
