@@ -24,7 +24,7 @@ test:
 # hold (dots no worse than the seed) — plus the chip-free hash-stream
 # smoke (the two asserted BENCH_r07 rows: streamed hash offload >= 1.3x
 # single-shot on the sim transport, flat host builder >= 1.5x recursive).
-tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke wan-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke retention-smoke localnet-smoke shard-smoke upgrade-smoke overload-smoke
+tier1: hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke wan-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke retention-smoke localnet-smoke shard-smoke upgrade-smoke overload-smoke replica-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Chip-free bench smoke: every BASELINE config on the pinned CPU backend,
@@ -207,6 +207,19 @@ upgrade-smoke:
 overload-smoke:
 	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_OVERLOAD_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_overload.py
 
+# Read-replica smoke, chip-free (~60-90 s): bench_replica.py's reduced
+# pass — the replica_flood scenario on ONE 4-process localnet with two
+# verified replica processes (plus one TAMPERING one) behind node 0. A
+# hot verified-read flood + WS subscribers land on the replicas while
+# the scenario asserts the validator's commit cadence stays flat,
+# replica-served blocks are byte-identical to the validator's, the
+# replica_* scrape rows move with zero proof failures, and a verifying
+# client rejects 100% of reads from the tampered replica. Runs as part
+# of `make tier1`; the full bench adds the 1/2/4-replica serving ladder
+# and writes BENCH_r24.json (docs/serving.md § Read replicas).
+replica-smoke:
+	JAX_PLATFORMS=cpu TENDERMINT_TPU_PLATFORM=cpu BENCH_REPLICA_SMOKE=1 timeout -k 10 300 $(PY) benches/bench_replica.py
+
 test_race:
 	$(PY) -m pytest tests/test_race.py -q
 
@@ -219,4 +232,4 @@ test_slow:
 native:
 	$(MAKE) -C native
 
-.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke wan-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke retention-smoke localnet-smoke shard-smoke upgrade-smoke overload-smoke
+.PHONY: test test_race test_integrations test_slow native tier1 bench-smoke hash-stream-smoke chaos-smoke wal-torture-smoke statesync-smoke statetree-smoke metrics-smoke net-chaos-smoke wan-smoke pipeline-smoke fleet-smoke committee-smoke txtrace-smoke retention-smoke localnet-smoke shard-smoke upgrade-smoke overload-smoke replica-smoke

@@ -105,6 +105,13 @@ Configs (BASELINE.md):
                   BENCH_r13.json; chip-free rows asserted, the
                   live-daemon row auto-appends on a tunnel window)
 
+24 replica      — verified read-replica tier: the replica_flood
+                  localnet scenario (cadence flat under flood, byte
+                  identity, 100% tamper rejection) + the serving
+                  ladder — verified reads/s and relayed WS events/s
+                  direct-to-validator vs 1/2/4 replica processes
+                  (writes BENCH_r24.json; chip-free)
+
 Each bench is its own process (the TPU is exclusive per process).
 Usage: python benches/run_all.py [--skip testnet,...]
 """
@@ -143,6 +150,7 @@ BENCHES = {
     "21_devd_shard": [sys.executable, "benches/bench_devd_shard.py"],
     "22_upgrade": [sys.executable, "benches/bench_upgrade.py"],
     "23_overload": [sys.executable, "benches/bench_overload.py"],
+    "24_replica": [sys.executable, "benches/bench_replica.py"],
 }
 
 

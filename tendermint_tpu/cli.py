@@ -1,7 +1,7 @@
 """Command-line interface (reference: cmd/tendermint/main.go:14-37 +
 cmd/tendermint/commands/*).
 
-Commands: init, node, testnet, gen_validator, show_validator,
+Commands: init, node, replica, testnet, gen_validator, show_validator,
 reset_all, reset_priv_validator, replay, replay_console, version.
 `--home` picks the node root (config.toml + genesis + privval + data).
 """
@@ -111,6 +111,44 @@ def cmd_node(args) -> int:
         node.stop()
         if race_mon is not None:
             print(race_mon.report())
+    return 0
+
+
+def cmd_replica(args) -> int:
+    """Run a verified read replica (round 24, docs/serving.md § Read
+    replicas): follows --upstream with a light client and serves the
+    read RPC surface from a proof-carrying cache."""
+    import logging
+
+    logging.basicConfig(
+        level=getattr(logging, (args.log_level or "info").upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    cfg = _load_config(args.home)
+    if args.upstream:
+        cfg.replica.upstream = args.upstream
+    if args.rpc_laddr:
+        cfg.replica.laddr = args.rpc_laddr
+    if args.max_lag_heights is not None:
+        cfg.replica.max_lag_heights = args.max_lag_heights
+
+    from tendermint_tpu.replica import ReplicaDaemon
+
+    daemon = ReplicaDaemon(cfg)
+    daemon.start()
+    print(
+        f"Started replica: upstream={cfg.replica.upstream} "
+        f"rpc_port={daemon.rpc_port}"
+    )
+
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.5)
+    finally:
+        daemon.stop()
     return 0
 
 
@@ -263,6 +301,24 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--log_level", default="info")
     sp.add_argument("--db_backend", default=None, help="sqlite | filedb | memdb")
     sp.set_defaults(fn=cmd_node)
+
+    sp = sub.add_parser(
+        "replica",
+        help="run a verified read replica following an upstream node "
+        "(docs/serving.md § Read replicas)",
+    )
+    sp.add_argument(
+        "--upstream", default=None,
+        help="upstream RPC address (host:port) — a node, or another replica",
+    )
+    sp.add_argument("--rpc.laddr", dest="rpc_laddr", default=None)
+    sp.add_argument(
+        "--max_lag_heights", type=int, default=None,
+        help="bounded staleness: refuse latest-reads when the verified "
+        "view lags upstream by more than this many heights",
+    )
+    sp.add_argument("--log_level", default="info")
+    sp.set_defaults(fn=cmd_replica)
 
     sp = sub.add_parser("testnet", help="initialize files for an N-node testnet")
     sp.add_argument("--n", type=int, default=4)

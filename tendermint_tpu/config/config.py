@@ -270,6 +270,37 @@ class DeviceConfig:
 
 
 @dataclass
+class ReplicaConfig:
+    """Verified read-replica daemon (round 24, docs/serving.md § Read
+    replicas): a stateless, proof-carrying read cache that follows an
+    upstream node's RPC with the light client and serves the read
+    surface. Every knob has a TENDERMINT_REPLICA_* env twin (env wins,
+    read per use — live-tunable)."""
+
+    root_dir: str = ""
+    # upstream RPC endpoint ("host:port" or "unix:///path.sock"). May
+    # itself be a replica — tiered fan-out; proofs compose unchanged.
+    upstream: str = ""
+    # the replica's own read listener (same transports as a node's RPC)
+    laddr: str = "tcp://0.0.0.0:46659"
+    # bounded staleness: a latest-height read is served from cache only
+    # while the cached proof sits within this many heights of the
+    # replica's verified head, and refused entirely when the replica
+    # itself lags its upstream by more than this
+    max_lag_heights: int = 10
+    # proof-carrying cache entry cap (LRU over (path, key, height))
+    cache_entries: int = 10_000
+    # verified block/commit responses kept for block / blockchain_info /
+    # commit serving and downstream replica chaining (also sizes the
+    # light client's verified-header memo)
+    keep_blocks: int = 64
+    # upstream WS resubscribe backoff: initial seconds, doubling per
+    # consecutive failure up to the max
+    reconnect_backoff_s: float = 0.25
+    reconnect_backoff_max_s: float = 4.0
+
+
+@dataclass
 class Config:
     base: BaseConfig = field(default_factory=BaseConfig)
     rpc: RPCConfig = field(default_factory=RPCConfig)
@@ -279,6 +310,7 @@ class Config:
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     pruning: PruningConfig = field(default_factory=PruningConfig)
     device: DeviceConfig = field(default_factory=DeviceConfig)
+    replica: ReplicaConfig = field(default_factory=ReplicaConfig)
 
     def set_root(self, root: str) -> "Config":
         self.base.root_dir = root
@@ -289,6 +321,7 @@ class Config:
         self.statesync.root_dir = root
         self.pruning.root_dir = root
         self.device.root_dir = root
+        self.replica.root_dir = root
         return self
 
     def copy(self) -> "Config":
@@ -301,6 +334,7 @@ class Config:
             replace(self.statesync),
             replace(self.pruning),
             replace(self.device),
+            replace(self.replica),
         )
 
 

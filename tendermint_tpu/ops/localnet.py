@@ -296,6 +296,110 @@ class LocalNode:
         self.proc = None
 
 
+class ReplicaProc:
+    """One verified read-replica process (round 24, `cli replica`,
+    docs/serving.md § Read replicas) following an upstream node's RPC.
+    The replica_flood scenario scales these out in front of node 0 and
+    points the read flood at them instead of the validator."""
+
+    def __init__(self, home: str, upstream: str, rpc_port: int,
+                 extra_env: dict | None = None):
+        self.home = home
+        self.upstream = upstream
+        self.rpc_port = rpc_port
+        self.extra_env = dict(extra_env or {})
+        self.proc: subprocess.Popen | None = None
+
+    @property
+    def rpc_url(self) -> str:
+        return f"127.0.0.1:{self.rpc_port}"
+
+    def start(self) -> None:
+        os.makedirs(self.home, exist_ok=True)
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.setdefault("TENDERMINT_TPU_DISABLE", "1")
+        env.setdefault("TENDERMINT_DEVD_SOCK", "/nonexistent/devd.sock")
+        env.update({k: str(v) for k, v in self.extra_env.items()})
+        env["PYTHONPATH"] = REPO
+        cmd = [
+            sys.executable, "-m", "tendermint_tpu.cli",
+            "--home", self.home, "replica",
+            "--upstream", self.upstream,
+            "--rpc.laddr", f"tcp://127.0.0.1:{self.rpc_port}",
+            "--log_level", "error",
+        ]
+        self.proc = subprocess.Popen(
+            cmd, cwd=REPO, env=env,
+            stdout=open(os.path.join(self.home, "replica.log"), "ab"),
+            stderr=subprocess.STDOUT,
+        )
+
+    def rpc(self, method: str, params: dict | None = None,
+            timeout: float = 10.0):
+        body = json.dumps({
+            "jsonrpc": "2.0", "id": "localnet", "method": method,
+            "params": params or {},
+        }).encode()
+        req = urllib.request.Request(
+            f"http://{self.rpc_url}/", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            out = json.loads(resp.read())
+        if out.get("error"):
+            raise RuntimeError(f"replica:{self.rpc_port} {method}: "
+                               f"{out['error']}")
+        return out["result"]
+
+    def metrics(self) -> dict:
+        return fleet.fetch_metrics(self.rpc_url)
+
+    def lag(self) -> int:
+        """replica_lag_heights off /status; -1 while down/warming.
+        Raises if the process EXITED: a dead replica must never read
+        as merely-warming — a zombie from a prior run squatting the
+        port would answer /status in its place and the caller's wait
+        loop would bind the flood to stale state."""
+        if self.proc is not None and self.proc.poll() is not None:
+            tail = b""
+            try:
+                with open(os.path.join(self.home, "replica.log"), "rb") as f:
+                    tail = f.read()[-400:]
+            except OSError:
+                pass
+            raise RuntimeError(
+                f"replica :{self.rpc_port} exited "
+                f"rc={self.proc.returncode}: ...{tail.decode(errors='replace')}"
+            )
+        try:
+            st = self.rpc("status", timeout=5)
+            if not st.get("replica", {}).get("connected"):
+                return -1
+            if int(st.get("latest_block_height") or 0) < 2:
+                return -1
+            return int(st["replica_lag_heights"])
+        except Exception:  # noqa: BLE001 — down/starting counts as -1
+            return -1
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill(self, sig=signal.SIGTERM) -> None:
+        if self.proc is None:
+            return
+        try:
+            self.proc.send_signal(sig)
+            self.proc.wait(timeout=15)
+        except Exception:  # noqa: BLE001 — escalate a wedged shutdown
+            try:
+                self.proc.kill()
+                self.proc.wait(timeout=15)
+            except Exception:  # noqa: BLE001
+                pass
+        self.proc = None
+
+
 class Localnet:
     """The process fleet: generate -> start -> drive/chaos -> read."""
 
@@ -704,6 +808,14 @@ def run_scenario(spec: LocalnetSpec, scenario: str = "converge",
                       AHEAD of a bulk marker submitted before it, the
                       ladder transition landed in the flight ring, and
                       per-height byte identity holds across the fleet
+    replica_flood   — the round-24 read-replica proof: boot verified
+                      replica processes behind node 0, point a hot
+                      verified-read flood + WS subscribers at THEM, and
+                      assert the validator's commit cadence stays flat,
+                      replica-served blocks are byte-identical to the
+                      validator's, the replica_* scrape rows move, and
+                      a TENDERMINT_REPLICA_TAMPER replica is rejected
+                      by 100% of verifying clients
 
     Returns a flat JSON-able result row (heights/s, duplicate-vote
     ratio, fleet bytes — the bench's raw material)."""
@@ -1088,11 +1200,197 @@ def run_scenario(spec: LocalnetSpec, scenario: str = "converge",
                 for k, v in st.items():
                     agg[str(k)] = agg.get(str(k), 0) + v
             result["flood_statuses"] = agg
+        elif scenario == "replica_flood":
+            # round-24 read-replica proof: verified replicas absorb a
+            # hot read flood while the validator's commit cadence stays
+            # flat, replica-served blocks are byte-identical to the
+            # validator's, and a tampering replica is rejected by every
+            # verifying client
+            assert spec.n >= 2, "replica_flood needs n >= 2 (byte identity)"
+            from tendermint_tpu.rpc.client import HTTPClient, WSClient
+            from tendermint_tpu.rpc.light import (
+                LightClient,
+                LightClientError,
+            )
+
+            target_node = net.nodes[0]
+            ok = net.wait_height(2, timeout=120.0)
+            assert ok, f"net never settled: {net.heights()}"
+            # commit content for the replicas to serve before measuring
+            keys = [f"rk{i}".encode() for i in range(8)]
+            for i, k in enumerate(keys):
+                deadline = time.monotonic() + 60.0
+                sent = False
+                while not sent and time.monotonic() < deadline:
+                    try:
+                        target_node.rpc("broadcast_tx_async",
+                                        {"tx": (k + b"=rv%d" % i).hex()})
+                        sent = True
+                    except Exception:  # noqa: BLE001 — mempool backoff
+                        time.sleep(0.2)
+                assert sent, f"seed key {k!r} never admitted"
+            # unloaded baseline cadence
+            b0 = target_node.metrics_height()
+            t_b = time.monotonic()
+            ok = net.wait_height(b0 + heights, timeout=60.0 * heights)
+            assert ok, f"no unloaded convergence: {net.heights()}"
+            baseline_hps = heights / (time.monotonic() - t_b)
+            # two honest replicas + one tampering one behind node 0
+            rep_base = spec.base_port + 2 * spec.n + 10
+            replicas = [
+                ReplicaProc(os.path.join(spec.root, f"replica{i}"),
+                            target_node.rpc_url, rep_base + i)
+                for i in range(2)
+            ]
+            tamper_rep = ReplicaProc(
+                os.path.join(spec.root, "replica-tamper"),
+                target_node.rpc_url, rep_base + 2,
+                extra_env={"TENDERMINT_REPLICA_TAMPER": "value"},
+            )
+            procs = replicas + [tamper_rep]
+            stop = threading.Event()
+            read_stats: list[dict] = [{} for _ in range(4)]
+            floods: list[threading.Thread] = []
+            subs: list = []
+            try:
+                for r in procs:
+                    r.start()
+                for r in procs:
+                    deadline = time.monotonic() + 120.0
+                    while r.lag() != 0 and time.monotonic() < deadline:
+                        time.sleep(0.25)
+                    assert r.lag() == 0, (
+                        f"replica :{r.rpc_port} never caught up")
+
+                # the read flood lands on the REPLICAS only: verified
+                # hot-key reads plus relayed-event subscribers — the
+                # validator serves none of it
+                def read_params(i, keys=keys):
+                    return {"data": keys[i % len(keys)].hex(), "path": "",
+                            "height": 0, "prove": True}
+
+                for j, st in enumerate(read_stats):
+                    floods.append(threading.Thread(
+                        target=_flood_loop, daemon=True,
+                        args=(replicas[j % len(replicas)].rpc_port,
+                              "abci_query", read_params, stop, st,
+                              f"127.0.1.{j + 1}"),
+                    ))
+                for r in replicas:
+                    ws = WSClient(r.rpc_url)
+                    ws.subscribe("NewBlock")
+                    subs.append(ws)
+                for th in floods:
+                    th.start()
+                # loaded cadence, measured on the validator
+                flood_heights = max(heights, 6)
+                h0 = target_node.metrics_height()
+                t_f = time.monotonic()
+                deadline = t_f + 120.0 * flood_heights
+                while time.monotonic() < deadline:
+                    if target_node.metrics_height() >= h0 + flood_heights:
+                        break
+                    time.sleep(0.25)
+                h1 = target_node.metrics_height()
+                assert h1 >= h0 + flood_heights, (
+                    f"consensus stalled under replica flood: {h0} -> {h1}")
+                flood_hps = flood_heights / (time.monotonic() - t_f)
+                # every downstream subscriber rode the relayed stream
+                relayed = 0
+                for ws in subs:
+                    try:
+                        ev = ws.next_event(timeout=30.0)
+                        hdr = ((ev.get("data") or {}).get("block")
+                               or {}).get("header") or {}
+                        if hdr.get("height"):
+                            relayed += 1
+                    except Exception:  # noqa: BLE001 — counted below
+                        pass
+                assert relayed == len(subs), (
+                    f"only {relayed}/{len(subs)} subscribers saw events")
+                stop.set()
+                for th in floods:
+                    th.join(timeout=10)
+                # replica scrape surface: reads served off the verified
+                # cache, zero proof failures on the honest replicas
+                served = hits = 0.0
+                for r in replicas:
+                    m = r.metrics()
+                    assert (fleet.metric_value(
+                        m, "replica_height", default=0) or 0) >= 1, (
+                        f"replica :{r.rpc_port} reports no height")
+                    assert (fleet.metric_value(
+                        m, "replica_proof_verify_failures",
+                        default=0) or 0) == 0, (
+                        f"proof failures on honest replica :{r.rpc_port}")
+                    served += fleet.metric_value(
+                        m, "replica_served_reads_total", default=0) or 0
+                    hits += fleet.metric_value(
+                        m, "replica_cache_hits", default=0) or 0
+                assert served > 0, "replicas served no reads"
+                assert hits > 0, "no proof-cache hits under a hot-key flood"
+                # cadence: the validator must not feel the read flood
+                assert flood_hps >= baseline_hps / 1.5, (
+                    f"cadence degraded past 1.5x behind replicas: "
+                    f"{flood_hps:.2f} hps vs {baseline_hps:.2f} unloaded")
+                # byte identity: a replica-served block IS the
+                # validator's block, byte for byte
+                h = max(1, target_node.metrics_height() - 2)
+                want = json.dumps(
+                    target_node.rpc("block", {"height": h}), sort_keys=True)
+                for r in replicas:
+                    got = json.dumps(
+                        r.rpc("block", {"height": h}), sort_keys=True)
+                    assert got == want, (
+                        f"replica :{r.rpc_port} serves different bytes "
+                        f"at height {h}")
+                # tamper probe: a verifying client rejects EVERY read
+                # from the lying replica — corruption is detected, not
+                # propagated
+                lc = LightClient.from_genesis(
+                    HTTPClient(tamper_rep.rpc_url))
+                probe_keys = keys[:4]
+                rejected = 0
+                for k in probe_keys:
+                    try:
+                        lc.verified_query(k)
+                    except LightClientError:
+                        rejected += 1
+                assert rejected == len(probe_keys), (
+                    f"tampered replica only rejected "
+                    f"{rejected}/{len(probe_keys)} reads")
+            finally:
+                stop.set()
+                for th in floods:
+                    th.join(timeout=10)
+                for ws in subs:
+                    try:
+                        ws.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                for r in procs:
+                    r.kill()
+            target = min(h for h in net.heights() if h >= 0)
+            result["converged_heights"] = net.assert_converged(target)
+            result["heights"] = target
+            result["replicas"] = len(replicas)
+            result["baseline_heights_per_s"] = round(baseline_hps, 3)
+            result["flood_heights_per_s"] = round(flood_hps, 3)
+            result["cadence_ratio"] = round(flood_hps / baseline_hps, 3)
+            result["replica_reads_served"] = int(served)
+            result["replica_cache_hits"] = int(hits)
+            result["tamper_rejected"] = rejected
+            result["tamper_probes"] = len(probe_keys)
+            agg: dict = {}
+            for st in read_stats:
+                for k, v in st.items():
+                    agg[str(k)] = agg.get(str(k), 0) + v
+            result["flood_statuses"] = agg
         else:
             raise ValueError(
                 f"unknown scenario {scenario!r}; known: converge, "
                 "partition_heal, rolling_restart, upgrade, pex_churn, "
-                "overload"
+                "overload, replica_flood"
             )
         result["duplicate_vote_ratio"] = net.duplicate_vote_ratio()
         result["gossip_bytes"] = net.gossip_bytes()
@@ -1117,7 +1415,8 @@ def main(argv=None) -> int:
                          "unless --keep)")
     ap.add_argument("--scenario", default="converge",
                     choices=["converge", "partition_heal", "rolling_restart",
-                             "upgrade", "pex_churn", "overload"])
+                             "upgrade", "pex_churn", "overload",
+                             "replica_flood"])
     ap.add_argument("--heights", type=int, default=5)
     ap.add_argument("--topology", default="",
                     choices=["", "full", "ring", "star"])

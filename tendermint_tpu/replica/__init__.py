@@ -1,0 +1,9 @@
+"""Verified read-replica tier (round 24, docs/serving.md § Read
+replicas): stateless proof-carrying replicas that scale the read RPC
+surface horizontally while clients keep verifying every byte against
+validator-signed headers."""
+
+from tendermint_tpu.replica.cache import ProofCache
+from tendermint_tpu.replica.daemon import ReplicaDaemon
+
+__all__ = ["ProofCache", "ReplicaDaemon"]

@@ -16,7 +16,7 @@ import queue
 import socket
 import struct
 import threading
-import urllib.request
+from urllib.parse import urlsplit
 
 from tendermint_tpu.rpc.core.routes import build_routes
 
@@ -42,10 +42,24 @@ class _UnixHTTPConnection(http.client.HTTPConnection):
 
 
 class HTTPClient:
+    """JSON-RPC over HTTP with per-thread persistent connections (round
+    24): a replica's upstream fetch path issues thousands of small POSTs
+    and a fresh TCP handshake per request was the dominant cost. Each
+    calling thread keeps ONE keep-alive connection (the server side is
+    HTTP/1.1 with Content-Length). A connection that turns out dead on
+    reuse — server restart, idle EOF — is rebuilt and the request
+    retried once; a FRESH connection's failure still raises (the server
+    is genuinely down), and a timeout never retries (the request may be
+    executing server-side, and a broadcast_tx must not double-submit)."""
+
     def __init__(self, addr: str, timeout: float = 30.0):
         # addr: "host:port", "http://host:port", or "unix:///path.sock"
         self.timeout = timeout
         self._id = 0
+        self._mtx = threading.Lock()
+        self._local = threading.local()
+        # reused-connection rebuilds that transparently re-sent a request
+        self.reconnects = 0
         if addr.startswith("unix://"):
             self.unix_path: str | None = addr[len("unix://"):]
             self.addr = addr
@@ -54,54 +68,88 @@ class HTTPClient:
         if not addr.startswith("http"):
             addr = "http://" + addr
         self.addr = addr.rstrip("/")
+        u = urlsplit(self.addr)
+        self._host = u.hostname or "127.0.0.1"
+        self._port = u.port or 80
 
-    def _call_unix(self, data: bytes) -> dict:
-        conn = _UnixHTTPConnection(self.unix_path, self.timeout)
+    def _connect(self):
+        if self.unix_path:
+            return _UnixHTTPConnection(self.unix_path, self.timeout)
+        return http.client.HTTPConnection(
+            self._host, self._port, timeout=self.timeout
+        )
+
+    def _drop(self, conn) -> None:
+        self._local.conn = None
         try:
-            conn.request(
-                "POST", "/", body=data,
-                headers={"Content-Type": "application/json"},
-            )
-            resp = conn.getresponse()
-            raw = resp.read()
-        finally:
             conn.close()
+        except OSError:
+            pass
+
+    @staticmethod
+    def _roundtrip(conn, data: bytes) -> tuple[int, bytes, bool]:
+        conn.request(
+            "POST", "/", body=data,
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        raw = resp.read()
+        return resp.status, raw, not resp.will_close
+
+    def _post(self, data: bytes) -> tuple[int, bytes]:
+        conn = getattr(self._local, "conn", None)
+        reused = conn is not None
+        if conn is None:
+            conn = self._connect()
         try:
-            return json.loads(raw.decode())
-        except ValueError as exc:
-            raise RPCClientError(f"HTTP {resp.status}") from exc
+            status, raw, keep = self._roundtrip(conn, data)
+        except TimeoutError:
+            self._drop(conn)
+            raise
+        except (http.client.HTTPException, ConnectionError, OSError):
+            self._drop(conn)
+            if not reused:
+                raise
+            with self._mtx:
+                self.reconnects += 1
+            conn = self._connect()
+            try:
+                status, raw, keep = self._roundtrip(conn, data)
+            except (http.client.HTTPException, ConnectionError, OSError):
+                self._drop(conn)
+                raise
+        if keep:
+            self._local.conn = conn
+        else:
+            self._drop(conn)
+        return status, raw
 
     def call(self, method: str, **params):
-        self._id += 1
+        with self._mtx:
+            self._id += 1
+            id_ = self._id
         req = {
             "jsonrpc": "2.0",
-            "id": self._id,
+            "id": id_,
             "method": method,
             "params": params,
         }
-        data = json.dumps(req).encode()
-        if self.unix_path:
-            body = self._call_unix(data)
-            if body.get("error"):
-                raise RPCClientError(body["error"])
-            return body["result"]
-        r = urllib.request.Request(
-            self.addr + "/",
-            data=data,
-            headers={"Content-Type": "application/json"},
-        )
+        status, raw = self._post(json.dumps(req).encode())
+        # JSON-RPC errors ride non-200 statuses with a JSON body
         try:
-            with urllib.request.urlopen(r, timeout=self.timeout) as resp:
-                body = json.loads(resp.read().decode())
-        except urllib.error.HTTPError as exc:
-            # JSON-RPC errors ride non-200 statuses with a JSON body
-            try:
-                body = json.loads(exc.read().decode())
-            except ValueError:
-                raise RPCClientError(f"HTTP {exc.code}") from exc
+            body = json.loads(raw.decode())
+        except ValueError as exc:
+            raise RPCClientError(f"HTTP {status}") from exc
         if body.get("error"):
             raise RPCClientError(body["error"])
         return body["result"]
+
+    def close(self) -> None:
+        """Close THIS thread's persistent connection (each thread owns
+        its own; idle ones die with their thread or at GC)."""
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            self._drop(conn)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):

@@ -13,6 +13,8 @@ verification — no skipping/bisection, matching the reference line).
 
 from __future__ import annotations
 
+from collections import OrderedDict
+
 from tendermint_tpu.types.agg_commit import commit_from_json, commit_is_aggregate
 from tendermint_tpu.types.block import Commit, Header
 from tendermint_tpu.types.validator_set import CommitError, ValidatorSet
@@ -37,6 +39,13 @@ class LightClient:
         # validator-set change is chain-linked to a verified predecessor,
         # even across separate advance() calls
         self._trusted_header: Header | None = None
+        # small LRU of VERIFIED headers by height (round 24): N proof
+        # checks at one height cost one commit verification, and a read
+        # replica can verify proofs at any recent height without
+        # re-walking trust. Every entry comes out of _verify_with, so
+        # everything memoized carried +2/3 of a trusted/adopted set.
+        self.header_memo_max = 64
+        self._header_memo: OrderedDict[int, Header] = OrderedDict()
 
     @classmethod
     def from_genesis(cls, client, **kw) -> "LightClient":
@@ -64,6 +73,10 @@ class LightClient:
             batch_verifier=self.batch_verifier,
         )
         c._trusted_header = self._trusted_header
+        # the memo is copied, not shared: the clone's walk must never
+        # mutate this instance's state
+        c.header_memo_max = self.header_memo_max
+        c._header_memo = OrderedDict(self._header_memo)
         return c
 
     def trusted_header(self) -> Header | None:
@@ -120,7 +133,37 @@ class LightClient:
             )
         except CommitError as exc:
             raise LightClientError(f"commit verification failed: {exc}")
+        self._memo_header(height, header)
         return header
+
+    def _memo_header(self, height: int, header: Header) -> None:
+        memo = self._header_memo
+        memo[height] = header
+        memo.move_to_end(height)
+        while len(memo) > max(1, self.header_memo_max):
+            memo.popitem(last=False)
+
+    def header_at(self, height: int) -> Header:
+        """A verified header at `height`, from the memo when possible
+        (round 24): repeat proof checks at one height verify its commit
+        once, not once per query. Advances trust when `height` is ahead
+        of the walk; raises LightClientError when the height fell out of
+        the memo behind trust (re-query for a fresher proof)."""
+        hdr = self._header_memo.get(height)
+        if hdr is not None:
+            self._header_memo.move_to_end(height)
+            return hdr
+        if height > self.height:
+            self.advance(height)
+        if height == self.height and self._trusted_header is not None:
+            return self._trusted_header
+        hdr = self._header_memo.get(height)
+        if hdr is not None:
+            return hdr
+        raise LightClientError(
+            f"no verified header at {height} (trust is at {self.height}); "
+            "re-query for a fresher proof"
+        )
 
     def advance(self, to_height: int) -> None:
         """Walk trust forward to `to_height`, verifying every header with
@@ -375,15 +418,9 @@ class LightClient:
         if proof.key != key:
             raise LightClientError("proof is for a different key")
         # the root that commits height-h app state is header (h+1)'s
-        # app_hash; walk trust there if we aren't yet
-        if self.height < h + 1:
-            self.advance(h + 1)
-        if self.height != h + 1 or self._trusted_header is None:
-            raise LightClientError(
-                f"no verified header at {h + 1} (trust is at {self.height}); "
-                "re-query for a fresher proof"
-            )
-        app_hash = self._trusted_header.app_hash
+        # app_hash; header_at serves repeat queries at one height from
+        # the verified-header memo and walks trust forward when needed
+        app_hash = self.header_at(h + 1).app_hash
         if not proof.verify(app_hash):
             raise LightClientError(
                 f"state proof failed verification against header {h + 1}"

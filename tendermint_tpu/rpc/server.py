@@ -96,10 +96,12 @@ def _coerce_params(params: dict, known: list[str]) -> dict:
 
 
 class RPCServer(BaseService):
-    def __init__(self, laddr: str, ctx, unsafe: bool = False):
+    def __init__(self, laddr: str, ctx, unsafe: bool = False, routes=None):
         super().__init__(name="rpc.server")
         self.ctx = ctx
-        self.routes = build_routes(unsafe)
+        # routes override (round 24): the replica daemon serves the read
+        # surface off its verified cache with the same transports/admission
+        self.routes = build_routes(unsafe) if routes is None else dict(routes)
         # ingress admission (round 23, rpc/admission.py): the node wires
         # a shared controller (node.rpc_admission) so telemetry and the
         # load-shed ladder see it; bare harnesses get a private default
@@ -309,10 +311,15 @@ class RPCServer(BaseService):
                     self._respond({"status": "ok", "code": 0, "checks": {},
                                    "note": "no node in RPC context"})
                     return
-                from tendermint_tpu.node.health import health_report
+                # a node-like facade (replica daemon) supplies its own
+                # verdict through health_fn; full nodes use health_report
+                health_fn = getattr(node, "health_fn", None)
+                if health_fn is None:
+                    from tendermint_tpu.node.health import health_report
 
+                    health_fn = lambda: health_report(node)  # noqa: E731
                 try:
-                    report = health_report(node)
+                    report = health_fn()
                 except Exception:  # noqa: BLE001 — a broken check is a
                     # wiring bug; surface it as a probe failure, never
                     # take the RPC thread down

@@ -113,6 +113,58 @@ def test_tcp_scheme_accepted():
         srv.stop()
 
 
+def test_http_client_keepalive_reuses_connection(tcp_server):
+    """Round-24 satellite: the replica's upstream fetch path must NOT
+    pay a TCP handshake per request — one thread keeps one connection."""
+    c = HTTPClient(f"127.0.0.1:{tcp_server.port}")
+    assert c.echo(value=1)["value"] == 1
+    conn1 = c._local.conn
+    assert conn1 is not None
+    assert c.echo(value=2)["value"] == 2
+    assert c._local.conn is conn1
+    assert c.reconnects == 0
+    c.close()
+    assert c._local.conn is None
+
+
+def test_http_client_reconnects_on_eof(tcp_server):
+    """Regression: EOF on the persistent connection (server restart,
+    idle timeout) heals with ONE transparent rebuild + resend."""
+    c = HTTPClient(f"127.0.0.1:{tcp_server.port}")
+    assert c.echo(value="a")["value"] == "a"
+    # sever the kept-alive connection out from under the client — what
+    # the far end going away looks like to the next request
+    c._local.conn.sock.close()
+    assert c.echo(value="b")["value"] == "b"
+    assert c.reconnects == 1
+    # healed connection persists again
+    assert c.echo(value="c")["value"] == "c"
+    assert c.reconnects == 1
+    c.close()
+
+
+def test_http_client_fresh_connection_failure_raises():
+    """A server that is genuinely down raises to the caller — the
+    retry-once path is only for connections that died while parked."""
+    srv = _make_server("127.0.0.1:0")
+    port = srv.port
+    srv.stop()
+    c = HTTPClient(f"127.0.0.1:{port}")
+    with pytest.raises(OSError):
+        c.echo(value=1)
+    assert c.reconnects == 0
+
+
+def test_http_client_keepalive_over_unix(unix_server):
+    c = HTTPClient(f"unix://{unix_server.unix_path}")
+    assert c.echo(value="u1")["value"] == "u1"
+    conn1 = c._local.conn
+    assert conn1 is not None
+    assert c.echo(value="u2")["value"] == "u2"
+    assert c._local.conn is conn1
+    c.close()
+
+
 def test_unix_bind_refuses_to_delete_regular_file():
     """A mistyped laddr pointing at an existing regular file must fail at
     bind WITHOUT deleting the file."""

@@ -535,6 +535,35 @@ class TestVerifiedQuery:
         res = lc.verified_query(b"k5-0", height=head)
         assert res["value"] == b"v5"
 
+    def test_header_memo_one_verification_per_burst(self):
+        """Round-24 satellite: a 100-query burst at one height verifies
+        that height's commit ONCE — repeat proofs ride the verified-
+        header memo, so a replica's serve path costs no per-read commit
+        verification (every /commit fetch implies a verification, so
+        counting fetches counts verifications)."""
+        chain, lc = self._chain()
+        head = chain.block_store.height()
+        real = chain.rpc_stub()
+        calls = {"commit": 0}
+
+        class Counting:
+            def __getattr__(self, name):
+                return getattr(real, name)
+
+            def commit(self, **kw):
+                calls["commit"] += 1
+                return real.commit(**kw)
+
+        lc.client = Counting()
+        first = lc.verified_query(b"k5-0", height=head - 1)
+        assert first["value"] == b"v5"
+        walked = calls["commit"]
+        assert walked >= 1
+        for _ in range(100):
+            res = lc.verified_query(b"k5-0", height=head - 1)
+            assert res["value"] == b"v5"
+        assert calls["commit"] == walked
+
     def test_lying_node_detected(self):
         from tendermint_tpu.rpc.light import LightClientError
 
